@@ -1,0 +1,19 @@
+"""deepspeed_tpu: a TPU-native large-scale training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the full capability set of the
+reference (DeepSpeed v0.11.2 — see SURVEY.md): JSON-config-driven training
+engine, ZeRO-style optimizer/gradient/parameter sharding with tiered offload,
+data/tensor/pipeline/expert/sequence parallelism on one named device mesh,
+Pallas kernels for the hot ops, sharded universal checkpoints, inference/
+decode engine, and the observability stack.
+"""
+
+from .config import Config
+from .platform import (get_accelerator, init_distributed, build_mesh, MeshSpec)
+from .runtime.engine import Engine, initialize
+from .version import __version__
+
+from . import comm  # noqa: F401  (deepspeed.comm analog)
+
+__all__ = ["initialize", "Engine", "Config", "get_accelerator",
+           "init_distributed", "build_mesh", "MeshSpec", "__version__"]

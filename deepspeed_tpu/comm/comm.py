@@ -1,0 +1,147 @@
+"""Named-axis collective API + comms logging.
+
+TPU-native analog of ``deepspeed/comm/comm.py``: the reference exposes a
+torch.distributed-superset API over a global backend object and wraps every
+collective in a ``timed_op`` profiler (``comm/comm.py:101-134``). Here the
+"backend" is XLA itself — these wrappers are called *inside* ``shard_map``/
+``jit`` bodies with mesh axis names, and XLA lowers them onto ICI/DCN.
+
+Because collectives execute inside a compiled program, per-op host timing is
+meaningless; instead the ``CommsLogger`` records op/volume metadata at trace
+time (exact, since shapes are static) and can report aggregate volumes per
+axis — the analog of the reference's msg-size/algbw log
+(``utils/comms_logging.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.logging import log_dist
+
+_REDUCE_OPS = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin, "mean": lax.pmean}
+
+
+@dataclass
+class CommEvent:
+    op: str
+    axis: str
+    bytes: int
+    shape: tuple
+    dtype: str
+
+
+@dataclass
+class CommsLogger:
+    """Trace-time collective ledger (reference ``CommsLogger``)."""
+
+    enabled: bool = False
+    verbose: bool = False
+    events: list[CommEvent] = field(default_factory=list)
+
+    def record(self, op: str, axis: Any, x: Any) -> None:
+        if not self.enabled:
+            return
+        try:
+            leaves = jax.tree_util.tree_leaves(x)
+            nbytes = sum(l.size * l.dtype.itemsize for l in leaves)
+            shape = tuple(leaves[0].shape) if leaves else ()
+            dtype = str(leaves[0].dtype) if leaves else "?"
+        except Exception:
+            nbytes, shape, dtype = 0, (), "?"
+        ev = CommEvent(op=op, axis=str(axis), bytes=nbytes, shape=shape, dtype=dtype)
+        self.events.append(ev)
+        if self.verbose:
+            log_dist(f"comm: {op} axis={axis} {shape} {dtype} ({nbytes / 1e6:.2f} MB)",
+                     ranks=[0])
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for ev in self.events:
+            key = f"{ev.op}@{ev.axis}"
+            d = out.setdefault(key, {"count": 0, "mbytes": 0.0})
+            d["count"] += 1
+            d["mbytes"] += ev.bytes / 1e6
+        return out
+
+    def log_summary(self) -> None:
+        for key, d in self.summary().items():
+            log_dist(f"comm summary | {key}: n={int(d['count'])} vol={d['mbytes']:.1f} MB",
+                     ranks=[0])
+
+    def reset(self) -> None:
+        self.events.clear()
+
+
+comms_logger = CommsLogger()
+
+
+def _logged(fn):
+    @functools.wraps(fn)
+    def wrapper(x, axis_name, *args, **kwargs):
+        comms_logger.record(fn.__name__, axis_name, x)
+        return fn(x, axis_name, *args, **kwargs)
+
+    return wrapper
+
+
+# ------------------------------------------------------------------ collectives
+@_logged
+def all_reduce(x, axis_name: str | Sequence[str], op: str = "sum"):
+    return jax.tree.map(lambda t: _REDUCE_OPS[op](t, axis_name), x)
+
+
+@_logged
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.tree.map(lambda t: lax.all_gather(t, axis_name, axis=axis, tiled=tiled), x)
+
+
+@_logged
+def reduce_scatter(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.tree.map(
+        lambda t: lax.psum_scatter(t, axis_name, scatter_dimension=axis, tiled=tiled), x)
+
+
+@_logged
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool = True):
+    return jax.tree.map(
+        lambda t: lax.all_to_all(t, axis_name, split_axis=split_axis,
+                                 concat_axis=concat_axis, tiled=tiled), x)
+
+
+@_logged
+def ppermute(x, axis_name: str, perm: Sequence[tuple[int, int]]):
+    return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm=perm), x)
+
+
+@_logged
+def broadcast(x, axis_name: str, src: int = 0):
+    """Broadcast ``src``'s value to every member of the axis."""
+
+    def _bcast(t):
+        idx = lax.axis_index(axis_name)
+        masked = jnp.where(idx == src, t, jnp.zeros_like(t))
+        return lax.psum(masked, axis_name)
+
+    return jax.tree.map(_bcast, x)
+
+
+def barrier(axis_name: str):
+    """Synchronize an axis (a psum of a scalar; XLA orders around it)."""
+    return lax.psum(jnp.zeros((), jnp.float32), axis_name)
+
+
+def get_world_size(axis_name: str | Sequence[str]) -> int:
+    """Axis size from inside a shard_map body."""
+    if isinstance(axis_name, (tuple, list)):
+        size = 1
+        for a in axis_name:
+            size *= lax.axis_size(a)
+        return size
+    return lax.axis_size(axis_name)

@@ -1,0 +1,8 @@
+from .base import AUTO, ConfigModel, is_auto, sci_int
+from .config import (BF16Config, CheckpointConfig, Config, FP16Config,
+                     MeshConfig, MoEConfig, OffloadConfig, OptimizerConfig,
+                     RematConfig, SchedulerConfig, ZeroConfig)
+
+__all__ = ["Config", "ConfigModel", "AUTO", "is_auto", "sci_int", "OptimizerConfig",
+           "SchedulerConfig", "BF16Config", "FP16Config", "ZeroConfig", "MeshConfig",
+           "RematConfig", "OffloadConfig", "CheckpointConfig", "MoEConfig"]

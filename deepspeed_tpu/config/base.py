@@ -1,0 +1,60 @@
+"""Typed config-tree base machinery.
+
+Analog of the reference's ``runtime/config_utils.py:16`` (``DeepSpeedConfigModel``):
+pydantic models with support for the ``"auto"`` sentinel, deprecated-field
+migration, and scientific-notation integers (``pp_int``-style ``5e8`` values in
+JSON configs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+AUTO = "auto"
+
+
+def is_auto(value: Any) -> bool:
+    return isinstance(value, str) and value.lower() == AUTO
+
+
+def sci_int(value: Any) -> int:
+    """Accept 5e8 / "5e8" / 500_000_000 style values as ints."""
+    if isinstance(value, str):
+        value = float(value)
+    return int(value)
+
+
+class ConfigModel(BaseModel):
+    """Base for every config node.
+
+    ``DEPRECATED_ALIASES``: mapping old_field -> new_field. If a user config
+    sets the old key and not the new one, the value migrates with a warning —
+    the same contract as the reference's ``deprecated``/``new_param`` field
+    metadata (``config_utils.py:16``).
+    """
+
+    model_config = ConfigDict(extra="forbid", validate_assignment=True,
+                              arbitrary_types_allowed=True, populate_by_name=True)
+
+    DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {}
+
+    @model_validator(mode="before")
+    @classmethod
+    def _migrate_deprecated(cls, values: Any) -> Any:
+        if not isinstance(values, dict):
+            return values
+        aliases = cls.DEPRECATED_ALIASES
+        for old, new in aliases.items():
+            if old in values:
+                from ..utils.logging import warning_once
+
+                warning_once(f"config field '{old}' is deprecated; use '{new}'")
+                values.setdefault(new, values.pop(old))
+        return values
+
+
+def get_scalar_param(d: dict, key: str, default: Any) -> Any:
+    """Dict-with-default lookup (reference ``config.py`` ``get_scalar_param``)."""
+    return d.get(key, default)

@@ -1,0 +1,295 @@
+"""Top-level JSON config tree.
+
+TPU-native analog of ``runtime/config.py:686`` (``DeepSpeedConfig``): a single
+JSON/dict drives the whole engine. Field names intentionally match the
+reference ds_config schema (train_batch_size / gradient_accumulation_steps /
+optimizer / scheduler / bf16 / zero_optimization / ...) so users migrating
+from the reference find the same knobs; TPU-specific additions live under
+``mesh`` (parallelism degrees — replacing the external Megatron ``mpu``
+object) and ``remat`` (activation checkpointing policy).
+
+Batch arithmetic follows the reference contract
+(``runtime/config.py`` batch-size resolution):
+
+    train_batch_size = micro_batch_per_device * gradient_accumulation_steps
+                       * dp_world_size
+
+Any one of the three may be "auto"/omitted and is solved for; all three given
+must be consistent.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, ClassVar, Literal, Optional, Union
+
+from pydantic import Field, field_validator
+
+from .base import AUTO, ConfigModel, is_auto, sci_int
+
+
+# --------------------------------------------------------------------- pieces
+class OptimizerConfig(ConfigModel):
+    type: str = "adamw"
+    params: dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(ConfigModel):
+    type: str = "WarmupLR"
+    params: dict[str, Any] = Field(default_factory=dict)
+
+
+class BF16Config(ConfigModel):
+    enabled: bool = True
+
+
+class FP16Config(ConfigModel):
+    """fp16 + dynamic loss scale (reference ``runtime/fp16/loss_scaler.py``).
+
+    On TPU bf16 is the native fast dtype and needs no loss scale; fp16 is kept
+    for capability parity and numerics experiments.
+    """
+
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadConfig(ConfigModel):
+    """Reference ``runtime/zero/offload_config.py``."""
+
+    device: Literal["none", "cpu", "nvme"] = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = True
+    pipeline_read: bool = True
+    pipeline_write: bool = True
+
+    @property
+    def enabled(self) -> bool:
+        return self.device != "none"
+
+
+class ZeroConfig(ConfigModel):
+    """Reference ``runtime/zero/config.py``.
+
+    Under XLA the stages are realized as sharding/collective choices compiled
+    into the train step (see ``runtime/zero/partitioning.py``), not optimizer
+    subclasses; the knobs keep their reference meanings.
+    """
+
+    stage: int = 0
+    # Params smaller than this stay replicated under stage 3
+    # (reference ``param_persistence_threshold``).
+    param_persistence_threshold: int = 10_000
+    reduce_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
+    # ZeRO++: secondary param shard within a fast-ICI subgroup (hpZ),
+    # quantized weight gather (qwZ), quantized gradient a2a (qgZ).
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # MiCS-style sub-group sharding (shard within groups of this size).
+    mics_shard_size: int = 0
+
+    DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {"cpu_offload": "offload_optimizer"}
+
+    @field_validator("param_persistence_threshold", "reduce_bucket_size", mode="before")
+    @classmethod
+    def _sci(cls, v):
+        return sci_int(v) if not is_auto(v) else v
+
+
+class MeshConfig(ConfigModel):
+    """Parallelism degrees → named mesh axes (TPU-specific; replaces the
+    reference's external ``mpu`` + pipe topology)."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+
+class RematConfig(ConfigModel):
+    """Activation checkpointing (reference ``runtime/activation_checkpointing``).
+
+    Realized as ``jax.checkpoint`` policies on the layer scan rather than
+    explicit tensor stashing; ``offload`` maps saved residuals to host memory
+    (the reference's ``cpu_checkpointing``).
+    """
+
+    enabled: bool = False
+    policy: Literal["none", "full", "dots_saveable", "save_nothing",
+                    "offload_dots"] = "dots_saveable"
+    offload: bool = False
+
+
+class MonitorConfig(ConfigModel):
+    enabled: bool = False
+    tensorboard: dict[str, Any] = Field(default_factory=dict)
+    csv_monitor: dict[str, Any] = Field(default_factory=dict)
+    wandb: dict[str, Any] = Field(default_factory=dict)
+
+
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    prof_ops: list[str] = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CheckpointConfig(ConfigModel):
+    use_node_local_storage: bool = False
+    tag_validation: Literal["ignore", "warn", "fail"] = "warn"
+    load_universal: bool = False
+    async_save: bool = True
+
+
+class DataTypesConfig(ConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class GradientCompressionConfig(ConfigModel):
+    """1-bit / compressed data-parallel gradient path
+    (reference ``runtime/comm/nccl.py:51`` error-feedback sign compression)."""
+
+    enabled: bool = False
+    type: Literal["onebit", "int8"] = "int8"
+
+
+class MoEConfig(ConfigModel):
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    aux_loss_weight: float = 0.01
+
+
+# ----------------------------------------------------------------- top level
+class Config(ConfigModel):
+    # batch arithmetic (reference runtime/config.py)
+    train_batch_size: Union[int, str] = AUTO
+    train_micro_batch_size_per_gpu: Union[int, str] = AUTO
+    gradient_accumulation_steps: Union[int, str] = AUTO
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    seed: int = 42
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+
+    optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
+    scheduler: Optional[SchedulerConfig] = None  # None => constant optimizer lr
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    remat: RematConfig = Field(default_factory=RematConfig)
+    monitor: MonitorConfig = Field(default_factory=MonitorConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    gradient_compression: GradientCompressionConfig = Field(
+        default_factory=GradientCompressionConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
+
+    DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {"zero": "zero_optimization"}
+
+    # ------------------------------------------------------------- factories
+    @classmethod
+    def from_any(cls, cfg: Union["Config", dict, str, Path, None]) -> "Config":
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, Config):
+            return cfg
+        if isinstance(cfg, (str, Path)):
+            with open(cfg) as f:
+                cfg = json.load(f)
+        return cls(**cfg)
+
+    # --------------------------------------------------------------- solving
+    def resolve_batch_sizes(self, dp_world_size: int) -> "Config":
+        """Solve the train/micro/GAS triple (reference batch resolution)."""
+        tb = None if is_auto(self.train_batch_size) else int(self.train_batch_size)
+        mb = (None if is_auto(self.train_micro_batch_size_per_gpu)
+              else int(self.train_micro_batch_size_per_gpu))
+        gas = (None if is_auto(self.gradient_accumulation_steps)
+               else int(self.gradient_accumulation_steps))
+
+        if tb is not None and mb is not None and gas is None:
+            if tb % (mb * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by micro_batch*dp "
+                    f"({mb}*{dp_world_size})")
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None and mb is None:
+            if tb % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by gas*dp "
+                    f"({gas}*{dp_world_size})")
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None:
+            gas = gas or 1
+            tb = tb or mb * gas * dp_world_size
+        elif tb is not None:
+            gas = 1
+            if tb % dp_world_size != 0:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by dp_world_size {dp_world_size}")
+            mb = tb // dp_world_size
+        else:
+            mb, gas, tb = 1, 1, dp_world_size
+
+        if tb != mb * gas * dp_world_size:
+            raise ValueError(
+                f"inconsistent batch config: train_batch_size={tb} != "
+                f"micro({mb}) * gas({gas}) * dp({dp_world_size})")
+
+        out = self.model_copy(deep=True)
+        out.train_batch_size = tb
+        out.train_micro_batch_size_per_gpu = mb
+        out.gradient_accumulation_steps = gas
+        return out
+
+    # ------------------------------------------------------------ properties
+    @property
+    def zero_stage(self) -> int:
+        return self.zero_optimization.stage
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+    def to_dict(self) -> dict:
+        return json.loads(self.model_dump_json())

@@ -1,0 +1,5 @@
+from .presets import build_model, gpt2, llama2, mixtral, tiny_test
+from .transformer import TransformerConfig, TransformerLM
+
+__all__ = ["TransformerConfig", "TransformerLM", "build_model", "gpt2",
+           "llama2", "mixtral", "tiny_test"]

@@ -1,0 +1,71 @@
+"""Model-family presets over the unified TransformerLM.
+
+Covers the model families exercised by the reference baselines (BASELINE.md):
+GPT-2 (125M/1.5B), Llama-2 (7B/13B/70B), BERT-class encoder sizes are served
+by the same trunk with ``causal=False`` planned, Mixtral via ``num_experts``.
+"""
+
+from __future__ import annotations
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def gpt2(size: str = "125m", **overrides) -> TransformerConfig:
+    table = {
+        "125m": dict(n_layer=12, n_head=12, d_model=768),
+        "350m": dict(n_layer=24, n_head=16, d_model=1024),
+        "774m": dict(n_layer=36, n_head=20, d_model=1280),
+        "1.5b": dict(n_layer=48, n_head=25, d_model=1600),
+    }
+    base = dict(vocab_size=50257, max_seq=1024, pos_embedding="learned",
+                norm="layernorm", activation="gelu", use_bias=True,
+                tie_embeddings=True)
+    base.update(table[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama2(size: str = "7b", **overrides) -> TransformerConfig:
+    table = {
+        "tiny": dict(n_layer=4, n_head=8, n_kv_head=4, d_model=256, d_ff=688),
+        "7b": dict(n_layer=32, n_head=32, d_model=4096, d_ff=11008),
+        "13b": dict(n_layer=40, n_head=40, d_model=5120, d_ff=13824),
+        "70b": dict(n_layer=80, n_head=64, n_kv_head=8, d_model=8192, d_ff=28672),
+    }
+    base = dict(vocab_size=32000, max_seq=4096, pos_embedding="rope",
+                norm="rmsnorm", activation="silu_glu", use_bias=False,
+                tie_embeddings=False)
+    base.update(table[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def mixtral(size: str = "8x7b", **overrides) -> TransformerConfig:
+    table = {
+        "tiny": dict(n_layer=4, n_head=8, n_kv_head=4, d_model=256, d_ff=512,
+                     num_experts=4, moe_top_k=2),
+        "8x7b": dict(n_layer=32, n_head=32, n_kv_head=8, d_model=4096, d_ff=14336,
+                     num_experts=8, moe_top_k=2),
+    }
+    base = dict(vocab_size=32000, max_seq=4096, pos_embedding="rope",
+                norm="rmsnorm", activation="silu_glu", use_bias=False,
+                tie_embeddings=False)
+    base.update(table[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def tiny_test(**overrides) -> TransformerConfig:
+    """Unit-test sized config (analog of the reference tests' SimpleModel)."""
+    base = dict(vocab_size=256, n_layer=2, n_head=4, d_model=64, d_ff=128,
+                max_seq=64, tie_embeddings=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def build_model(cfg: TransformerConfig, attention_fn=None) -> TransformerLM:
+    if cfg.num_experts > 1:
+        from .moe import MoETransformerLM
+
+        return MoETransformerLM(cfg, attention_fn=attention_fn)
+    return TransformerLM(cfg, attention_fn=attention_fn)

@@ -1,0 +1,329 @@
+"""Unified decoder-only transformer LM (GPT-2 and Llama families).
+
+This is the flagship model the framework trains and serves. Functional style:
+``init`` builds a param pytree, ``apply`` is a pure function, ``param_specs``
+returns the TP/EP sharding rules as a matching pytree of ``PartitionSpec``.
+
+Design choices that matter on TPU:
+- **scan over stacked layers**: every per-layer weight carries a leading
+  ``L`` dim and the block runs under ``lax.scan`` — one compiled layer body,
+  remat-friendly, and the unit at which ZeRO-3 all-gathers params
+  (the compiled analog of the reference fetch coordinator's per-submodule
+  gather, ``partitioned_param_coordinator.py:256``).
+- **parallelism by constraint**: batch dim sharded over ``(data, expert)``,
+  sequence dim over ``seq``, heads/ffn over ``model``. Ulysses sequence
+  parallelism (reference ``sequence/layer.py:15-85``, all-to-all that trades
+  the sequence shard for a head shard around attention) is expressed as two
+  resharding constraints — GSPMD emits the same all-to-alls.
+- **MXU-friendly shapes**: weights live in (possibly stacked) 2-D matmul
+  layouts, computation in bf16 with fp32 softmax/layernorm accumulations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..platform.mesh import BATCH_AXES, constrain
+
+B_AXES = BATCH_AXES  # ("data", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: Optional[int] = None       # < n_head => GQA/MQA (Llama-2-70B style)
+    d_model: int = 768
+    d_ff: Optional[int] = None            # default 4*d_model (gpt2) / from preset
+    max_seq: int = 1024
+    # family switches
+    pos_embedding: str = "learned"        # "learned" (gpt2) | "rope" (llama)
+    norm: str = "layernorm"               # "layernorm" | "rmsnorm"
+    activation: str = "gelu"              # "gelu" | "silu_glu" (llama)
+    use_bias: bool = True                 # gpt2 yes, llama no
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16             # compute dtype
+    # MoE (dense when num_experts == 1); see models/moe.py
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def is_glu(self) -> bool:
+        return self.activation.endswith("glu")
+
+    def flops_per_token(self) -> float:
+        """6*N matmul FLOPs per token + attention term (for MFU accounting)."""
+        n_params = self.param_count(non_embedding=True)
+        attn = 12 * self.n_layer * self.d_model * self.max_seq
+        return 6 * n_params + attn
+
+    def param_count(self, non_embedding: bool = False) -> int:
+        d, f, L = self.d_model, self.ffn_dim, self.n_layer
+        h, kv, hd = self.n_head, self.kv_heads, self.head_dim
+        per_layer = d * (h * hd) + 2 * d * (kv * hd) + (h * hd) * d
+        per_layer += d * f * (3 if self.is_glu else 2)
+        emb = self.vocab_size * d
+        total = L * per_layer + (emb if not non_embedding else 0)
+        if not self.tie_embeddings and not non_embedding:
+            total += emb
+        return total
+
+
+# ------------------------------------------------------------------ helpers
+def _norm(x, scale, bias, kind: str, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        y = xf * lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    else:
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rope(q, k, positions, theta: float):
+    """Rotary embeddings on (B, S, H, hd) q/k."""
+    hd = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, hd/2)
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+
+    return rot(q.astype(jnp.float32)).astype(q.dtype), rot(k.astype(jnp.float32)).astype(k.dtype)
+
+
+def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None):
+    """Plain causal attention, fp32 softmax. q:(B,S,H,hd) k/v:(B,S,KV,hd).
+
+    Heads are grouped for GQA by repeating kv. The Pallas flash kernel
+    (ops/flash_attention.py) replaces this on TPU for long sequences.
+    """
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / math.sqrt(hd)
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    big_neg = jnp.finfo(jnp.float32).min
+    scores = jnp.where(causal[None, None, :, :], scores, big_neg)
+    if mask is not None:  # (B, S) padding mask on keys
+        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, big_neg)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+# -------------------------------------------------------------------- model
+class TransformerLM:
+    """init/apply/param_specs over a :class:`TransformerConfig`."""
+
+    def __init__(self, config: TransformerConfig, attention_fn=None):
+        self.cfg = config
+        self.attention_fn = attention_fn or causal_attention
+
+    # ----------------------------------------------------------------- init
+    def init(self, rng) -> dict:
+        cfg = self.cfg
+        d, f, L = cfg.d_model, cfg.ffn_dim, cfg.n_layer
+        h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+        k = iter(jax.random.split(rng, 16))
+
+        def dense(key, shape, scale=None):
+            scale = scale or (1.0 / math.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
+            return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+        layers = {
+            "ln1_scale": jnp.ones((L, d), jnp.float32),
+            "wq": dense(next(k), (L, d, h * hd)),
+            "wk": dense(next(k), (L, d, kv * hd)),
+            "wv": dense(next(k), (L, d, kv * hd)),
+            "wo": dense(next(k), (L, h * hd, d), scale=1.0 / math.sqrt(2 * L * d)),
+            "ln2_scale": jnp.ones((L, d), jnp.float32),
+            "w_in": dense(next(k), (L, d, f)),
+            "w_out": dense(next(k), (L, f, d), scale=1.0 / math.sqrt(2 * L * f)),
+        }
+        if cfg.is_glu:
+            layers["w_gate"] = dense(next(k), (L, d, f))
+        if cfg.use_bias:
+            layers.update({
+                "ln1_bias": jnp.zeros((L, d), jnp.float32),
+                "ln2_bias": jnp.zeros((L, d), jnp.float32),
+                "bq": jnp.zeros((L, h * hd), jnp.float32),
+                "bk": jnp.zeros((L, kv * hd), jnp.float32),
+                "bv": jnp.zeros((L, kv * hd), jnp.float32),
+                "bo": jnp.zeros((L, d), jnp.float32),
+                "b_in": jnp.zeros((L, f), jnp.float32),
+                "b_out": jnp.zeros((L, d), jnp.float32),
+            })
+        params = {
+            "tok_embed": jax.random.normal(next(k), (cfg.vocab_size, d), jnp.float32) * 0.02,
+            "layers": layers,
+            "lnf_scale": jnp.ones((d,), jnp.float32),
+        }
+        if cfg.pos_embedding == "learned":
+            params["pos_embed"] = jax.random.normal(next(k), (cfg.max_seq, d),
+                                                    jnp.float32) * 0.02
+        if cfg.use_bias:
+            params["lnf_bias"] = jnp.zeros((d,), jnp.float32)
+        if not cfg.tie_embeddings:
+            params["lm_head"] = dense(next(k), (d, cfg.vocab_size), scale=0.02)
+        return params
+
+    # ---------------------------------------------------------------- specs
+    def param_specs(self) -> dict:
+        """TP (Megatron-style) sharding over the ``model`` axis:
+        qkv/w_in column-split, wo/w_out row-split, embeddings vocab-split."""
+        cfg = self.cfg
+        layers = {
+            "ln1_scale": P(None, None),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "ln2_scale": P(None, None),
+            "w_in": P(None, None, "model"),
+            "w_out": P(None, "model", None),
+        }
+        if cfg.is_glu:
+            layers["w_gate"] = P(None, None, "model")
+        if cfg.use_bias:
+            layers.update({
+                "ln1_bias": P(None, None), "ln2_bias": P(None, None),
+                "bq": P(None, "model"), "bk": P(None, "model"), "bv": P(None, "model"),
+                "bo": P(None, None), "b_in": P(None, "model"), "b_out": P(None, None),
+            })
+        specs = {
+            "tok_embed": P("model", None),
+            "layers": layers,
+            "lnf_scale": P(None),
+        }
+        if cfg.pos_embedding == "learned":
+            specs["pos_embed"] = P(None, None)
+        if cfg.use_bias:
+            specs["lnf_bias"] = P(None)
+        if not cfg.tie_embeddings:
+            specs["lm_head"] = P(None, "model")
+        return specs
+
+    def stacked_fn(self):
+        """Which param shapes are layer-stacked (leading scan dim)."""
+        L = self.cfg.n_layer
+
+        def is_stacked(shape) -> bool:
+            return len(shape) >= 2 and shape[0] == L
+
+        return is_stacked
+
+    # ---------------------------------------------------------------- apply
+    def _layer(self, x, layer_params, positions, attn_mask):
+        cfg = self.cfg
+        p = layer_params
+        B, S, d = x.shape
+        h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+        def maybe_bias(y, name):
+            return y + p[name].astype(y.dtype) if cfg.use_bias and name in p else y
+
+        # ---- attention
+        y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm)
+        q = maybe_bias(y @ p["wq"].astype(y.dtype), "bq").reshape(B, S, h, hd)
+        kk = maybe_bias(y @ p["wk"].astype(y.dtype), "bk").reshape(B, S, kv, hd)
+        vv = maybe_bias(y @ p["wv"].astype(y.dtype), "bv").reshape(B, S, kv, hd)
+        if cfg.pos_embedding == "rope":
+            q, kk = _rope(q, kk, positions, cfg.rope_theta)
+        # Ulysses: trade the sequence shard for a head shard around attention
+        # (reference sequence/layer.py all_to_all pair).
+        qs = constrain(q, P(B_AXES, None, ("model", "seq"), None))
+        ks = constrain(kk, P(B_AXES, None, None, None)) \
+            if kv < h else constrain(kk, P(B_AXES, None, ("model", "seq"), None))
+        vs = constrain(vv, P(B_AXES, None, None, None)) \
+            if kv < h else constrain(vv, P(B_AXES, None, ("model", "seq"), None))
+        o = self.attention_fn(qs, ks, vs, mask=attn_mask)
+        o = constrain(o, P(B_AXES, "seq", "model", None))
+        o = maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), "bo")
+        x = x + o
+        # ---- mlp
+        y = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm)
+        u = maybe_bias(y @ p["w_in"].astype(y.dtype), "b_in")
+        if cfg.is_glu:
+            u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
+        elif cfg.activation == "gelu":
+            u = jax.nn.gelu(u)
+        else:
+            u = jax.nn.silu(u)
+        u = constrain(u, P(B_AXES, "seq", "model"))
+        x = x + maybe_bias(u @ p["w_out"].astype(y.dtype), "b_out")
+        return constrain(x, P(B_AXES, "seq", None))
+
+    def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None):
+        """Forward: (B, S) int32 → (B, S, V) logits (compute dtype)."""
+        cfg = self.cfg
+        B, S = input_ids.shape
+        x = params["tok_embed"].astype(cfg.dtype)[input_ids]  # (B,S,D)
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        if cfg.pos_embedding == "learned":
+            x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
+        x = constrain(x, P(B_AXES, "seq", None))
+
+        body = partial(self._layer, positions=positions, attn_mask=attn_mask)
+        if remat_policy is not None:
+            body = jax.checkpoint(body, policy=remat_policy, prevent_cse=False)
+
+        def scan_fn(carry, layer_params):
+            return body(carry, layer_params), None
+
+        x, _ = lax.scan(scan_fn, x, params["layers"])
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm)
+        if cfg.tie_embeddings:
+            logits = x @ params["tok_embed"].astype(x.dtype).T
+        else:
+            logits = x @ params["lm_head"].astype(x.dtype)
+        return constrain(logits, P(B_AXES, "seq", "model"))
+
+    # ----------------------------------------------------------------- loss
+    def loss(self, params, batch, *, remat_policy=None):
+        """Next-token cross-entropy, fp32, mean over non-pad target tokens."""
+        ids = batch["input_ids"]
+        logits = self.apply(params, ids, attn_mask=batch.get("attention_mask"),
+                            remat_policy=remat_policy)
+        targets = ids[:, 1:]
+        logits = logits[:, :-1].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(nll)

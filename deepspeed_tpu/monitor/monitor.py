@@ -1,0 +1,88 @@
+"""Experiment monitoring fan-out.
+
+Analog of ``deepspeed/monitor/monitor.py:29`` (``MonitorMaster``): rank-0
+event writer dispatching to TensorBoard / CSV / WandB backends, driven by the
+``monitor`` config block. Events are ``(name, value, step)`` tuples.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from pathlib import Path
+from typing import Sequence
+
+import jax
+
+from ..utils.logging import logger
+
+
+class _CsvWriter:
+    def __init__(self, cfg: dict):
+        self.dir = Path(cfg.get("output_path", "./csv_monitor"))
+        self.job = cfg.get("job_name", "DeepSpeedTpuJob")
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._files: dict[str, object] = {}
+
+    def write_events(self, events: Sequence[tuple]):
+        for name, value, step in events:
+            fname = self.dir / (name.replace("/", "_") + ".csv")
+            new = not fname.exists()
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class _TensorboardWriter:
+    def __init__(self, cfg: dict):
+        from torch.utils.tensorboard import SummaryWriter  # torch-cpu is baked in
+
+        out = os.path.join(cfg.get("output_path", "./runs"), cfg.get("job_name", "job"))
+        self.writer = SummaryWriter(log_dir=out)
+
+    def write_events(self, events: Sequence[tuple]):
+        for name, value, step in events:
+            self.writer.add_scalar(name, float(value), int(step))
+        self.writer.flush()
+
+
+class _WandbWriter:
+    def __init__(self, cfg: dict):
+        import wandb
+
+        wandb.init(project=cfg.get("project", "deepspeed_tpu"),
+                   group=cfg.get("group"), team=cfg.get("team"))
+        self.wandb = wandb
+
+    def write_events(self, events: Sequence[tuple]):
+        for name, value, step in events:
+            self.wandb.log({name: float(value)}, step=int(step))
+
+
+class MonitorMaster:
+    def __init__(self, cfg):
+        self.writers = []
+        if jax.process_index() != 0:
+            return
+        if cfg.tensorboard.get("enabled"):
+            try:
+                self.writers.append(_TensorboardWriter(cfg.tensorboard))
+            except Exception as e:  # tensorboard optional
+                logger.warning(f"tensorboard monitor disabled: {e}")
+        if cfg.csv_monitor.get("enabled"):
+            self.writers.append(_CsvWriter(cfg.csv_monitor))
+        if cfg.wandb.get("enabled"):
+            try:
+                self.writers.append(_WandbWriter(cfg.wandb))
+            except Exception as e:
+                logger.warning(f"wandb monitor disabled: {e}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.writers)
+
+    def write_events(self, events: Sequence[tuple]):
+        for w in self.writers:
+            w.write_events(events)

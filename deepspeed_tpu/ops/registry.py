@@ -1,0 +1,40 @@
+"""Op registry.
+
+Analog of the reference ``op_builder/`` system (20 builders, JIT/AOT compile,
+``DS_BUILD_*`` flags): on TPU, device kernels are Pallas (pure Python, no
+build step) and only host-side native code (async I/O, CPU optimizer) needs
+compilation. The registry maps an op name to its best available
+implementation for the current platform, with graceful fallback to the XLA
+reference implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, dict[str, Callable]] = {}
+
+
+def register_op(name: str, platform: str = "default"):
+    """Decorator: register ``fn`` as the implementation of ``name`` on ``platform``."""
+
+    def deco(fn):
+        _REGISTRY.setdefault(name, {})[platform] = fn
+        return fn
+
+    return deco
+
+
+def get_op_builder(name: str, platform: str = "tpu") -> Callable:
+    impls = _REGISTRY.get(name)
+    if not impls:
+        raise KeyError(f"unknown op '{name}'; registered: {sorted(_REGISTRY)}")
+    if platform in impls:
+        return impls[platform]
+    if "default" in impls:
+        return impls["default"]
+    raise KeyError(f"op '{name}' has no implementation for platform '{platform}'")
+
+
+def available_ops() -> list[str]:
+    return sorted(_REGISTRY)

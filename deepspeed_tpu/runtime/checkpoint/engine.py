@@ -1,0 +1,78 @@
+"""Checkpoint save/load.
+
+TPU-native analog of the reference checkpoint stack (``engine.py:2653,2982``
++ pluggable ``runtime/checkpoint_engine/``): one *logical* checkpoint in a
+sharded array store (orbax/tensorstore), written collectively by all hosts —
+universal-by-construction. Where the reference writes per-(dp,tp,pp)-rank
+shard files and needs an offline converter (``checkpoint/ds_to_universal.py``)
+to reshape between topologies, here restore-onto-any-mesh is native: load
+targets are specified as abstract (shape, sharding) and tensorstore reshards.
+
+Layout per tag directory:
+    <dir>/<tag>/state/...      sharded TrainState (master params, moments, step)
+    <dir>/<tag>/meta.json      config + model metadata
+    <dir>/latest               tag pointer (same contract as the reference)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ...utils.logging import log_dist
+
+
+def _checkpointer():
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
+    tag = tag or f"global_step{engine.global_steps}"
+    base = Path(save_dir).absolute()
+    path = base / tag
+    ckptr = _checkpointer()
+    ckptr.save(path / "state", engine.state, force=True)
+    if jax.process_index() == 0:
+        meta = {
+            "tag": tag,
+            "global_steps": engine.global_steps,
+            "config": engine.config.to_dict(),
+            "param_count": engine.param_count,
+            "mesh": dict(engine.mesh.shape),
+        }
+        (path / "meta.json").write_text(json.dumps(meta, indent=2))
+        (base / "latest").write_text(tag)
+    log_dist(f"saved checkpoint {path}", ranks=[0])
+    return str(path)
+
+
+def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
+    base = Path(load_dir).absolute()
+    if tag is None:
+        latest = base / "latest"
+        if not latest.exists():
+            raise FileNotFoundError(f"no 'latest' tag file in {base}")
+        tag = latest.read_text().strip()
+    path = base / tag
+    ckptr = _checkpointer()
+    # Abstract target carries this engine's shardings: restoring onto a
+    # different mesh/topology reshards transparently (elastic resume).
+    abstract = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        engine.state, engine.state_shardings)
+    restored = ckptr.restore(path / "state", item=abstract)
+    engine.state = restored
+    meta_file = path / "meta.json"
+    if meta_file.exists():
+        meta = json.loads(meta_file.read_text())
+        engine.global_steps = int(meta.get("global_steps", int(restored.step)))
+    else:
+        engine.global_steps = int(restored.step)
+    log_dist(f"loaded checkpoint {path} (step {engine.global_steps})", ranks=[0])
+    return str(path)

@@ -1,0 +1,357 @@
+"""Training engine.
+
+TPU-native analog of ``DeepSpeedEngine`` (``runtime/engine.py:175``, 3.5 kLoC).
+The reference wraps the model and orchestrates forward/backward/step
+imperatively with hooks, streams, and bucketed collectives; here the entire
+micro-step pipeline — gradient accumulation (``lax.scan`` over micro-batches,
+replacing the ``is_gradient_accumulation_boundary`` bookkeeping), mixed
+precision casts, loss scaling, ZeRO-sharded gradient reduction, clipping, and
+the optimizer update — is one jitted, donated function. XLA's latency-hiding
+scheduler provides the comm/compute overlap that the reference hand-codes
+with side streams (``overlap_comm``).
+
+API shape follows the reference: ``initialize(config, model, ...)`` returns an
+engine with ``train_batch`` / ``eval_batch`` / ``save_checkpoint`` /
+``load_checkpoint`` / ``client_lr_scheduler``-style accessors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..platform.accelerator import get_accelerator
+from ..platform.mesh import (BATCH_AXES, MeshSpec, build_mesh, dp_world_size)
+from ..utils.logging import log_dist, logger
+from ..utils.timer import ThroughputTimer, WallClockTimers, peak_flops_for
+from .loss_scaler import (LossScaleState, grads_finite, init_loss_scale,
+                          update_loss_scale)
+from .lr_schedules import build_schedule
+from .optimizers import OptState, Optimizer, build_optimizer
+from .zero.partitioning import ZeroPartitioner, shardings_from_specs
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray              # i32 global step
+    master_params: Any             # fp32, ZeRO-sharded per stage
+    opt_state: OptState            # same sharding as master
+    loss_scale: LossScaleState
+    skipped_steps: jnp.ndarray     # i32 (fp16 overflow skips)
+
+
+def _remat_policy(cfg: Config):
+    if not cfg.remat.enabled:
+        return None
+    name = cfg.remat.policy
+    cp = jax.checkpoint_policies
+    table = {
+        "none": None,
+        "full": cp.nothing_saveable,
+        "save_nothing": cp.nothing_saveable,
+        "dots_saveable": cp.dots_saveable,
+    }
+    if name == "offload_dots":
+        try:
+            return cp.save_and_offload_only_these_names(
+                names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:
+            return cp.dots_saveable
+    return table.get(name, cp.dots_saveable)
+
+
+class Engine:
+    """Owns mesh, sharded state, and the compiled train/eval steps."""
+
+    def __init__(self, config: Config | dict | str | None, model,
+                 mesh: Optional[Mesh] = None, seed: Optional[int] = None):
+        self.config = Config.from_any(config)
+        self.model = model
+        self.acc = get_accelerator()
+        m = self.config.mesh
+        self.mesh = mesh or build_mesh(MeshSpec(data=m.data, model=m.model,
+                                                pipe=m.pipe, seq=m.seq,
+                                                expert=m.expert))
+        self.dp_world = dp_world_size(self.mesh)
+        self.config = self.config.resolve_batch_sizes(self.dp_world)
+        self.seed = self.config.seed if seed is None else seed
+
+        zcfg = self.config.zero_optimization
+        self.partitioner = ZeroPartitioner(zcfg, self.mesh)
+        self.optimizer: Optimizer = build_optimizer(self.config.optimizer.type,
+                                                    self.config.optimizer.params)
+        base_lr = float(self.config.optimizer.params.get("lr", 1e-3))
+        sched_cfg = self.config.scheduler
+        self.lr_schedule = build_schedule(sched_cfg.type if sched_cfg else None,
+                                          sched_cfg.params if sched_cfg else {}, base_lr)
+        self.remat_policy = _remat_policy(self.config)
+        self.compute_dtype = self.config.compute_dtype
+
+        # ---------------- sharding trees
+        rng = jax.random.PRNGKey(self.seed)
+        abstract = jax.eval_shape(self.model.init, rng)
+        shapes = jax.tree.map(lambda a: a.shape, abstract)
+        model_specs = self.model.param_specs()
+        stacked = self.model.stacked_fn() if hasattr(self.model, "stacked_fn") else (lambda s: False)
+        self.compute_specs = self.partitioner.compute_specs(model_specs, shapes, stacked)
+        self.master_specs = self.partitioner.master_specs(model_specs, shapes, stacked)
+        self.compute_shardings = shardings_from_specs(self.mesh, self.compute_specs)
+        self.master_shardings = shardings_from_specs(self.mesh, self.master_specs)
+
+        self.param_count = sum(int(np.prod(a.shape))
+                               for a in jax.tree.leaves(abstract))
+        log_dist(f"engine: {self.param_count / 1e6:.1f}M params | zero stage "
+                 f"{zcfg.stage} | mesh {dict(self.mesh.shape)} | "
+                 f"micro={self.config.train_micro_batch_size_per_gpu} "
+                 f"gas={self.config.gradient_accumulation_steps} "
+                 f"global={self.config.train_batch_size}", ranks=[0])
+
+        # ---------------- init state (sharded at construction: the zero.Init
+        # analog — params are born partitioned, never materialized replicated)
+        self.state_shardings = TrainState(
+            step=NamedSharding(self.mesh, P()),
+            master_params=self.master_shardings,
+            opt_state=OptState(mu=self.master_shardings, nu=self.master_shardings,
+                               count=NamedSharding(self.mesh, P())),
+            loss_scale=LossScaleState(*(NamedSharding(self.mesh, P()),) * 3),
+            skipped_steps=NamedSharding(self.mesh, P()),
+        )
+        with self.mesh:
+            init_fn = jax.jit(self._init_state, out_shardings=self.state_shardings)
+            self.state: TrainState = init_fn(rng)
+
+        # opt_state moments for optimizers that don't use nu/mu are empty (0,)
+        # arrays; fix their shardings to replicated to avoid spec-rank mismatch.
+        self._fix_empty_moment_shardings()
+
+        self._train_step = jax.jit(
+            self._train_step_impl,
+            donate_argnums=(0,),
+            in_shardings=(self.state_shardings, self._batch_sharding()),
+            out_shardings=(self.state_shardings, None),
+        )
+        self._eval_step = jax.jit(self._eval_step_impl,
+                                  in_shardings=(self.state_shardings.master_params,
+                                                self._batch_sharding(gas_dim=False)))
+
+        self.timers = WallClockTimers()
+        mb, gas = self.config.train_micro_batch_size_per_gpu, self.config.gradient_accumulation_steps
+        self.throughput = ThroughputTimer(
+            batch_size=int(self.config.train_batch_size),
+            steps_per_output=self.config.steps_per_print,
+            flops_per_sample=self._flops_per_sample(),
+            peak_flops=peak_flops_for(self.acc.current_device()) * len(jax.devices()),
+        )
+        self.global_steps = 0
+        self.monitor = None
+        if self.config.monitor.enabled:
+            from ..monitor.monitor import MonitorMaster
+
+            self.monitor = MonitorMaster(self.config.monitor)
+
+    # ------------------------------------------------------------------ util
+    def _flops_per_sample(self) -> float:
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is not None and hasattr(cfg, "flops_per_token"):
+            return cfg.flops_per_token() * getattr(cfg, "max_seq", 1) * 3  # fwd+bwd
+        return 0.0
+
+    def _batch_sharding(self, gas_dim: bool = True):
+        # batches are dicts of arrays shaped (gas, global_micro, ...) for train
+        # and (global_batch, ...) for eval
+        if gas_dim:
+            return NamedSharding(self.mesh, P(None, BATCH_AXES))
+        return NamedSharding(self.mesh, P(BATCH_AXES))
+
+    def _init_state(self, rng) -> TrainState:
+        master = jax.tree.map(lambda a: a.astype(jnp.float32), self.model.init(rng))
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            master_params=master,
+            opt_state=self.optimizer.init(master),
+            loss_scale=init_loss_scale(self.config.fp16),
+            skipped_steps=jnp.zeros((), jnp.int32),
+        )
+
+    def _fix_empty_moment_shardings(self):
+        def fix(shard_tree, state_tree):
+            return jax.tree.map(
+                lambda s, x: NamedSharding(self.mesh, P()) if x.ndim == 1 and x.shape == (0,) else s,
+                shard_tree, state_tree)
+
+        os = self.state.opt_state
+        self.state_shardings = self.state_shardings._replace(
+            opt_state=OptState(mu=fix(self.state_shardings.opt_state.mu, os.mu),
+                               nu=fix(self.state_shardings.opt_state.nu, os.nu),
+                               count=self.state_shardings.opt_state.count))
+
+    # ------------------------------------------------------------- train step
+    def _cast_compute(self, master):
+        cp = jax.tree.map(lambda p: p.astype(self.compute_dtype), master)
+        return jax.lax.with_sharding_constraint(cp, self.compute_specs)
+
+    def _train_step_impl(self, state: TrainState, batch: dict):
+        cfg = self.config
+        gas = int(cfg.gradient_accumulation_steps)
+        scale = state.loss_scale.scale
+
+        compute_params = self._cast_compute(state.master_params)
+
+        def loss_fn(cp, mb):
+            loss = self.model.loss(cp, mb, remat_policy=self.remat_policy)
+            return loss * scale / gas
+
+        grad_fn = jax.value_and_grad(loss_fn, argnums=0)
+        acc_dtype = jnp.dtype(cfg.data_types.grad_accum_dtype or "float32")
+
+        def gas_body(carry, mb):
+            g_acc, loss_acc = carry
+            scaled_loss, g = grad_fn(compute_params, mb)
+            g_acc = jax.tree.map(lambda a, gg: a + gg.astype(acc_dtype), g_acc, g)
+            return (g_acc, loss_acc + scaled_loss / scale), None
+
+        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), compute_params)
+        (grads, loss), _ = lax.scan(gas_body, (zero_grads, jnp.float32(0.0)), batch)
+
+        # ZeRO >= 2: constrain grads to the master (partitioned) sharding so the
+        # cross-data reduction lowers to reduce-scatter, not all-reduce.
+        grad_specs = self.partitioner.grad_spec_tree(self.master_specs)
+        if grad_specs is not None:
+            grads = jax.lax.with_sharding_constraint(grads, grad_specs)
+
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+        finite = grads_finite(grads) if cfg.fp16.enabled else jnp.bool_(True)
+
+        # gradient clipping (reference engine gradient_clipping / global norm)
+        if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+            clip = jnp.minimum(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * clip, grads)
+        else:
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+
+        lr = self.lr_schedule(state.step)
+
+        def do_update(_):
+            new_master, new_opt = self.optimizer.update(
+                state.master_params, state.opt_state, grads, lr)
+            return new_master, new_opt, jnp.int32(0)
+
+        def skip_update(_):
+            return state.master_params, state.opt_state, jnp.int32(1)
+
+        new_master, new_opt, skipped = lax.cond(finite, do_update, skip_update, None)
+        new_ls = update_loss_scale(state.loss_scale, finite, cfg.fp16)
+
+        new_state = TrainState(
+            step=state.step + 1,
+            master_params=new_master,
+            opt_state=new_opt,
+            loss_scale=new_ls,
+            skipped_steps=state.skipped_steps + skipped,
+        )
+        metrics = {"loss": loss, "grad_norm": gnorm, "lr": lr,
+                   "loss_scale": scale, "skipped": skipped}
+        return new_state, metrics
+
+    def _eval_step_impl(self, master_params, batch: dict):
+        cp = self._cast_compute(master_params)
+        return self.model.loss(cp, batch)
+
+    # ------------------------------------------------------------ public API
+    def _make_global(self, batch: dict, gas_dim: bool = True) -> dict:
+        """Per-host numpy batch → global sharded jax.Arrays.
+
+        Train batches: (gas * micro * local_dp, ...) per host, reshaped to
+        (gas, local_batch, ...) then assembled along the batch dim.
+        """
+        cfg = self.config
+        gas = int(cfg.gradient_accumulation_steps)
+        sharding = self._batch_sharding(gas_dim)
+
+        def to_global(x):
+            x = np.asarray(x)
+            if gas_dim:
+                local = x.shape[0] // gas
+                x = x.reshape((gas, local) + x.shape[1:])
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return {k: to_global(v) for k, v in batch.items()}
+
+    def train_batch(self, batch: dict) -> dict:
+        """One optimizer step over train_batch_size samples (micro-stepping,
+        grad accumulation, and the update are all inside the compiled step)."""
+        self.throughput.start()
+        if not isinstance(next(iter(batch.values())), jax.Array):
+            batch = self._make_global(batch)
+        with self.mesh:
+            self.state, metrics = self._train_step(self.state, batch)
+        self.global_steps += 1
+        if self.config.wall_clock_breakdown or \
+                self.global_steps % self.config.steps_per_print == 0:
+            metrics = {k: float(v) for k, v in metrics.items()}
+            jax.block_until_ready(self.state.step)
+            stats = self.throughput.stop(report=True)
+            if self.global_steps % self.config.steps_per_print == 0:
+                log_dist(f"step={self.global_steps} loss={metrics['loss']:.4f} "
+                         f"lr={metrics['lr']:.3e} gnorm={metrics['grad_norm']:.3f}",
+                         ranks=[0])
+            if self.monitor:
+                events = [(f"Train/loss", metrics["loss"], self.global_steps),
+                          (f"Train/lr", metrics["lr"], self.global_steps)]
+                if stats:
+                    events.append(("Train/samples_per_sec",
+                                   stats["samples_per_sec"], self.global_steps))
+                self.monitor.write_events(events)
+        else:
+            self.throughput.stop(report=False)
+        return metrics
+
+    def eval_batch(self, batch: dict) -> float:
+        if not isinstance(next(iter(batch.values())), jax.Array):
+            batch = self._make_global(batch, gas_dim=False)
+        with self.mesh:
+            return float(self._eval_step(self.state.master_params, batch))
+
+    @property
+    def lr(self) -> float:
+        return float(self.lr_schedule(self.state.step))
+
+    @property
+    def train_micro_batch_size_per_device(self) -> int:
+        return int(self.config.train_micro_batch_size_per_gpu)
+
+    @property
+    def train_batch_size(self) -> int:
+        return int(self.config.train_batch_size)
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, save_dir: str, tag: str | None = None) -> str:
+        from .checkpoint.engine import save_checkpoint as _save
+
+        return _save(self, save_dir, tag)
+
+    def load_checkpoint(self, load_dir: str, tag: str | None = None) -> str:
+        from .checkpoint.engine import load_checkpoint as _load
+
+        return _load(self, load_dir, tag)
+
+
+def initialize(config: Config | dict | str | None = None, model=None,
+               mesh: Optional[Mesh] = None, seed: Optional[int] = None,
+               **kwargs) -> Engine:
+    """Public entry point (reference ``deepspeed.initialize``,
+    ``deepspeed/__init__.py:64``). Returns the engine; the optimizer and LR
+    scheduler live inside it, built from the config."""
+    assert model is not None, "initialize() requires a model"
+    return Engine(config, model, mesh=mesh, seed=seed, **kwargs)

@@ -1,0 +1,62 @@
+"""Dynamic loss scaling for fp16.
+
+Analog of the reference ``runtime/fp16/loss_scaler.py:42`` (DynamicLossScaler)
+and the global overflow check (``stage3.py:1998-2054``): scale the loss,
+detect non-finite grads with one global reduction, skip the step and back off
+the scale on overflow, grow it after a stable window. Fully jittable —
+the skip/backoff is `jnp.where` data-flow, not Python control flow.
+
+bf16 (the TPU-native path) does not need this and runs with scale==1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 current loss scale
+    good_steps: jnp.ndarray     # i32 consecutive non-overflow steps
+    hysteresis: jnp.ndarray     # i32 remaining hysteresis budget
+
+
+def init_loss_scale(cfg: FP16Config) -> LossScaleState:
+    if not cfg.enabled:
+        return LossScaleState(scale=jnp.float32(1.0), good_steps=jnp.int32(0),
+                              hysteresis=jnp.int32(cfg.hysteresis))
+    init = cfg.loss_scale if cfg.loss_scale > 0 else float(2 ** cfg.initial_scale_power)
+    return LossScaleState(scale=jnp.float32(init), good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(cfg.hysteresis))
+
+
+def grads_finite(grads: Any) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    finites = [jnp.all(jnp.isfinite(l)) for l in leaves]
+    return jnp.all(jnp.stack(finites)) if finites else jnp.bool_(True)
+
+
+def update_loss_scale(state: LossScaleState, finite: jnp.ndarray,
+                      cfg: FP16Config) -> LossScaleState:
+    if not cfg.enabled or cfg.loss_scale > 0:  # static scale: never move
+        return state
+    window = cfg.loss_scale_window
+    # overflow: consume hysteresis; halve only when exhausted (reference
+    # ``loss_scaler.py`` hysteresis semantics)
+    hys_left = jnp.maximum(state.hysteresis - 1, 0)
+    backoff_scale = jnp.maximum(state.scale * 0.5, cfg.min_loss_scale)
+    overflow_scale = jnp.where(state.hysteresis <= 1, backoff_scale, state.scale)
+    # stable window: double
+    grown = state.good_steps + 1
+    grow = grown >= window
+    good_scale = jnp.where(grow, state.scale * 2.0, state.scale)
+    return LossScaleState(
+        scale=jnp.where(finite, good_scale, overflow_scale),
+        good_steps=jnp.where(finite, jnp.where(grow, 0, grown), 0).astype(jnp.int32),
+        hysteresis=jnp.where(finite, jnp.int32(cfg.hysteresis),
+                             hys_left.astype(jnp.int32)),
+    )

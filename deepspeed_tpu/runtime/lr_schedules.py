@@ -1,0 +1,131 @@
+"""LR schedules.
+
+Capability analog of the reference ``runtime/lr_schedules.py`` (763 LoC):
+LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR — implemented
+as jittable ``step -> lr`` functions so the schedule value is computed inside
+the compiled train step (no host round-trip per step).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: jnp.float32(lr)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+              warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
+    """Reference ``WarmupLR``: warm up then hold at max."""
+    wmin, wmax, wsteps = float(warmup_min_lr), float(warmup_max_lr), max(1, warmup_num_steps)
+
+    def sched(step):
+        s = jnp.minimum(step.astype(jnp.float32), wsteps)
+        if warmup_type == "log":
+            frac = jnp.log1p(s) / math.log1p(wsteps)
+        else:
+            frac = s / wsteps
+        return wmin + (wmax - wmin) * frac
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Schedule:
+    """Reference ``WarmupDecayLR``: warmup then linear decay to 0."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    total = max(1, total_num_steps)
+    wsteps = max(1, warmup_num_steps)
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        decay = jnp.clip((total - s) / max(1, total - wsteps), 0.0, 1.0)
+        return jnp.where(s < wsteps, warm(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 1e-4,
+                     warmup_max_lr: float = 1e-3) -> Schedule:
+    total = max(1, total_num_steps)
+    wsteps = max(1, warmup_num_steps)
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        warm_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.minimum(s / wsteps, 1.0)
+        prog = jnp.clip((s - wsteps) / max(1, total - wsteps), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * prog))
+        return warmup_max_lr * jnp.where(s < wsteps, warm_frac, cos)
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float, cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int | None = None, decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0) -> Schedule:
+    """Reference ``OneCycle`` (triangular up/down then optional decay)."""
+    up = max(1, cycle_first_step_size)
+    down = max(1, cycle_second_step_size or cycle_first_step_size)
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        in_up = s < up
+        in_down = (s >= up) & (s < up + down)
+        frac_up = jnp.clip(s / up, 0.0, 1.0)
+        frac_down = jnp.clip((s - up) / down, 0.0, 1.0)
+        lr_up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * frac_up
+        lr_down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * frac_down
+        post = s - (up + down)
+        if decay_step_size > 0:
+            decay = jnp.maximum(0.0, 1.0 - decay_lr_rate * (post / decay_step_size))
+        else:
+            decay = 1.0
+        lr_post = cycle_min_lr * decay
+        return jnp.where(in_up, lr_up, jnp.where(in_down, lr_down, lr_post))
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Schedule:
+    """Reference ``LRRangeTest``: linearly/staircase increasing probe."""
+    base, size, rate = lr_range_test_min_lr, max(1, lr_range_test_step_size), lr_range_test_step_rate
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        interval = jnp.floor(s / size) if lr_range_test_staircase else s / size
+        return base * (1.0 + interval * rate)
+
+    return sched
+
+
+SCHEDULES = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+    "constant": lambda lr=1e-3, **_: constant(lr),
+}
+
+
+def build_schedule(sched_type: str | None, params: dict, fallback_lr: float) -> Schedule:
+    """ds_config ``scheduler`` → schedule; no scheduler → constant optimizer lr."""
+    if sched_type is None:
+        return constant(fallback_lr)
+    key = sched_type.lower().replace("_", "")
+    if key not in SCHEDULES:
+        raise ValueError(f"unknown scheduler '{sched_type}' (have {sorted(SCHEDULES)})")
+    p = dict(params)
+    if key in ("warmuplr", "warmupdecaylr", "warmupcosinelr") and "warmup_max_lr" not in p:
+        p["warmup_max_lr"] = fallback_lr
+    return SCHEDULES[key](**p)

@@ -1,0 +1,241 @@
+"""Native optimizers.
+
+Capability analog of the reference's fused/CPU optimizers
+(``csrc/adam/multi_tensor_adam.cu``, ``csrc/adam/cpu_adam_impl.cpp``,
+``csrc/lamb/fused_lamb_cuda_kernel.cu``, ``csrc/lion/*``,
+``csrc/adagrad/cpu_adagrad.cpp``, and the Python wrappers in
+``deepspeed/ops/adam|lamb|lion|adagrad``). On TPU the multi-tensor-apply
+machinery is unnecessary — the whole update is one XLA program fused across
+the parameter pytree — so each optimizer is a pure ``update`` rule over fp32
+master state. The update runs shard-wise on ZeRO-partitioned state; XLA emits
+zero collectives for it because every operand shares the master sharding.
+
+States are kept as explicit pytrees so ZeRO partitioning, offload, and
+universal checkpointing can address them per-leaf, mirroring how the
+reference checkpoints ``exp_avg``/``exp_avg_sq`` per partition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OptState(NamedTuple):
+    """Moment state; unused slots are empty pytrees to keep one step signature."""
+
+    mu: Any    # first moment / momentum / Adagrad accumulator
+    nu: Any    # second moment
+    count: jnp.ndarray  # int32 step counter (bias correction)
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """An optimizer = init + shard-wise update on fp32 master params."""
+
+    name: str
+    init: Callable[[Any], OptState]
+    update: Callable[[Any, OptState, Any, jnp.ndarray], tuple[Any, OptState]]
+    hyperparams: dict = dataclasses.field(default_factory=dict)
+
+
+def _zeros_like_tree(params):
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def _empty_tree(params):
+    return jax.tree.map(lambda p: jnp.zeros((0,), jnp.float32), params)
+
+
+# ------------------------------------------------------------------- Adam(W)
+def adam(lr_placeholder: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+         weight_decay: float = 0.0, adamw: bool = True, bias_correction: bool = True):
+    b1, b2 = betas
+
+    def init(params) -> OptState:
+        return OptState(mu=_zeros_like_tree(params), nu=_zeros_like_tree(params),
+                        count=jnp.zeros((), jnp.int32))
+
+    def update(params, state: OptState, grads, lr):
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - b1 ** c
+            bc2 = 1.0 - b2 ** c
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(p, m, v, g):
+            g = g.astype(jnp.float32)
+            if weight_decay and not adamw:  # classic Adam: L2 folded into grad
+                g = g + weight_decay * p
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * jnp.square(g)
+            step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay and adamw:  # AdamW: decoupled decay
+                step = step + weight_decay * p
+            return p - lr * step, m, v
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_g = treedef.flatten_up_to(grads)
+        out = [leaf(p, m, v, g) for p, m, v, g in zip(flat_p, flat_m, flat_v, flat_g)]
+        new_p = treedef.unflatten([o[0] for o in out])
+        new_m = treedef.unflatten([o[1] for o in out])
+        new_v = treedef.unflatten([o[2] for o in out])
+        return new_p, OptState(mu=new_m, nu=new_v, count=count)
+
+    return Optimizer("adamw" if adamw else "adam", init, update,
+                     dict(betas=betas, eps=eps, weight_decay=weight_decay))
+
+
+# --------------------------------------------------------------------- Lion
+def lion(betas=(0.9, 0.99), weight_decay: float = 0.0):
+    b1, b2 = betas
+
+    def init(params) -> OptState:
+        return OptState(mu=_zeros_like_tree(params), nu=_empty_tree(params),
+                        count=jnp.zeros((), jnp.int32))
+
+    def update(params, state: OptState, grads, lr):
+        def leaf(p, m, g):
+            g = g.astype(jnp.float32)
+            upd = jnp.sign(b1 * m + (1.0 - b1) * g)
+            if weight_decay:
+                upd = upd + weight_decay * p
+            new_m = b2 * m + (1.0 - b2) * g
+            return p - lr * upd, new_m
+
+        new = jax.tree.map(leaf, params, state.mu, grads)
+        new_p = jax.tree.map(lambda t: t[0], new, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda t: t[1], new, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, OptState(mu=new_m, nu=state.nu, count=state.count + 1)
+
+    return Optimizer("lion", init, update, dict(betas=betas, weight_decay=weight_decay))
+
+
+# --------------------------------------------------------------------- LAMB
+def lamb(betas=(0.9, 0.999), eps: float = 1e-6, weight_decay: float = 0.0,
+         min_trust: float = 0.01, max_trust: float = 10.0):
+    """LAMB with per-param trust ratio (reference ``fused_lamb_cuda_kernel.cu``).
+
+    Norms are global over each (possibly data-sharded) master param; XLA
+    reduces them across shards automatically.
+    """
+    b1, b2 = betas
+
+    def init(params) -> OptState:
+        return OptState(mu=_zeros_like_tree(params), nu=_zeros_like_tree(params),
+                        count=jnp.zeros((), jnp.int32))
+
+    def update(params, state: OptState, grads, lr):
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** c
+        bc2 = 1.0 - b2 ** c
+
+        def leaf(p, m, v, g):
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * jnp.square(g)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + weight_decay * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, min_trust, max_trust), 1.0)
+            return p - lr * trust * u, m, v
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_g = treedef.flatten_up_to(grads)
+        out = [leaf(p, m, v, g) for p, m, v, g in zip(flat_p, flat_m, flat_v, flat_g)]
+        return (treedef.unflatten([o[0] for o in out]),
+                OptState(mu=treedef.unflatten([o[1] for o in out]),
+                         nu=treedef.unflatten([o[2] for o in out]), count=count))
+
+    return Optimizer("lamb", init, update, dict(betas=betas, eps=eps,
+                                                weight_decay=weight_decay))
+
+
+# ------------------------------------------------------------------ Adagrad
+def adagrad(eps: float = 1e-10, weight_decay: float = 0.0):
+    def init(params) -> OptState:
+        return OptState(mu=_zeros_like_tree(params), nu=_empty_tree(params),
+                        count=jnp.zeros((), jnp.int32))
+
+    def update(params, state: OptState, grads, lr):
+        def leaf(p, acc, g):
+            g = g.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p
+            acc = acc + jnp.square(g)
+            return p - lr * g / (jnp.sqrt(acc) + eps), acc
+
+        new = jax.tree.map(leaf, params, state.mu, grads)
+        new_p = jax.tree.map(lambda t: t[0], new, is_leaf=lambda x: isinstance(x, tuple))
+        new_a = jax.tree.map(lambda t: t[1], new, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, OptState(mu=new_a, nu=state.nu, count=state.count + 1)
+
+    return Optimizer("adagrad", init, update, dict(eps=eps, weight_decay=weight_decay))
+
+
+# ---------------------------------------------------------------------- SGD
+def sgd(momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False):
+    def init(params) -> OptState:
+        mu = _zeros_like_tree(params) if momentum else _empty_tree(params)
+        return OptState(mu=mu, nu=_empty_tree(params), count=jnp.zeros((), jnp.int32))
+
+    def update(params, state: OptState, grads, lr):
+        def leaf(p, m, g):
+            g = g.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p
+            if momentum:
+                m = momentum * m + g
+                g = g + momentum * m if nesterov else m
+            return p - lr * g, m
+
+        new = jax.tree.map(leaf, params, state.mu, grads)
+        new_p = jax.tree.map(lambda t: t[0], new, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda t: t[1], new, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, OptState(mu=new_m, nu=state.nu, count=state.count + 1)
+
+    return Optimizer("sgd", init, update, dict(momentum=momentum))
+
+
+# ------------------------------------------------------------------ registry
+def build_optimizer(opt_type: str, params: dict) -> Optimizer:
+    """ds_config ``optimizer.type`` → optimizer (reference
+    ``engine._configure_basic_optimizer`` name dispatch, ``engine.py:1239``)."""
+    t = opt_type.lower().replace("_", "")
+    p = dict(params)
+    lr = p.pop("lr", None)  # lr flows through the scheduler, not the optimizer
+    betas = tuple(p.pop("betas", (0.9, 0.999)))
+    wd = p.pop("weight_decay", 0.0)
+    eps = p.pop("eps", 1e-8)
+    p.pop("torch_adam", None), p.pop("adam_w_mode", None), p.pop("freeze_step", None)
+    p.pop("cuda_aware", None), p.pop("comm_backend_name", None)
+    if t in ("adam",):
+        return adam(betas=betas, eps=eps, weight_decay=wd, adamw=False)
+    if t in ("adamw", "fusedadam", "cpuadam"):
+        return adam(betas=betas, eps=eps, weight_decay=wd, adamw=True)
+    if t in ("onebitadam", "zerooneadam"):
+        # Compressed-communication variant: the compression lives in the
+        # gradient-reduction path (config.gradient_compression), the update
+        # rule is Adam.
+        return adam(betas=betas, eps=eps, weight_decay=wd, adamw=True)
+    if t in ("lamb", "fusedlamb", "onebitlamb"):
+        return lamb(betas=(betas[0], betas[1]), eps=eps, weight_decay=wd)
+    if t in ("lion", "fusedlion", "cpulion"):
+        return lion(betas=(betas[0], betas[1]) if betas else (0.9, 0.99), weight_decay=wd)
+    if t in ("adagrad", "cpuadagrad"):
+        return adagrad(eps=p.pop("eps", 1e-10) if "eps" in p else 1e-10, weight_decay=wd)
+    if t == "sgd":
+        return sgd(momentum=p.pop("momentum", 0.0), weight_decay=wd,
+                   nesterov=p.pop("nesterov", False))
+    raise ValueError(f"unknown optimizer type '{opt_type}'")

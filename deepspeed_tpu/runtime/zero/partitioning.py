@@ -1,0 +1,151 @@
+"""ZeRO stages as sharding rules.
+
+The reference implements ZeRO as optimizer subclasses with per-param mutation,
+grad hooks, and a fetch coordinator (``runtime/zero/stage_1_and_2.py:96``,
+``stage3.py:72``, ``partitioned_param_coordinator.py:58``). Under XLA the same
+partitioning semantics are *compiled into the step* as sharding choices over
+the ``data`` mesh axis:
+
+- **stage 0**: params, grads, optimizer state replicated; gradients
+  all-reduced (XLA inserts the all-reduce because the batch is sharded).
+- **stage 1**: fp32 master params + optimizer state sharded over ``data``;
+  the optimizer update runs shard-wise, and the cast back to the compute
+  dtype all-gathers the updated params — exactly the reference's
+  "update partition, then allgather" step (``stage_1_and_2.py:1699``).
+- **stage 2**: additionally, gradients are constrained to the master sharding
+  *before* the update, so XLA lowers the grad reduction to reduce-scatter
+  instead of all-reduce (the IPG-bucket reduce-scatter of
+  ``stage_1_and_2.py:1270``), never materializing full replicated grads.
+- **stage 3**: compute params are sharded over ``data`` too; the per-layer
+  all-gather that ``PartitionedParameterCoordinator.fetch_sub_module`` does
+  eagerly is emitted by XLA inside the (scanned) forward/backward, overlapped
+  by the latency-hiding scheduler. Small params stay replicated below
+  ``param_persistence_threshold`` (same knob as the reference).
+
+TP/EP sharding composes: a param's model-defined :class:`PartitionSpec` (the
+``model``/``expert`` axes) is augmented with ``data`` on a free dimension.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...config import ZeroConfig
+
+
+def _spec_entries(spec: PartitionSpec | None, rank: int) -> list:
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (rank - len(entries))
+    return entries
+
+
+def _axis_factor(entry, mesh: Mesh) -> int:
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, (tuple, list)) else (entry,)
+    return int(np.prod([mesh.shape[a] for a in names]))
+
+
+def add_axis_to_spec(spec: Optional[PartitionSpec], shape: tuple[int, ...],
+                     mesh: Mesh, axis: str = "data",
+                     skip_dims: tuple[int, ...] = ()) -> PartitionSpec:
+    """Shard one more dimension of ``shape`` over ``axis``, composing with the
+    existing ``spec``. Picks the largest free (unsharded, divisible) dim;
+    falls back to stacking onto an already-sharded dim; returns ``spec``
+    unchanged (replicated w.r.t. ``axis``) if nothing divides.
+    """
+    size = mesh.shape[axis]
+    if size == 1:
+        return spec if spec is not None else PartitionSpec()
+    entries = _spec_entries(spec, len(shape))
+    if any(axis in (e if isinstance(e, (tuple, list)) else (e,))
+           for e in entries if e is not None):
+        return PartitionSpec(*entries)
+
+    # Prefer free dims, largest first (ties → later dims, which are usually
+    # the contraction/output dims that XLA gathers most efficiently).
+    candidates = sorted(
+        (d for d in range(len(shape)) if d not in skip_dims),
+        key=lambda d: (entries[d] is not None, -shape[d], -d),
+    )
+    for d in candidates:
+        existing = _axis_factor(entries[d], mesh)
+        if shape[d] % (existing * size) == 0:
+            if entries[d] is None:
+                entries[d] = axis
+            else:
+                prev = entries[d] if isinstance(entries[d], (tuple, list)) else (entries[d],)
+                entries[d] = tuple(prev) + (axis,)
+            return PartitionSpec(*entries)
+    return PartitionSpec(*entries)
+
+
+def param_size(shape: tuple[int, ...]) -> int:
+    return int(np.prod(shape)) if shape else 1
+
+
+class ZeroPartitioner:
+    """Computes compute/master sharding trees for a model's params."""
+
+    def __init__(self, zero_config: ZeroConfig, mesh: Mesh,
+                 scan_dims: int = 0):
+        self.cfg = zero_config
+        self.mesh = mesh
+        # Leading dims that a `lax.scan` over layers iterates; sharding those
+        # over `data` would turn balanced all-gathers into single-owner
+        # broadcasts, so they are excluded from partitioning.
+        self.scan_dims = scan_dims
+
+    # ------------------------------------------------------------- per-param
+    def compute_spec(self, model_spec: Optional[PartitionSpec],
+                     shape: tuple[int, ...], *, stacked: bool = False) -> PartitionSpec:
+        """Sharding of the (bf16) compute copy of a param."""
+        base = model_spec if model_spec is not None else PartitionSpec()
+        if self.cfg.stage < 3:
+            return base
+        if param_size(shape) < int(self.cfg.param_persistence_threshold):
+            return base
+        skip = tuple(range(1 if stacked else 0))
+        return add_axis_to_spec(base, shape, self.mesh, "data", skip_dims=skip)
+
+    def master_spec(self, model_spec: Optional[PartitionSpec],
+                    shape: tuple[int, ...], *, stacked: bool = False) -> PartitionSpec:
+        """Sharding of fp32 master params and optimizer moments."""
+        base = model_spec if model_spec is not None else PartitionSpec()
+        if self.cfg.stage < 1:
+            return base
+        skip = tuple(range(1 if stacked else 0))
+        return add_axis_to_spec(base, shape, self.mesh, "data", skip_dims=skip)
+
+    # ----------------------------------------------------------------- trees
+    def _tree_map_specs(self, fn, model_specs, shapes, stacked_fn):
+        return jax.tree.map(
+            lambda spec, shp: fn(spec, tuple(shp), stacked=stacked_fn(shp)),
+            model_specs, shapes,
+            is_leaf=lambda x: x is None or isinstance(x, PartitionSpec),
+        )
+
+    def compute_specs(self, model_specs, shapes, stacked_fn=lambda s: False):
+        return self._tree_map_specs(self.compute_spec, model_specs, shapes, stacked_fn)
+
+    def master_specs(self, model_specs, shapes, stacked_fn=lambda s: False):
+        return self._tree_map_specs(self.master_spec, model_specs, shapes, stacked_fn)
+
+    # ------------------------------------------------------------------ grads
+    def grad_spec_tree(self, master_specs):
+        """Stage >= 2: constrain grads to the master sharding so the reduction
+        lowers to reduce-scatter. Stage < 2: leave to XLA (all-reduce)."""
+        if self.cfg.stage >= 2:
+            return master_specs
+        return None
+
+
+def shardings_from_specs(mesh: Mesh, specs) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s if s is not None else PartitionSpec()),
+        specs, is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))

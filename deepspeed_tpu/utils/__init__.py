@@ -1,0 +1,5 @@
+from .logging import log_dist, logger, print_rank_0
+from .timer import ThroughputTimer, WallClockTimers, peak_flops_for
+
+__all__ = ["logger", "log_dist", "print_rank_0", "WallClockTimers",
+           "ThroughputTimer", "peak_flops_for"]

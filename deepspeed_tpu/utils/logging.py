@@ -1,0 +1,64 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (logger +
+``log_dist`` rank filtering). In a multi-host JAX job the "rank" is
+``jax.process_index()``; inside a single-process SPMD program all devices share
+one Python process, so rank filtering is per *host*, not per chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int | None = None) -> logging.Logger:
+    if level is None:
+        level = getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO)
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: list[int] | None = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process ranks (``[-1]`` or None = all).
+
+    Mirrors the behavior of the reference ``log_dist`` but keyed on
+    ``jax.process_index()``.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        logger.info(message)
+
+
+def warning_once(message: str, _seen: set = set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,30 @@
+"""Test harness configuration.
+
+Analog of the reference's DistributedTest machinery (``tests/unit/common.py``):
+where the reference spawns N OS processes with real NCCL over loopback, the
+JAX-native trick is a *virtual 8-device CPU mesh* in one process
+(``--xla_force_host_platform_device_count``) — every collective, sharding, and
+partitioning path compiles and executes exactly as it would across 8 chips.
+Must be set before JAX initializes, hence here at collection time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DSTPU_LOG_LEVEL", "WARNING")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platform_name", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

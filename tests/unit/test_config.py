@@ -1,0 +1,60 @@
+"""Config tree tests (analog of reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.config import Config
+
+
+def test_defaults():
+    c = Config()
+    assert c.zero_optimization.stage == 0
+    assert c.bf16.enabled and not c.fp16.enabled
+
+
+def test_batch_resolution_all_given():
+    c = Config(train_batch_size=32, train_micro_batch_size_per_gpu=2,
+               gradient_accumulation_steps=2).resolve_batch_sizes(dp_world_size=8)
+    assert c.train_batch_size == 32
+
+
+def test_batch_resolution_solve_gas():
+    c = Config(train_batch_size=32, train_micro_batch_size_per_gpu=2)
+    c = c.resolve_batch_sizes(dp_world_size=4)
+    assert c.gradient_accumulation_steps == 4
+
+
+def test_batch_resolution_solve_micro():
+    c = Config(train_batch_size=64, gradient_accumulation_steps=2)
+    c = c.resolve_batch_sizes(dp_world_size=4)
+    assert c.train_micro_batch_size_per_gpu == 8
+
+
+def test_batch_resolution_inconsistent():
+    c = Config(train_batch_size=30, train_micro_batch_size_per_gpu=2,
+               gradient_accumulation_steps=2)
+    with pytest.raises(ValueError):
+        c.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_sci_notation_ints():
+    c = Config(zero_optimization={"stage": 2, "reduce_bucket_size": "5e8"})
+    assert c.zero_optimization.reduce_bucket_size == 500_000_000
+
+
+def test_from_json_dict():
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}},
+    }
+    c = Config.from_any(cfg)
+    assert c.zero_optimization.stage == 3
+    assert c.zero_optimization.offload_optimizer.device == "cpu"
+    assert c.optimizer.params["lr"] == 3e-4
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(Exception):
+        Config.from_any({"not_a_real_key": 1})

@@ -1,0 +1,120 @@
+"""End-to-end engine tests on the virtual 8-device mesh.
+
+Correctness oracles follow the reference test strategy (SURVEY.md §4):
+loss decreases, and ZeRO stages are loss-equivalent to the unsharded
+baseline (the analog of ZeRO-vs-vanilla-Adam equivalence in
+tests/unit/runtime/zero/test_zero.py).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def make_config(stage=0, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 100,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 5}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": stage, "param_persistence_threshold": 0},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(engine, n_steps=8, seed=0):
+    data = random_token_dataset(256, seq_len=32, vocab_size=256, seed=seed,
+                                learnable=True)
+    loader = DataLoader(data, local_batch_size=engine.train_batch_size,
+                        shuffle=True, seed=seed)
+    losses = []
+    for i, batch in enumerate(loader):
+        if i >= n_steps:
+            break
+        m = engine.train_batch(batch)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_trains(devices, stage):
+    model = build_model(tiny_test())
+    engine = ds.initialize(make_config(stage=stage), model)
+    losses = run_steps(engine, n_steps=8)
+    assert losses[-1] < losses[0], f"stage {stage}: loss did not decrease: {losses}"
+
+
+def test_zero_stages_loss_equivalent(devices):
+    """All ZeRO stages compute the same optimization trajectory."""
+    ref_losses = None
+    for stage in [0, 1, 2, 3]:
+        model = build_model(tiny_test())
+        engine = ds.initialize(make_config(stage=stage), model)
+        losses = run_steps(engine, n_steps=4)
+        if ref_losses is None:
+            ref_losses = losses
+        else:
+            np.testing.assert_allclose(losses, ref_losses, rtol=2e-2,
+                                       err_msg=f"stage {stage} diverged from stage 0")
+
+
+def test_gas_matches_large_batch(devices):
+    """GAS x micro == one big batch (same global batch, same trajectory)."""
+    model = build_model(tiny_test())
+    e1 = ds.initialize(make_config(stage=1, train_batch_size=32,
+                                   gradient_accumulation_steps=4,
+                                   train_micro_batch_size_per_gpu="auto"), model)
+    e2 = ds.initialize(make_config(stage=1, train_batch_size=32,
+                                   gradient_accumulation_steps=1,
+                                   train_micro_batch_size_per_gpu="auto"), model)
+    l1 = run_steps(e1, n_steps=3)
+    l2 = run_steps(e2, n_steps=3)
+    np.testing.assert_allclose(l1, l2, rtol=2e-2)
+
+
+def test_tensor_parallel_trains(devices):
+    model = build_model(tiny_test())
+    cfg = make_config(stage=1, train_micro_batch_size_per_gpu="auto")
+    cfg["mesh"] = {"data": 2, "model": 4}
+    engine = ds.initialize(cfg, model)
+    assert engine.dp_world == 2
+    losses = run_steps(engine, n_steps=6)
+    assert losses[-1] < losses[0]
+
+
+def test_ulysses_sequence_parallel_trains(devices):
+    """seq axis shards the sequence dim; attention reshards via all-to-all
+    (the GSPMD realization of reference sequence/layer.py)."""
+    model = build_model(tiny_test())
+    cfg = make_config(stage=1, train_micro_batch_size_per_gpu="auto")
+    cfg["mesh"] = {"data": 2, "seq": 4}
+    engine = ds.initialize(cfg, model)
+    losses = run_steps(engine, n_steps=6)
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_dynamic_loss_scale(devices):
+    model = build_model(tiny_test())
+    cfg = make_config(stage=2)
+    cfg["bf16"] = {"enabled": False}
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    engine = ds.initialize(cfg, model)
+    losses = run_steps(engine, n_steps=6)
+    assert losses[-1] < losses[0]
+    assert float(engine.state.loss_scale.scale) > 0
+
+
+def test_eval_batch(devices):
+    model = build_model(tiny_test())
+    engine = ds.initialize(make_config(stage=1), model)
+    data = random_token_dataset(16, 32, 256)
+    batch = DataLoader(data, local_batch_size=16, shuffle=False).collate_fn(data)
+    loss = engine.eval_batch(batch)
+    assert np.isfinite(loss) and loss > 0

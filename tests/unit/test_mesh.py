@@ -1,0 +1,70 @@
+"""Mesh construction and ZeRO partitioning-rule tests."""
+
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.config import ZeroConfig
+from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh, dp_world_size
+from deepspeed_tpu.runtime.zero.partitioning import ZeroPartitioner, add_axis_to_spec
+
+
+def test_mesh_auto_data(devices):
+    mesh = build_mesh(MeshSpec())
+    assert mesh.shape["data"] == 8
+
+def test_mesh_2d(devices):
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    assert mesh.shape["data"] == 2 and mesh.shape["model"] == 4
+    assert dp_world_size(mesh) == 2
+
+
+def test_mesh_overcommit_raises(devices):
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(data=16, model=4))
+
+
+def test_add_axis_prefers_largest_free_dim(devices):
+    mesh = build_mesh(MeshSpec(data=8))
+    spec = add_axis_to_spec(P(None, None), (128, 512), mesh, "data")
+    assert spec == P(None, "data")
+
+
+def test_add_axis_composes_with_model(devices):
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    spec = add_axis_to_spec(P(None, "model"), (256, 512), mesh, "data")
+    assert spec == P("data", "model")
+
+
+def test_add_axis_indivisible_replicates(devices):
+    mesh = build_mesh(MeshSpec(data=8))
+    spec = add_axis_to_spec(P(), (3, 5), mesh, "data")
+    assert spec == P(None, None)
+
+
+def test_partitioner_stages(devices):
+    mesh = build_mesh(MeshSpec(data=8))
+    shape = (1024, 1024)
+    for stage, master_sharded, compute_sharded in [
+            (0, False, False), (1, True, False), (2, True, False), (3, True, True)]:
+        part = ZeroPartitioner(ZeroConfig(stage=stage), mesh)
+        ms = part.master_spec(None, shape)
+        cs = part.compute_spec(None, shape)
+        assert ("data" in str(ms)) == master_sharded, (stage, ms)
+        assert ("data" in str(cs)) == compute_sharded, (stage, cs)
+
+
+def test_stage3_persistence_threshold(devices):
+    mesh = build_mesh(MeshSpec(data=8))
+    part = ZeroPartitioner(ZeroConfig(stage=3, param_persistence_threshold=10000), mesh)
+    small = part.compute_spec(None, (32, 32))   # 1024 < threshold -> replicated
+    big = part.compute_spec(None, (512, 512))
+    assert "data" not in str(small)
+    assert "data" in str(big)
+
+
+def test_stage3_scan_dim_excluded(devices):
+    mesh = build_mesh(MeshSpec(data=8))
+    part = ZeroPartitioner(ZeroConfig(stage=3), mesh)
+    spec = part.compute_spec(None, (8, 64, 256), stacked=True)
+    assert spec[0] is None  # layer-stack dim untouched
+    assert "data" in str(spec)
