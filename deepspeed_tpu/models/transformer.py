@@ -58,6 +58,7 @@ class TransformerConfig:
     num_experts: int = 1
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -76,16 +77,29 @@ class TransformerConfig:
         return self.activation.endswith("glu")
 
     def flops_per_token(self) -> float:
-        """6*N matmul FLOPs per token + attention term (for MFU accounting)."""
-        n_params = self.param_count(non_embedding=True)
+        """6*N_active matmul FLOPs per token + attention term (MFU accounting).
+
+        For MoE only the ``moe_top_k`` routed experts do work per token, so
+        FLOPs use the *active* parameter count, not the total bank size."""
+        n_params = self.param_count(non_embedding=True, active_only=True)
         attn = 12 * self.n_layer * self.d_model * self.max_seq
         return 6 * n_params + attn
 
-    def param_count(self, non_embedding: bool = False) -> int:
-        d, f, L = self.d_model, self.ffn_dim, self.n_layer
+    def _ffn_params_per_layer(self, active_only: bool = False) -> int:
+        d, f, E = self.d_model, self.ffn_dim, self.num_experts
+        per_expert = d * f * (3 if self.is_glu else 2)
+        if E == 1:
+            return per_expert
+        router = d * E
+        mult = min(self.moe_top_k, E) if active_only else E
+        return router + mult * per_expert
+
+    def param_count(self, non_embedding: bool = False,
+                    active_only: bool = False) -> int:
+        d, L = self.d_model, self.n_layer
         h, kv, hd = self.n_head, self.kv_heads, self.head_dim
         per_layer = d * (h * hd) + 2 * d * (kv * hd) + (h * hd) * d
-        per_layer += d * f * (3 if self.is_glu else 2)
+        per_layer += self._ffn_params_per_layer(active_only=active_only)
         emb = self.vocab_size * d
         total = L * per_layer + (emb if not non_embedding else 0)
         if not self.tie_embeddings and not non_embedding:
@@ -164,6 +178,7 @@ class TransformerLM:
             scale = scale or (1.0 / math.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
             return (jax.random.normal(key, shape, jnp.float32) * scale)
 
+        dense_ffn = cfg.num_experts == 1  # MoE trunks build expert banks instead
         layers = {
             "ln1_scale": jnp.ones((L, d), jnp.float32),
             "wq": dense(next(k), (L, d, h * hd)),
@@ -171,11 +186,12 @@ class TransformerLM:
             "wv": dense(next(k), (L, d, kv * hd)),
             "wo": dense(next(k), (L, h * hd, d), scale=1.0 / math.sqrt(2 * L * d)),
             "ln2_scale": jnp.ones((L, d), jnp.float32),
-            "w_in": dense(next(k), (L, d, f)),
-            "w_out": dense(next(k), (L, f, d), scale=1.0 / math.sqrt(2 * L * f)),
         }
-        if cfg.is_glu:
-            layers["w_gate"] = dense(next(k), (L, d, f))
+        if dense_ffn:
+            layers["w_in"] = dense(next(k), (L, d, f))
+            layers["w_out"] = dense(next(k), (L, f, d), scale=1.0 / math.sqrt(2 * L * f))
+            if cfg.is_glu:
+                layers["w_gate"] = dense(next(k), (L, d, f))
         if cfg.use_bias:
             layers.update({
                 "ln1_bias": jnp.zeros((L, d), jnp.float32),
@@ -184,9 +200,10 @@ class TransformerLM:
                 "bk": jnp.zeros((L, kv * hd), jnp.float32),
                 "bv": jnp.zeros((L, kv * hd), jnp.float32),
                 "bo": jnp.zeros((L, d), jnp.float32),
-                "b_in": jnp.zeros((L, f), jnp.float32),
-                "b_out": jnp.zeros((L, d), jnp.float32),
             })
+            if dense_ffn:
+                layers["b_in"] = jnp.zeros((L, f), jnp.float32)
+                layers["b_out"] = jnp.zeros((L, d), jnp.float32)
         params = {
             "tok_embed": jax.random.normal(next(k), (cfg.vocab_size, d), jnp.float32) * 0.02,
             "layers": layers,
@@ -206,6 +223,7 @@ class TransformerLM:
         """TP (Megatron-style) sharding over the ``model`` axis:
         qkv/w_in column-split, wo/w_out row-split, embeddings vocab-split."""
         cfg = self.cfg
+        dense_ffn = cfg.num_experts == 1
         layers = {
             "ln1_scale": P(None, None),
             "wq": P(None, None, "model"),
@@ -213,17 +231,21 @@ class TransformerLM:
             "wv": P(None, None, "model"),
             "wo": P(None, "model", None),
             "ln2_scale": P(None, None),
-            "w_in": P(None, None, "model"),
-            "w_out": P(None, "model", None),
         }
-        if cfg.is_glu:
-            layers["w_gate"] = P(None, None, "model")
+        if dense_ffn:
+            layers["w_in"] = P(None, None, "model")
+            layers["w_out"] = P(None, "model", None)
+            if cfg.is_glu:
+                layers["w_gate"] = P(None, None, "model")
         if cfg.use_bias:
             layers.update({
                 "ln1_bias": P(None, None), "ln2_bias": P(None, None),
                 "bq": P(None, "model"), "bk": P(None, "model"), "bv": P(None, "model"),
-                "bo": P(None, None), "b_in": P(None, "model"), "b_out": P(None, None),
+                "bo": P(None, None),
             })
+            if dense_ffn:
+                layers["b_in"] = P(None, "model")
+                layers["b_out"] = P(None, None)
         specs = {
             "tok_embed": P("model", None),
             "layers": layers,
@@ -247,20 +269,18 @@ class TransformerLM:
         return is_stacked
 
     # ---------------------------------------------------------------- apply
-    def _layer(self, x, layer_params, positions, attn_mask):
+    def _maybe_bias(self, y, p, name):
+        return y + p[name].astype(y.dtype) if self.cfg.use_bias and name in p else y
+
+    def _attention_block(self, x, p, positions, attn_mask):
+        """Shared attention half of a layer (dense and MoE trunks)."""
         cfg = self.cfg
-        p = layer_params
         B, S, d = x.shape
         h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
-
-        def maybe_bias(y, name):
-            return y + p[name].astype(y.dtype) if cfg.use_bias and name in p else y
-
-        # ---- attention
         y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm)
-        q = maybe_bias(y @ p["wq"].astype(y.dtype), "bq").reshape(B, S, h, hd)
-        kk = maybe_bias(y @ p["wk"].astype(y.dtype), "bk").reshape(B, S, kv, hd)
-        vv = maybe_bias(y @ p["wv"].astype(y.dtype), "bv").reshape(B, S, kv, hd)
+        q = self._maybe_bias(y @ p["wq"].astype(y.dtype), p, "bq").reshape(B, S, h, hd)
+        kk = self._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, S, kv, hd)
+        vv = self._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, S, kv, hd)
         if cfg.pos_embedding == "rope":
             q, kk = _rope(q, kk, positions, cfg.rope_theta)
         # Ulysses: trade the sequence shard for a head shard around attention
@@ -272,11 +292,13 @@ class TransformerLM:
             if kv < h else constrain(vv, P(B_AXES, None, ("model", "seq"), None))
         o = self.attention_fn(qs, ks, vs, mask=attn_mask)
         o = constrain(o, P(B_AXES, "seq", "model", None))
-        o = maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), "bo")
-        x = x + o
-        # ---- mlp
-        y = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm)
-        u = maybe_bias(y @ p["w_in"].astype(y.dtype), "b_in")
+        o = self._maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), p, "bo")
+        return x + o
+
+    def _mlp_block(self, y, p):
+        """FFN half. Returns (out, aux_loss); MoE trunks override this."""
+        cfg = self.cfg
+        u = self._maybe_bias(y @ p["w_in"].astype(y.dtype), p, "b_in")
         if cfg.is_glu:
             u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
         elif cfg.activation == "gelu":
@@ -284,10 +306,20 @@ class TransformerLM:
         else:
             u = jax.nn.silu(u)
         u = constrain(u, P(B_AXES, "seq", "model"))
-        x = x + maybe_bias(u @ p["w_out"].astype(y.dtype), "b_out")
-        return constrain(x, P(B_AXES, "seq", None))
+        out = self._maybe_bias(u @ p["w_out"].astype(y.dtype), p, "b_out")
+        return out, jnp.float32(0.0)
 
-    def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None):
+    def _layer(self, x, layer_params, positions, attn_mask):
+        cfg = self.cfg
+        p = layer_params
+        x = self._attention_block(x, p, positions, attn_mask)
+        y = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm)
+        out, aux = self._mlp_block(y, p)
+        x = x + out
+        return constrain(x, P(B_AXES, "seq", None)), aux
+
+    def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None,
+              return_aux: bool = False):
         """Forward: (B, S) int32 → (B, S, V) logits (compute dtype)."""
         cfg = self.cfg
         B, S = input_ids.shape
@@ -302,22 +334,27 @@ class TransformerLM:
             body = jax.checkpoint(body, policy=remat_policy, prevent_cse=False)
 
         def scan_fn(carry, layer_params):
-            return body(carry, layer_params), None
+            new_x, aux = body(carry, layer_params)
+            return new_x, aux
 
-        x, _ = lax.scan(scan_fn, x, params["layers"])
+        x, aux_losses = lax.scan(scan_fn, x, params["layers"])
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm)
         if cfg.tie_embeddings:
             logits = x @ params["tok_embed"].astype(x.dtype).T
         else:
             logits = x @ params["lm_head"].astype(x.dtype)
-        return constrain(logits, P(B_AXES, "seq", "model"))
+        logits = constrain(logits, P(B_AXES, "seq", "model"))
+        if return_aux:
+            return logits, jnp.sum(aux_losses)
+        return logits
 
     # ----------------------------------------------------------------- loss
     def loss(self, params, batch, *, remat_policy=None):
-        """Next-token cross-entropy, fp32, mean over non-pad target tokens."""
+        """Next-token cross-entropy, fp32, mean over non-pad target tokens,
+        plus the MoE load-balancing aux loss when the trunk routes."""
         ids = batch["input_ids"]
-        logits = self.apply(params, ids, attn_mask=batch.get("attention_mask"),
-                            remat_policy=remat_policy)
+        logits, aux = self.apply(params, ids, attn_mask=batch.get("attention_mask"),
+                                 remat_policy=remat_policy, return_aux=True)
         targets = ids[:, 1:]
         logits = logits[:, :-1].astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -325,5 +362,9 @@ class TransformerLM:
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, 1:].astype(jnp.float32)
-            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return jnp.mean(nll)
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            ce = jnp.mean(nll)
+        if self.cfg.num_experts > 1:
+            ce = ce + self.cfg.moe_aux_loss_weight * aux
+        return ce

@@ -195,7 +195,15 @@ class Engine:
 
     # ------------------------------------------------------------- train step
     def _cast_compute(self, master):
-        cp = jax.tree.map(lambda p: p.astype(self.compute_dtype), master)
+        """bf16/fp16 compute cast; leaves named in the model's
+        ``fp32_param_names()`` (e.g. MoE routers) stay fp32."""
+        keep = set(getattr(self.model, "fp32_param_names", lambda: ())())
+
+        def cast(path, p):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            return p if name in keep else p.astype(self.compute_dtype)
+
+        cp = jax.tree_util.tree_map_with_path(cast, master)
         return jax.lax.with_sharding_constraint(cp, self.compute_specs)
 
     def _train_step_impl(self, state: TrainState, batch: dict):
