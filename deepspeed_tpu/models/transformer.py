@@ -283,15 +283,20 @@ class TransformerLM:
         vv = self._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, S, kv, hd)
         if cfg.pos_embedding == "rope":
             q, kk = _rope(q, kk, positions, cfg.rope_theta)
-        # Ulysses: trade the sequence shard for a head shard around attention
-        # (reference sequence/layer.py all_to_all pair).
-        qs = constrain(q, P(B_AXES, None, ("model", "seq"), None))
-        ks = constrain(kk, P(B_AXES, None, None, None)) \
-            if kv < h else constrain(kk, P(B_AXES, None, ("model", "seq"), None))
-        vs = constrain(vv, P(B_AXES, None, None, None)) \
-            if kv < h else constrain(vv, P(B_AXES, None, ("model", "seq"), None))
-        o = self.attention_fn(qs, ks, vs, mask=attn_mask)
-        o = constrain(o, P(B_AXES, "seq", "model", None))
+        if getattr(self.attention_fn, "handles_sharding", False):
+            # Explicit-collective attention (sequence/layer.py Ulysses or
+            # ring): the wrapper does its own shard_map resharding.
+            o = self.attention_fn(q, kk, vv, mask=attn_mask)
+        else:
+            # Ulysses via GSPMD: trade the sequence shard for a head shard
+            # around attention (reference sequence/layer.py all_to_all pair).
+            qs = constrain(q, P(B_AXES, None, ("model", "seq"), None))
+            ks = constrain(kk, P(B_AXES, None, None, None)) \
+                if kv < h else constrain(kk, P(B_AXES, None, ("model", "seq"), None))
+            vs = constrain(vv, P(B_AXES, None, None, None)) \
+                if kv < h else constrain(vv, P(B_AXES, None, ("model", "seq"), None))
+            o = self.attention_fn(qs, ks, vs, mask=attn_mask)
+            o = constrain(o, P(B_AXES, "seq", "model", None))
         o = self._maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), p, "bo")
         return x + o
 

@@ -1,0 +1,14 @@
+"""Sequence / context parallelism (long-context training).
+
+Reference analog: DeepSpeed-Ulysses ``deepspeed/sequence/layer.py:15-85``
+(all-to-all DistributedAttention) — plus ring attention (context
+parallelism over ICI neighbors via ``ppermute``), which the reference
+version lacks entirely (SURVEY §5 long-context: "Ring/blockwise attention:
+absent") and is the TPU-idiomatic long-context strategy.
+"""
+
+from .layer import (make_ring_attention, make_ulysses_attention,
+                    ring_attention_local, ulysses_attention_local)
+
+__all__ = ["make_ulysses_attention", "make_ring_attention",
+           "ulysses_attention_local", "ring_attention_local"]

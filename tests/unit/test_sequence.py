@@ -1,0 +1,99 @@
+"""Sequence/context parallelism: Ulysses + ring attention.
+
+Oracle: exact-math agreement with the single-device causal attention
+(reference test strategy — allclose equivalence against the unsharded op).
+The reference has NO Ulysses unit test (SURVEY §4 notes the gap); this adds
+the coverage the reference was missing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deepspeed_tpu.models.transformer import causal_attention
+from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+from deepspeed_tpu.sequence import make_ring_attention, make_ulysses_attention
+
+
+def _qkv(B=2, S=32, H=4, KV=None, hd=16, seed=0):
+    rng = np.random.default_rng(seed)
+    KV = KV or H
+    q = jnp.asarray(rng.standard_normal((B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    return q, k, v
+
+
+@pytest.fixture()
+def seq_mesh(devices):
+    return build_mesh(MeshSpec(data=2, seq=4))
+
+
+@pytest.mark.parametrize("maker", [make_ring_attention, make_ulysses_attention])
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_matches_plain_attention(seq_mesh, maker, kv_heads):
+    q, k, v = _qkv(KV=kv_heads)
+    want = causal_attention(q, k, v)
+    attn = maker(seq_mesh)
+    with seq_mesh:
+        got = jax.jit(lambda a, b, c: attn(a, b, c))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("maker", [make_ring_attention, make_ulysses_attention])
+def test_with_padding_mask(seq_mesh, maker):
+    q, k, v = _qkv()
+    mask = jnp.asarray(np.random.default_rng(1).integers(0, 2, (2, 32)),
+                       jnp.int32).at[:, :8].set(1)  # keep early keys valid
+    want = causal_attention(q, k, v, mask=mask)
+    attn = maker(seq_mesh)
+    with seq_mesh:
+        got = jax.jit(lambda a, b, c, m: attn(a, b, c, mask=m))(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("maker", [make_ring_attention, make_ulysses_attention])
+def test_grads_match(seq_mesh, maker):
+    """Backward pass through the collective attention must match too (the
+    reference's all-to-all pair is autograd-transparent; shard_map is)."""
+    q, k, v = _qkv(S=16)
+
+    def loss(f):
+        def inner(qq, kk, vv):
+            return jnp.sum(jnp.square(f(qq, kk, vv)))
+        return inner
+
+    want = jax.grad(loss(causal_attention), argnums=(0, 1, 2))(q, k, v)
+    attn = maker(seq_mesh)
+    with seq_mesh:
+        got = jax.jit(jax.grad(loss(attn), argnums=(0, 1, 2)))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_train_step_with_ring_attention(seq_mesh):
+    """End-to-end: a TransformerLM trained with ring attention on a
+    data x seq mesh takes a finite step."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+    attn = make_ring_attention(seq_mesh)
+    model = build_model(tiny_test(max_seq=32), attention_fn=attn)
+    cfg = {
+        "train_batch_size": 2,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": 2, "seq": 4},
+    }
+    engine = ds.initialize(cfg, model)
+    data = random_token_dataset(4, seq_len=32, vocab_size=256)
+    batch = DataLoader(data, local_batch_size=2, shuffle=False).collate_fn(data[:2])
+    metrics = engine.train_batch(batch)
+    assert np.isfinite(float(metrics["loss"]))
