@@ -1,0 +1,264 @@
+"""Pallas flash attention (fused causal attention, fwd + bwd kernels).
+
+TPU-native answer to the reference's fused transformer kernels
+(``csrc/transformer/*.cu`` and the inference softmax/attention kernels,
+~13 kLoC of CUDA — SURVEY §2.3 #8/#9): on TPU the elementwise zoo evaporates
+into XLA fusion and the one kernel worth hand-writing is blockwise attention.
+
+Design (standard flash attention 2, MXU-shaped):
+- forward: grid (B, H, S/blk); per q-block online-softmax stream over k/v
+  blocks (``fori_loop`` with a traced causal upper bound), accumulators in
+  fp32 carries, saves per-row logsumexp for the backward.
+- backward: two kernels — dq (grid over q blocks, streams k/v) and dk/dv
+  (grid over k blocks, streams q/dO), both recomputing probabilities from
+  the saved logsumexp; ``delta = rowsum(dO * O)`` precomputed outside.
+- GQA: kv heads are repeated to H with ``jnp.repeat`` *outside* the
+  custom_vjp, so the head-group sum in dk/dv falls out of autodiff.
+- dtype: matmuls run on the MXU with fp32 accumulation
+  (``preferred_element_type``); softmax math in fp32.
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests), and
+inputs that the kernel doesn't cover (padding masks, non-divisible shapes)
+fall back to the plain XLA attention.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BIG_NEG = -2.0 ** 30
+SUBLANES = 8  # fp32 sublane tile: lse/delta rows replicated to (8, S)
+
+
+# ---------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block: int,
+                scale: float, causal: bool):
+    iq = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale          # (blk, hd)
+    nkb = k_ref.shape[0] // block
+    q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+
+    def body(jk, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            kpos = jk * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            keep = q_pos >= kpos
+            s = jnp.where(keep, s, BIG_NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p.astype(v.dtype), v,
+                                   preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((block, 1), BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((block, 1), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    ub = iq + 1 if causal else nkb
+    m, l, acc = jax.lax.fori_loop(0, ub, body, (m0, l0, acc0))
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # (8, blk): replicated across sublanes to satisfy TPU (8, 128) tiling
+    lse_ref[...] = jnp.broadcast_to((m[:, 0] + jnp.log(l[:, 0]))[None, :],
+                                    (SUBLANES, block))
+
+
+def _fwd_call(q, k, v, *, block: int, causal: bool, interpret: bool):
+    B, H, S, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    grid = (B, H, S // block)
+    kernel = partial(_fwd_kernel, block=block, scale=scale, causal=causal)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, SUBLANES, block),
+                         lambda b, h, i: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, SUBLANES, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------- backward
+def _make_bwd_dq_kernel(block: int, scale: float, causal: bool):
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        iq = pl.program_id(2)
+        q = q_ref[...].astype(jnp.float32) * scale
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        nkb = k_ref.shape[0] // block
+        q_pos = iq * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0)
+
+        def body(jk, dq):
+            k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+            v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+            p = jnp.exp(s - lse[:, None])
+            if causal:
+                kpos = jk * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                p = jnp.where(q_pos >= kpos, p, 0.0)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None])
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        ub = iq + 1 if causal else nkb
+        dq = jax.lax.fori_loop(0, ub, body, jnp.zeros(q.shape, jnp.float32))
+        dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool):
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dk_ref, dv_ref):
+        jk = pl.program_id(2)
+        k = k_ref[...].astype(jnp.float32)               # (blk, hd)
+        v = v_ref[...].astype(jnp.float32)
+        nqb = q_ref.shape[0] // block
+        k_pos = jk * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1)
+
+        def body(iq, carry):
+            dk, dv = carry
+            q = q_ref[pl.ds(iq * block, block), :].astype(jnp.float32) * scale
+            do = do_ref[pl.ds(iq * block, block), :].astype(jnp.float32)
+            lse = lse_ref[0, pl.ds(iq * block, block)]
+            delta = delta_ref[0, pl.ds(iq * block, block)]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+            p = jnp.exp(s - lse[:, None])
+            if causal:
+                q_pos = iq * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                p = jnp.where(q_pos >= k_pos, p, 0.0)
+            dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None])
+            dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+            return dk, dv
+
+        lb = jk if causal else 0
+        z = jnp.zeros(k.shape, jnp.float32)
+        dk, dv = jax.lax.fori_loop(lb, nqb, body, (z, z))
+        dk_ref[...] = dk.astype(dk_ref.dtype)
+        dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _bwd_call(q, k, v, o, lse, do, *, block: int, causal: bool,
+              interpret: bool):
+    B, H, S, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None, :], (B, H, SUBLANES, S))
+    grid = (B, H, S // block)
+    blk_spec = pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0))
+    full_spec = pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0))
+    row_blk = pl.BlockSpec((None, None, SUBLANES, block),
+                           lambda b, h, i: (b, h, 0, i))
+    row_full = pl.BlockSpec((None, None, SUBLANES, S),
+                            lambda b, h, i: (b, h, 0, 0))
+
+    dq = pl.pallas_call(
+        _make_bwd_dq_kernel(block, scale, causal),
+        grid=grid,
+        in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk, row_blk],
+        out_specs=[blk_spec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    dk, dv = pl.pallas_call(
+        _make_bwd_dkv_kernel(block, scale, causal),
+        grid=grid,
+        in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full, row_full],
+        out_specs=[blk_spec, blk_spec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- custom VJP
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash(block, causal, interpret, q, k, v):
+    o, _ = _fwd_call(q, k, v, block=block, causal=causal, interpret=interpret)
+    return o
+
+
+def _flash_fwd(block, causal, interpret, q, k, v):
+    o, lse = _fwd_call(q, k, v, block=block, causal=causal, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(block, causal, interpret, res, g):
+    q, k, v, o, lse = res
+    return _bwd_call(q, k, v, o, lse, g, block=block, causal=causal,
+                     interpret=interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------------------- public API
+def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
+                    causal: bool = True, block: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused causal attention. q: (B, S, H, hd); k/v: (B, S, KV, hd).
+
+    Falls back to the plain XLA attention for padding masks or shapes the
+    kernel doesn't tile (S not divisible by the block size).
+    """
+    B, S, H, hd = q.shape
+    blk = min(block, S)
+    if mask is not None or S % blk != 0:
+        from ..models.transformer import causal_attention
+
+        return causal_attention(q, k, v, mask=mask)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    KV = k.shape[2]
+    if KV != H:  # GQA: differentiable repeat — dk/dv group-sum via autodiff
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    # (B, S, H, hd) -> (B, H, S, hd)
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    o = _flash(blk, causal, interpret, qt, kt, vt)
+    return o.swapaxes(1, 2)
+
+
+def make_flash_attention(block: int = 128, interpret: Optional[bool] = None):
+    """attention_fn factory for :class:`TransformerLM`."""
+
+    def attn(q, k, v, *, mask=None):
+        return flash_attention(q, k, v, mask=mask, block=block,
+                               interpret=interpret)
+
+    return attn

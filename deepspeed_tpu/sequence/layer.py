@@ -51,8 +51,17 @@ def _repeat_kv(k, v, n_heads: int):
 
 
 def _shard_mapped(mesh: Mesh, axis: str, body: Callable, q, k, v, mask):
-    """Run ``body(q, k, v, mask)`` under shard_map with seq-dim sharding."""
-    qspec = P(BATCH_AXES, axis, None, None)
+    """Run ``body(q, k, v, mask)`` under shard_map with seq-dim sharding.
+
+    The head dim shards over ``model`` (both bodies are per-head, so TP
+    composes: each model-axis shard handles H/tp heads, no cross-model
+    collectives), provided both q and kv head counts divide tp — the makers
+    pre-repeat GQA kv to guarantee this when tp > 1.
+    """
+    tp = int(mesh.shape.get("model", 1))
+    hshard = "model" if (tp > 1 and q.shape[2] % tp == 0
+                         and k.shape[2] % tp == 0) else None
+    qspec = P(BATCH_AXES, axis, hshard, None)
     if mask is None:
         f = shard_map(lambda q_, k_, v_: body(q_, k_, v_, None),
                       mesh=mesh, in_specs=(qspec, qspec, qspec),
@@ -127,6 +136,9 @@ def make_ring_attention(mesh: Mesh, axis: str = SEQ_AXIS) -> Callable:
             return causal_attention(q, k, v, mask=mask)
         assert q.shape[1] % n == 0, (
             f"seq len {q.shape[1]} not divisible by ring size {n}")
+        tp = int(mesh.shape.get("model", 1))
+        if tp > 1 and k.shape[2] % tp != 0:
+            k, v = _repeat_kv(k, v, q.shape[2])   # make kv shardable over tp
         body = partial(ring_attention_local, axis_name=axis, n_chunks=n)
         return _shard_mapped(mesh, axis, body, q, k, v, mask)
 
@@ -163,14 +175,19 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SEQ_AXIS,
         if n == 1:
             return inner(q, k, v, mask=mask)
         H = q.shape[2]
-        assert H % n == 0, f"n_heads {H} must be divisible by sp size {n} " \
-                           "(reference requirement, sequence/layer.py)"
+        tp = int(mesh.shape.get("model", 1))
+        tp = tp if (tp > 1 and H % tp == 0) else 1
+        assert (H // tp) % n == 0, \
+            f"n_heads {H} / tp {tp} must be divisible by sp size {n} " \
+            "(reference requirement, sequence/layer.py)"
         KV = k.shape[2]
-        if KV % n != 0:
+        if tp > 1:
+            if KV % (tp * n) != 0:
+                k, v = _repeat_kv(k, v, H)        # make kv shardable over tp x sp
+        elif KV % n != 0:
             # GQA: repeat kv only to the smallest splittable head count; the
             # local attention's own GQA expansion covers the rest.
-            target = math.lcm(KV, n)
-            k, v = _repeat_kv(k, v, target)
+            k, v = _repeat_kv(k, v, math.lcm(KV, n))
         body = partial(ulysses_attention_local, axis_name=axis, local_attn=inner)
         return _shard_mapped(mesh, axis, body, q, k, v, mask)
 
