@@ -1,5 +1,7 @@
+from .pipeline import PipelinedTransformerLM, build_pipeline_model
 from .presets import build_model, gpt2, llama2, mixtral, tiny_test
 from .transformer import TransformerConfig, TransformerLM
 
-__all__ = ["TransformerConfig", "TransformerLM", "build_model", "gpt2",
-           "llama2", "mixtral", "tiny_test"]
+__all__ = ["TransformerConfig", "TransformerLM", "PipelinedTransformerLM",
+           "build_model", "build_pipeline_model", "gpt2", "llama2", "mixtral",
+           "tiny_test"]

@@ -323,17 +323,21 @@ class TransformerLM:
         x = x + out
         return constrain(x, P(B_AXES, "seq", None)), aux
 
-    def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None,
-              return_aux: bool = False):
-        """Forward: (B, S) int32 → (B, S, V) logits (compute dtype)."""
+    def _embed(self, params, input_ids):
+        """(B, S) int32 → ((B, S, D) embeddings, (B, S) positions)."""
         cfg = self.cfg
         B, S = input_ids.shape
-        x = params["tok_embed"].astype(cfg.dtype)[input_ids]  # (B,S,D)
+        x = params["tok_embed"].astype(cfg.dtype)[input_ids]
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
         if cfg.pos_embedding == "learned":
             x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
-        x = constrain(x, P(B_AXES, "seq", None))
+        return constrain(x, P(B_AXES, "seq", None)), positions
 
+    def _scan_layers(self, x, layers, positions, attn_mask, remat_policy):
+        """Scan the (remat-wrapped) layer body over a stacked layer pytree.
+
+        ``layers`` may be the full stack or (under pipeline shard_map) the
+        local stage's slice. Returns (x, summed aux losses)."""
         body = partial(self._layer, positions=positions, attn_mask=attn_mask)
         if remat_policy is not None:
             body = jax.checkpoint(body, policy=remat_policy, prevent_cse=False)
@@ -342,15 +346,28 @@ class TransformerLM:
             new_x, aux = body(carry, layer_params)
             return new_x, aux
 
-        x, aux_losses = lax.scan(scan_fn, x, params["layers"])
+        x, aux_losses = lax.scan(scan_fn, x, layers)
+        return x, jnp.sum(aux_losses)
+
+    def _head(self, params, x):
+        """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
+        cfg = self.cfg
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm)
         if cfg.tie_embeddings:
             logits = x @ params["tok_embed"].astype(x.dtype).T
         else:
             logits = x @ params["lm_head"].astype(x.dtype)
-        logits = constrain(logits, P(B_AXES, "seq", "model"))
+        return constrain(logits, P(B_AXES, "seq", "model"))
+
+    def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None,
+              return_aux: bool = False):
+        """Forward: (B, S) int32 → (B, S, V) logits (compute dtype)."""
+        x, positions = self._embed(params, input_ids)
+        x, aux = self._scan_layers(x, params["layers"], positions, attn_mask,
+                                   remat_policy)
+        logits = self._head(params, x)
         if return_aux:
-            return logits, jnp.sum(aux_losses)
+            return logits, aux
         return logits
 
     # ----------------------------------------------------------------- loss

@@ -126,24 +126,32 @@ def local_batch_slice(mesh: Mesh) -> tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
+def current_mesh():
+    """The mesh active in this trace/context, or None. Checks the abstract
+    mesh first (``jax.set_mesh`` / inside-jit), then the legacy
+    ``with mesh:`` thread resources."""
+    from jax.sharding import get_abstract_mesh
+
+    ctx = get_abstract_mesh()
+    if ctx is not None and not ctx.empty:
+        return ctx
+    try:
+        from jax._src.mesh import thread_resources
+
+        ctx = thread_resources.env.physical_mesh
+    except Exception:
+        return None
+    return None if (ctx is None or ctx.empty) else ctx
+
+
 def constrain(x, *spec_or_pspec):
     """``with_sharding_constraint`` that no-ops when no mesh is in context
     (single-chip / un-meshed execution) and ignores axes the context mesh
     doesn't carry. Models use this so the same code runs on a bare chip and
     on any parallel mesh."""
-    from jax.sharding import get_abstract_mesh
-
-    ctx = get_abstract_mesh()
-    if ctx is None or ctx.empty:
-        # `with mesh:` contexts live in thread_resources, not the abstract mesh
-        try:
-            from jax._src.mesh import thread_resources
-
-            ctx = thread_resources.env.physical_mesh
-        except Exception:
-            return x
-        if ctx is None or ctx.empty:
-            return x
+    ctx = current_mesh()
+    if ctx is None:
+        return x
     spec = spec_or_pspec[0] if len(spec_or_pspec) == 1 and isinstance(
         spec_or_pspec[0], PartitionSpec) else PartitionSpec(*spec_or_pspec)
 
