@@ -9,11 +9,15 @@ decode engine, and the observability stack.
 """
 
 from .config import Config
+from .inference import InferenceConfig, InferenceEngine, init_inference
 from .platform import (get_accelerator, init_distributed, build_mesh, MeshSpec)
 from .runtime.engine import Engine, initialize
+from .runtime.hybrid_engine import HybridEngine
 from .version import __version__
 
 from . import comm  # noqa: F401  (deepspeed.comm analog)
 
-__all__ = ["initialize", "Engine", "Config", "get_accelerator",
-           "init_distributed", "build_mesh", "MeshSpec", "__version__"]
+__all__ = ["initialize", "Engine", "HybridEngine", "Config",
+           "init_inference", "InferenceEngine", "InferenceConfig",
+           "get_accelerator", "init_distributed", "build_mesh", "MeshSpec",
+           "__version__"]

@@ -1,0 +1,16 @@
+"""Inference stack: KV-cache decode engine, sampling, weight-only quant.
+
+Reference analog: ``deepspeed/inference/engine.py:39`` (InferenceEngine),
+the kernel-injection machinery (``module_inject/replace_module.py``), the
+fused decode kernels (``csrc/transformer/inference/``), and weight-only
+quantization (``inference/quantization``). TPU-native: the per-token decode
+path is one jitted scan with a static-shape KV cache (the CUDA-graph
+capture/replay of ``inference/engine.py:517`` is subsumed by XLA
+compilation), TP falls out of the same param sharding specs as training,
+and there is no module surgery — the model is already functional.
+"""
+
+from .config import InferenceConfig
+from .engine import InferenceEngine, init_inference
+
+__all__ = ["InferenceConfig", "InferenceEngine", "init_inference"]

@@ -1,0 +1,50 @@
+"""Inference config (reference ``deepspeed/inference/config.py:128-304``).
+
+The knobs that survive the TPU translation: dtype, tensor parallel size,
+max output tokens, weight-only quantization. ``enable_cuda_graph`` and
+``replace_with_kernel_inject`` have no analog — XLA compilation subsumes
+graph capture, and the model is functional so there is nothing to inject.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+           "float32": jnp.float32, "fp32": jnp.float32,
+           "float16": jnp.float16, "fp16": jnp.float16}
+
+
+@dataclasses.dataclass
+class InferenceConfig:
+    dtype: str = "bfloat16"            # compute dtype for decode
+    tensor_parallel: int = 1           # reference tensor_parallel.tp_size
+    max_out_tokens: int = 256          # reference max_out_tokens
+    quantize: bool = False             # int8 weight-only quant (WOQ)
+    quant_group_size: int = 128
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+
+    @classmethod
+    def from_any(cls, cfg: "InferenceConfig | dict | None") -> "InferenceConfig":
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, cls):
+            return cfg
+        known = {f.name for f in dataclasses.fields(cls)}
+        flat = dict(cfg)
+        # accept the reference's nested {"tensor_parallel": {"tp_size": N}}
+        tp = flat.get("tensor_parallel")
+        if isinstance(tp, dict):
+            flat["tensor_parallel"] = int(tp.get("tp_size", 1))
+        unknown = set(flat) - known
+        if unknown:
+            raise ValueError(f"unknown inference config keys: {sorted(unknown)}")
+        return cls(**flat)
+
+    @property
+    def compute_dtype(self) -> Any:
+        return _DTYPES[self.dtype]

@@ -1,0 +1,151 @@
+"""Prefill + single-token decode with a static-shape KV cache.
+
+Reference analog: the fused inference kernels and KV-cache workspace of
+``csrc/transformer/inference/`` (``softmax_context`` = attention over the
+cache, ``inference_context.h`` = the cache allocator). TPU-native: the cache
+is a pair of ``(L, B, max_len, KV, hd)`` arrays updated with
+``dynamic_update_slice`` inside the compiled step; attention over the cache
+masks positions beyond the current length, so every decode step has an
+identical static shape (one compiled program for the whole generation).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.transformer import TransformerConfig, _norm, _rope
+
+BIG_NEG = jnp.float32(-2.0 ** 30)
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray           # (L, B, max_len, KV, hd)
+    v: jnp.ndarray           # (L, B, max_len, KV, hd)
+    length: jnp.ndarray      # i32 scalar: tokens currently cached
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _cache_attend(q, ck, cv, length):
+    """q: (B, T, H, hd) vs cache (B, max_len, KV, hd); positions >= length
+    masked. For prefill T = prompt len (with causal offset); decode T = 1."""
+    B, T, H, hd = q.shape
+    KV = ck.shape[2]
+    if KV != H:
+        ck = jnp.repeat(ck, H // KV, axis=2)
+        cv = jnp.repeat(cv, H // KV, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, ck).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    # query t (global position length - T + t) may attend cache slot s
+    # iff s <= that position
+    t_pos = length - T + jnp.arange(T)[:, None]          # (T, 1)
+    s_pos = jnp.arange(ck.shape[1])[None, :]             # (1, max_len)
+    keep = s_pos <= t_pos                                # (T, max_len)
+    scores = jnp.where(keep[None, None], scores, BIG_NEG)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, cv)
+
+
+def _layer_step(model, x, p, cache_k, cache_v, length, positions):
+    """One transformer layer over x: (B, T, d), reading/writing the cache.
+
+    Returns (x_out, new_cache_k, new_cache_v). Mirrors
+    ``TransformerLM._attention_block`` / ``_mlp_block`` with cache attention
+    substituted for the full causal attention.
+    """
+    cfg = model.cfg
+    B, T, d = x.shape
+    h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm)
+    q = model._maybe_bias(y @ p["wq"].astype(y.dtype), p, "bq").reshape(B, T, h, hd)
+    k = model._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, T, kv, hd)
+    v = model._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, T, kv, hd)
+    if cfg.pos_embedding == "rope":
+        q, k = _rope(q, k, positions, cfg.rope_theta)
+
+    start = length - T  # cache slots [start, start+T) receive the new k/v
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
+                                       (0, start, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
+                                       (0, start, 0, 0))
+    o = _cache_attend(q, cache_k, cache_v, length)
+    o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
+                          p, "bo")
+    x = x + o
+    y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm)
+    out, _aux = model._mlp_block(y2, p)
+    return x + out, cache_k, cache_v
+
+
+def forward_with_cache(model, params, input_ids, cache: KVCache,
+                       positions=None):
+    """Run T tokens through all layers, appending to the cache.
+
+    input_ids: (B, T). Works for both prefill (T = prompt length, cache
+    empty) and decode (T = 1). Returns (logits (B, T, V), new cache).
+    """
+    cfg = model.cfg
+    B, T = input_ids.shape
+    new_len = cache.length + T
+    if positions is None:
+        positions = cache.length + jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    x = params["tok_embed"].astype(cfg.dtype)[input_ids]
+    if cfg.pos_embedding == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
+
+    def scan_fn(carry, layer_in):
+        x = carry
+        lp, ck, cv = layer_in
+        x, ck, cv = _layer_step(model, x, lp, ck, cv, new_len, positions)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
+    logits = model._head(params, x)
+    return logits, KVCache(k=ck, v=cv, length=new_len)
+
+
+def generate_tokens(model, params, input_ids, rng, *, max_new: int,
+                    sampler, eos_token_id=None, cache_dtype=None):
+    """Shared prefill + decode-scan generation loop.
+
+    Used by both :class:`~deepspeed_tpu.inference.InferenceEngine` and the
+    RLHF :class:`~deepspeed_tpu.runtime.hybrid_engine.HybridEngine` so the
+    schedule/eos logic cannot drift between them. ``sampler(logits, rng)``
+    -> (B,) int32.
+    """
+    B, S = input_ids.shape
+    cache = init_cache(model.cfg, B, S + max_new, cache_dtype or model.cfg.dtype)
+    eos = eos_token_id
+
+    logits, cache = forward_with_cache(model, params, input_ids, cache)
+    rng, sub = jax.random.split(rng)
+    tok = sampler(logits[:, -1], sub)
+    done = (tok == eos) if eos is not None else jnp.zeros((B,), bool)
+
+    def step(carry, _):
+        tok, cache, rng, done = carry
+        lg, cache = forward_with_cache(model, params, tok[:, None], cache)
+        rng, sub = jax.random.split(rng)
+        nxt = sampler(lg[:, 0], sub)
+        if eos is not None:
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (nxt == eos)
+        return (nxt, cache, rng, done), tok
+
+    (tok, _, _, _), toks = lax.scan(step, (tok, cache, rng, done), None,
+                                    length=max_new - 1)
+    # emitted tokens 0..max_new-2 plus the final carry token
+    return jnp.concatenate([toks, tok[None]], axis=0).T  # (B, max_new)

@@ -1,0 +1,97 @@
+"""Weight-only int8 quantization for inference.
+
+Reference analog: ``deepspeed/inference/quantization/`` (int4/int8 WOQ) and
+the ``GroupQuantizer`` used by kernel injection
+(``module_inject/replace_module.py:43``). TPU-native: weights are stored as
+int8 + per-group fp scales in HBM (4x memory cut vs bf16 at group_size -> inf)
+and dequantized on the fly inside the jitted step — XLA fuses the dequant
+into the consuming matmul, so HBM traffic (the decode bottleneck) drops
+accordingly. Pallas int8-matmul kernels can replace the fused dequant where
+profitable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 weight + per-group fp32 scales. ``group_size`` is pytree aux
+    data (static under jit, so reshapes stay static-shaped)."""
+
+    def __init__(self, q, scale, group_size: int):
+        self.q = q            # int8, original shape
+        self.scale = scale    # fp32, (..., n_groups, 1)
+        self.group_size = group_size
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.group_size
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+def quantize(w, group_size: int = 128) -> QuantizedTensor:
+    """Symmetric per-group int8 quantization along the last dim."""
+    shape = w.shape
+    last = shape[-1]
+    gs = group_size if last % group_size == 0 else last
+    wf = w.astype(jnp.float32).reshape(shape[:-1] + (last // gs, gs))
+    amax = jnp.max(jnp.abs(wf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q.reshape(shape), scale=scale, group_size=gs)
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
+    shape = qt.q.shape
+    last = shape[-1]
+    qf = qt.q.astype(jnp.float32).reshape(
+        shape[:-1] + (last // qt.group_size, qt.group_size))
+    return (qf * qt.scale).reshape(shape).astype(dtype)
+
+
+def _should_quantize(path, leaf, min_size: int) -> bool:
+    if leaf.ndim < 2 or leaf.size < min_size:
+        return False
+    name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+    # norms/bias stay full precision (match the reference WOQ exclusions)
+    return not (name.startswith(("ln", "b")) or "bias" in name
+                or "scale" in name)
+
+
+def quantize_params(params: Any, group_size: int = 128,
+                    min_size: int = 4096) -> Any:
+    """Quantize every large matmul weight in a param pytree to int8."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, leaf: quantize(leaf, group_size)
+        if _should_quantize(p, leaf, min_size) else leaf, params)
+
+
+def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Inverse of :func:`quantize_params` — called inside jit so XLA fuses
+    the dequant into consumers (weights stay int8 in HBM)."""
+    return jax.tree.map(
+        lambda leaf: dequantize(leaf, dtype)
+        if isinstance(leaf, QuantizedTensor) else leaf,
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def quantized_bytes(params: Any) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.q.size + leaf.scale.size * 4
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return int(total)

@@ -1,0 +1,34 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+Reference analog: generation policy handled by HF ``generate`` on top of the
+reference engine; here sampling is jit-compiled alongside the decode step.
+All samplers are static-shape (top-k via ``lax.top_k``, top-p via sorted
+cumulative mass) so the whole generation loop stays one compiled program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sample_logits(logits, rng, *, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0, greedy: bool = False):
+    """logits: (B, V) → (B,) int32 token ids."""
+    if greedy or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / jnp.float32(max(temperature, 1e-6))
+    if top_k and top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set of tokens with cumulative mass >= top_p
+        cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

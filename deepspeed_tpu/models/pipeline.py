@@ -128,17 +128,19 @@ class PipelinedTransformerLM(TransformerLM):
             # compiler ("Invalid binary instruction opcode copy",
             # hlo_instruction.cc:1585 — float-normalization pass, which
             # native-bf16 TPUs don't run). Upcast params OUTSIDE the
-            # shard_map and compute the whole pipeline in fp32 on CPU.
-            # Gated on actual dtypes at call time: the engine's compute cast
-            # (engine.py _cast_compute) can hand us bf16 params even when
-            # the model config says fp32.
-            if self.cfg.dtype == jnp.bfloat16:
-                import dataclasses
-
-                self.cfg = dataclasses.replace(self.cfg, dtype=jnp.float32)
+            # shard_map and run the pipelined body through an fp32-config
+            # clone (self.cfg stays untouched — dense fallback/eval numerics
+            # are unchanged). Gated on actual dtypes at call time: the
+            # engine's compute cast can hand us bf16 params even when the
+            # model config says fp32.
             params = jax.tree.map(
                 lambda p: p.astype(jnp.float32)
                 if p.dtype == jnp.bfloat16 else p, params)
+            if self.cfg.dtype == jnp.bfloat16:
+                from ..inference.engine import model_with_dtype
+
+                clone = model_with_dtype(self, jnp.float32)
+                return clone.loss(params, batch, remat_policy=remat_policy)
         ids = batch["input_ids"]
         B, S = ids.shape
         M = self.num_micro
