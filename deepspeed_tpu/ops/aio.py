@@ -1,0 +1,143 @@
+"""Async file I/O handle (NVMe offload tier, ZeRO-Infinity).
+
+Reference: ``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` — an aio handle
+with worker threads, queue depth, and block-size knobs, submitting O_DIRECT
+reads/writes of tensors. Same surface here over the C++ thread-pool
+extension (``csrc/aio.cpp``); a Python thread-pool fallback keeps the tier
+functional without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from .builder import build_and_load
+
+
+def _lib():
+    lib = build_and_load("aio")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        lib.ds_aio_create.restype = ctypes.c_void_p
+        lib.ds_aio_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_open.restype = ctypes.c_int
+        lib.ds_aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.ds_aio_close.argtypes = [ctypes.c_int]
+        for f in (lib.ds_aio_submit_read, lib.ds_aio_submit_write):
+            f.restype = ctypes.c_int64
+            f.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                          ctypes.c_int64, ctypes.c_int64]
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ds_aio_errors.restype = ctypes.c_int64
+        lib.ds_aio_errors.argtypes = [ctypes.c_void_p]
+        lib._sigs_set = True
+    return lib
+
+
+class AsyncIOHandle:
+    """Submit/wait file reads+writes of numpy buffers off the main thread."""
+
+    def __init__(self, n_threads: int = 4, block_size: int = 1 << 20,
+                 use_direct: bool = True):
+        self.block_size = block_size
+        self.use_direct = use_direct
+        self._lib = _lib()
+        if self._lib is not None:
+            self._h = ctypes.c_void_p(self._lib.ds_aio_create(n_threads,
+                                                              block_size))
+            self._pool = None
+        else:
+            self._h = None
+            self._pool = ThreadPoolExecutor(max_workers=n_threads)
+        self._fds: dict[str, int] = {}
+        self._futures: dict[int, Future] = {}
+        self._next = 1
+
+    # ------------------------------------------------------------------ fds
+    def _fd(self, path: str, for_write: bool) -> int:
+        key = f"{path}|{int(for_write)}"
+        if key not in self._fds:
+            if self._lib is not None:
+                fd = self._lib.ds_aio_open(path.encode(), int(for_write),
+                                           int(self.use_direct))
+                if fd < 0:
+                    raise OSError(f"aio open failed: {path}")
+            else:
+                flags = (os.O_WRONLY | os.O_CREAT) if for_write else os.O_RDONLY
+                fd = os.open(path, flags, 0o644)
+            self._fds[key] = fd
+        return self._fds[key]
+
+    # ---------------------------------------------------------------- submit
+    def submit_write(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        assert buf.flags["C_CONTIGUOUS"]
+        fd = self._fd(path, True)
+        if self._lib is not None:
+            return self._lib.ds_aio_submit_write(
+                self._h, fd, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.nbytes, offset)
+        t = self._next
+        self._next += 1
+        self._futures[t] = self._pool.submit(os.pwrite, fd, buf.tobytes(), offset)
+        return t
+
+    def submit_read(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        assert buf.flags["C_CONTIGUOUS"]
+        fd = self._fd(path, False)
+        if self._lib is not None:
+            return self._lib.ds_aio_submit_read(
+                self._h, fd, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.nbytes, offset)
+        t = self._next
+        self._next += 1
+
+        def read_into():
+            data = os.pread(fd, buf.nbytes, offset)
+            buf.view(np.uint8).reshape(-1)[:len(data)] = np.frombuffer(
+                data, np.uint8)
+
+        self._futures[t] = self._pool.submit(read_into)
+        return t
+
+    # ------------------------------------------------------------------ wait
+    def wait(self, ticket: int) -> None:
+        if self._lib is not None:
+            self._lib.ds_aio_wait(self._h, ticket)
+            if self._lib.ds_aio_errors(self._h):
+                raise OSError("aio: outstanding I/O errors")
+            return
+        for t in sorted(list(self._futures)):
+            if t <= ticket:
+                self._futures.pop(t).result()
+
+    def sync_write(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
+        self.wait(self.submit_write(path, buf, offset))
+
+    def sync_read(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
+        self.wait(self.submit_read(path, buf, offset))
+
+    def close(self) -> None:
+        for fd in self._fds.values():
+            (self._lib.ds_aio_close(fd) if self._lib is not None
+             else os.close(fd))
+        self._fds.clear()
+        if self._lib is not None and self._h:
+            self._lib.ds_aio_destroy(self._h)
+            self._h = None
+        if self._pool is not None:
+            self._pool.shutdown()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_available() -> bool:
+    return _lib() is not None

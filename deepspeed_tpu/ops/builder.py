@@ -1,0 +1,75 @@
+"""JIT build system for the C++ host extensions.
+
+Analog of the reference ``op_builder/builder.py`` (JIT-vs-AOT compile,
+``DS_BUILD_*`` env flags, compatibility probing). On TPU only host-side
+native code needs compiling (CPU optimizer, async I/O — SURVEY §2.3), so
+the builder is small: hash the source, ``g++ -O3 -march=native -fopenmp
+-shared -fPIC`` into a per-source cache dir, ``ctypes.CDLL`` the result.
+``DSTPU_BUILD_NATIVE=0`` disables native builds (pure-Python fallbacks take
+over, mirroring the reference's op-compatibility fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+from pathlib import Path
+from typing import Optional
+
+from ..utils.logging import logger
+
+CSRC = Path(__file__).resolve().parent.parent / "csrc"
+_CACHE: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def native_enabled() -> bool:
+    return os.environ.get("DSTPU_BUILD_NATIVE", "1") != "0"
+
+
+def _build_dir() -> Path:
+    d = Path(os.environ.get("DSTPU_BUILD_DIR",
+                            Path.home() / ".cache" / "deepspeed_tpu" / "build"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def build_and_load(name: str, extra_flags: tuple[str, ...] = ()) -> Optional[ctypes.CDLL]:
+    """Compile ``csrc/<name>.cpp`` (cached by content hash) and dlopen it.
+
+    Returns None when native builds are disabled or the toolchain fails —
+    callers must fall back to their Python implementation.
+    """
+    if name in _CACHE:
+        return _CACHE[name]
+    lib = None
+    if native_enabled():
+        src = CSRC / f"{name}.cpp"
+        try:
+            code = src.read_bytes()
+            tag = hashlib.sha256(code + b"|" + b" ".join(
+                f.encode() for f in extra_flags)).hexdigest()[:16]
+            out = _build_dir() / f"{name}-{tag}.so"
+            if not out.exists():
+                cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
+                       "-fPIC", "-std=c++17", str(src), "-o", str(out),
+                       *extra_flags]
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                logger.info(f"built native op '{name}' -> {out.name}")
+            lib = ctypes.CDLL(str(out))
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning(f"native build of '{name}' failed, using Python "
+                           f"fallback: {detail[:500]}")
+            lib = None
+    _CACHE[name] = lib
+    return lib
+
+
+def op_report() -> dict[str, bool]:
+    """Which native ops are buildable/loaded (the ``ds_report`` compat
+    matrix, reference ``env_report.py``)."""
+    return {name: build_and_load(name) is not None
+            for name in ("cpu_optimizer", "aio")}

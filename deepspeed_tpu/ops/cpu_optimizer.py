@@ -1,0 +1,130 @@
+"""Host optimizer over offloaded fp32 master state (ZeRO-Offload core).
+
+Reference: ``DeepSpeedCPUAdam`` (``ops/adam/cpu_adam.py:13``) over the AVX
+C++ kernels. The wrapper owns contiguous fp32 numpy state and applies the
+native step in place; a pure-numpy fallback keeps the path alive when the
+toolchain is unavailable. The optional bf16 copy-back writes the compute
+copy in the same pass (the reference's "simultaneous fp16 param copy").
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .builder import build_and_load
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+_U16P = ctypes.POINTER(ctypes.c_uint16)
+
+
+def _lib():
+    lib = build_and_load("cpu_optimizer")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        lib.ds_adam_step.argtypes = [_F32P, _F32P, _F32P, _F32P,
+                                     ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_int,
+                                     ctypes.c_int, _U16P]
+        lib.ds_lion_step.argtypes = [_F32P, _F32P, _F32P, ctypes.c_int64,
+                                     ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float, _U16P]
+        lib.ds_adagrad_step.argtypes = [_F32P, _F32P, _F32P, ctypes.c_int64,
+                                        ctypes.c_float, ctypes.c_float,
+                                        ctypes.c_float, _U16P]
+        lib._sigs_set = True
+    return lib
+
+
+def _ptr(a: Optional[np.ndarray], typ):
+    return a.ctypes.data_as(typ) if a is not None else typ()
+
+
+def _check(name, *arrays):
+    n = arrays[0].size
+    for a in arrays:
+        if a is None:
+            continue
+        assert a.flags["C_CONTIGUOUS"], f"{name}: arrays must be contiguous"
+        assert a.size == n, f"{name}: size mismatch"
+    return n
+
+
+def adam_step(p: np.ndarray, m: np.ndarray, v: np.ndarray, g: np.ndarray,
+              step: int, lr: float, betas=(0.9, 0.999), eps: float = 1e-8,
+              weight_decay: float = 0.0, adamw: bool = True,
+              bias_correction: bool = True,
+              p_bf16: Optional[np.ndarray] = None) -> None:
+    """In-place Adam(W) on flat fp32 arrays (semantics of
+    ``runtime/optimizers.py adam()``)."""
+    n = _check("adam", p, m, v, g, p_bf16)
+    lib = _lib()
+    if lib is not None:
+        lib.ds_adam_step(_ptr(p, _F32P), _ptr(m, _F32P), _ptr(v, _F32P),
+                         _ptr(g, _F32P), n, step, lr, betas[0], betas[1],
+                         eps, weight_decay, int(adamw), int(bias_correction),
+                         _ptr(p_bf16, _U16P))
+        return
+    # numpy fallback
+    b1, b2 = betas
+    bc1 = 1.0 - b1 ** step if bias_correction else 1.0
+    bc2 = 1.0 - b2 ** step if bias_correction else 1.0
+    grad = g if (adamw or not weight_decay) else g + weight_decay * p
+    m *= b1
+    m += (1 - b1) * grad
+    v *= b2
+    v += (1 - b2) * np.square(grad)
+    upd = (m / bc1) / (np.sqrt(v / bc2) + eps)
+    if adamw and weight_decay:
+        upd += weight_decay * p
+    p -= lr * upd
+    if p_bf16 is not None:
+        _f32_to_bf16_np(p, p_bf16)
+
+
+def lion_step(p, m, g, lr, betas=(0.9, 0.99), weight_decay: float = 0.0,
+              p_bf16=None) -> None:
+    n = _check("lion", p, m, g, p_bf16)
+    lib = _lib()
+    if lib is not None:
+        lib.ds_lion_step(_ptr(p, _F32P), _ptr(m, _F32P), _ptr(g, _F32P), n,
+                         lr, betas[0], betas[1], weight_decay,
+                         _ptr(p_bf16, _U16P))
+        return
+    b1, b2 = betas
+    upd = np.sign(b1 * m + (1 - b1) * g)
+    if weight_decay:
+        upd = upd + weight_decay * p
+    m *= b2
+    m += (1 - b2) * g
+    p -= lr * upd
+    if p_bf16 is not None:
+        _f32_to_bf16_np(p, p_bf16)
+
+
+def adagrad_step(p, acc, g, lr, eps: float = 1e-10,
+                 weight_decay: float = 0.0, p_bf16=None) -> None:
+    n = _check("adagrad", p, acc, g, p_bf16)
+    lib = _lib()
+    if lib is not None:
+        lib.ds_adagrad_step(_ptr(p, _F32P), _ptr(acc, _F32P), _ptr(g, _F32P),
+                            n, lr, eps, weight_decay, _ptr(p_bf16, _U16P))
+        return
+    grad = g + weight_decay * p if weight_decay else g
+    acc += np.square(grad)
+    p -= lr * grad / (np.sqrt(acc) + eps)
+    if p_bf16 is not None:
+        _f32_to_bf16_np(p, p_bf16)
+
+
+def _f32_to_bf16_np(src: np.ndarray, dst: np.ndarray) -> None:
+    x = src.view(np.uint32)
+    rounding = np.uint32(0x7FFF) + ((x >> np.uint32(16)) & np.uint32(1))
+    np.copyto(dst, ((x + rounding) >> np.uint32(16)).astype(np.uint16))
+
+
+def native_available() -> bool:
+    return _lib() is not None

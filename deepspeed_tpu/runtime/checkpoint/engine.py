@@ -37,7 +37,16 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
     base = Path(save_dir).absolute()
     path = base / tag
     ckptr = _checkpointer()
-    ckptr.save(path / "state", engine.state, force=True)
+    if getattr(engine, "offload", False):
+        # host-resident state (ZeRO-Offload/Infinity): numpy trees
+        m, v = engine.host_opt.moment_trees()
+        state = {"master_params": engine.host_opt.master_tree(),
+                 "mu": m, "count": np.int32(engine.host_opt.count)}
+        if v is not None:
+            state["nu"] = v
+        ckptr.save(path / "state", state, force=True)
+    else:
+        ckptr.save(path / "state", engine.state, force=True)
     if jax.process_index() == 0:
         meta = {
             "tag": tag,
@@ -61,18 +70,29 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
         tag = latest.read_text().strip()
     path = base / tag
     ckptr = _checkpointer()
-    # Abstract target carries this engine's shardings: restoring onto a
-    # different mesh/topology reshards transparently (elastic resume).
-    abstract = jax.tree.map(
-        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
-        engine.state, engine.state_shardings)
-    restored = ckptr.restore(path / "state", item=abstract)
-    engine.state = restored
+    if getattr(engine, "offload", False):
+        restored = ckptr.restore(path / "state")
+        engine.host_opt.load_state(restored["master_params"],
+                                   restored.get("mu"), restored.get("nu"),
+                                   count=int(restored["count"]))
+        with engine.mesh:
+            engine.compute_params = engine.host_opt.device_compute_params()
+        engine.global_steps = int(restored["count"])
+        step_guess = engine.global_steps
+    else:
+        # Abstract target carries this engine's shardings: restoring onto a
+        # different mesh/topology reshards transparently (elastic resume).
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            engine.state, engine.state_shardings)
+        restored = ckptr.restore(path / "state", item=abstract)
+        engine.state = restored
+        step_guess = int(restored.step)
     meta_file = path / "meta.json"
     if meta_file.exists():
         meta = json.loads(meta_file.read_text())
-        engine.global_steps = int(meta.get("global_steps", int(restored.step)))
+        engine.global_steps = int(meta.get("global_steps", step_guess))
     else:
-        engine.global_steps = int(restored.step)
+        engine.global_steps = step_guess
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})", ranks=[0])
     return str(path)

@@ -85,6 +85,7 @@ class Engine:
         self.seed = self.config.seed if seed is None else seed
 
         zcfg = self.config.zero_optimization
+        self.offload = False
         self.partitioner = ZeroPartitioner(zcfg, self.mesh)
         self.optimizer: Optimizer = build_optimizer(self.config.optimizer.type,
                                                     self.config.optimizer.params)
@@ -114,6 +115,14 @@ class Engine:
                  f"gas={self.config.gradient_accumulation_steps} "
                  f"global={self.config.train_batch_size}", ranks=[0])
 
+        # ---------------- ZeRO-Offload / Infinity: host-resident optimizer
+        zoff = zcfg.offload_optimizer
+        self.offload = zoff.device in ("cpu", "nvme")
+        if self.offload:
+            self._init_offload(rng, zoff)
+            self._post_init()
+            return
+
         # ---------------- init state (sharded at construction: the zero.Init
         # analog — params are born partitioned, never materialized replicated)
         self.state_shardings = TrainState(
@@ -141,7 +150,9 @@ class Engine:
         self._eval_step = jax.jit(self._eval_step_impl,
                                   in_shardings=(self.state_shardings.master_params,
                                                 self._batch_sharding(gas_dim=False)))
+        self._post_init()
 
+    def _post_init(self):
         self.timers = WallClockTimers()
         mb, gas = self.config.train_micro_batch_size_per_gpu, self.config.gradient_accumulation_steps
         self.throughput = ThroughputTimer(
@@ -156,6 +167,89 @@ class Engine:
             from ..monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(self.config.monitor)
+
+    def _init_offload(self, rng, zoff):
+        """ZeRO-Offload/Infinity mode: fp32 master + moments in host DRAM
+        (NVMe tier for moments), C++ host optimizer, device holds only the
+        compute copy. Reference: stage_1_and_2.py:1096 + swap_tensor/."""
+        from .offload import HostOffloadOptimizer
+
+        assert not self.config.fp16.enabled, \
+            "offload_optimizer requires bf16/fp32 (no dynamic loss scaling)"
+        with self.mesh:
+            init_params = jax.jit(self._init_master)(rng)
+        host_master = jax.tree.map(np.asarray, init_params)
+        del init_params
+        fp32_names = tuple(getattr(self.model, "fp32_param_names", lambda: ())())
+        self.host_opt = HostOffloadOptimizer(
+            host_master, self.optimizer, zoff,
+            compute_dtype=self.compute_dtype, fp32_names=fp32_names,
+            compute_shardings=self.compute_shardings)
+        with self.mesh:
+            self.compute_params = self.host_opt.device_compute_params()
+        self._grad_step = jax.jit(
+            self._grad_step_impl,
+            in_shardings=(self.compute_shardings, self._batch_sharding()))
+        self._eval_offload = jax.jit(
+            lambda cp, b: self.model.loss(cp, b),
+            in_shardings=(self.compute_shardings,
+                          self._batch_sharding(gas_dim=False)))
+        log_dist(f"offload: optimizer states on "
+                 f"{'NVMe' if zoff.device == 'nvme' else 'host DRAM'} "
+                 f"({self.param_count / 1e6:.1f}M params)", ranks=[0])
+
+    def _init_master(self, rng):
+        return jax.tree.map(lambda a: a.astype(jnp.float32),
+                            self.model.init(rng))
+
+    def _grad_step_impl(self, compute_params, batch):
+        """Forward+backward only — the update happens on the host."""
+        cfg = self.config
+        gas = int(cfg.gradient_accumulation_steps)
+
+        def loss_fn(cp, mb):
+            return self.model.loss(cp, mb, remat_policy=self.remat_policy) / gas
+
+        grad_fn = jax.value_and_grad(loss_fn)
+        acc_dtype = jnp.dtype(cfg.data_types.grad_accum_dtype or "float32")
+
+        def gas_body(carry, mb):
+            g_acc, loss_acc = carry
+            loss, g = grad_fn(compute_params, mb)
+            g_acc = jax.tree.map(lambda a, gg: a + gg.astype(acc_dtype), g_acc, g)
+            return (g_acc, loss_acc + loss), None
+
+        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype),
+                                  compute_params)
+        (grads, loss), _ = lax.scan(gas_body, (zero_grads, jnp.float32(0.0)),
+                                    batch)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                             for g in jax.tree.leaves(grads)))
+        return grads, {"loss": loss, "grad_norm": gnorm}
+
+    def _train_batch_offload(self, batch: dict) -> dict:
+        self.throughput.start()
+        if not isinstance(next(iter(batch.values())), jax.Array):
+            batch = self._make_global(batch)
+        with self.mesh:
+            grads, metrics = self._grad_step(self.compute_params, batch)
+        gnorm = float(metrics["grad_norm"])
+        lr = float(self.lr_schedule(jnp.int32(self.global_steps)))
+        clip = self.config.gradient_clipping
+        coef = min(1.0, clip / (gnorm + 1e-6)) if clip and clip > 0 else 1.0
+        with self.mesh:
+            self.compute_params = self.host_opt.step(grads, lr, coef)
+        self.global_steps += 1
+        out = {"loss": float(metrics["loss"]), "grad_norm": gnorm, "lr": lr,
+               "loss_scale": 1.0, "skipped": 0}
+        if self.global_steps % self.config.steps_per_print == 0:
+            self.throughput.stop(report=True)
+            log_dist(f"step={self.global_steps} loss={out['loss']:.4f} "
+                     f"lr={lr:.3e} gnorm={gnorm:.3f}", ranks=[0])
+        else:
+            self.throughput.stop(report=False)
+        return out
 
     # ------------------------------------------------------------------ util
     def _flops_per_sample(self) -> float:
@@ -298,7 +392,10 @@ class Engine:
 
     def train_batch(self, batch: dict) -> dict:
         """One optimizer step over train_batch_size samples (micro-stepping,
-        grad accumulation, and the update are all inside the compiled step)."""
+        grad accumulation, and the update are all inside the compiled step;
+        in offload mode the update runs on the host optimizer instead)."""
+        if self.offload:
+            return self._train_batch_offload(batch)
         self.throughput.start()
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
@@ -329,11 +426,15 @@ class Engine:
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch, gas_dim=False)
         with self.mesh:
+            if self.offload:
+                return float(self._eval_offload(self.compute_params, batch))
             return float(self._eval_step(self.state.master_params, batch))
 
     @property
     def lr(self) -> float:
-        return float(self.lr_schedule(self.state.step))
+        step = (jnp.int32(self.global_steps) if self.offload
+                else self.state.step)
+        return float(self.lr_schedule(step))
 
     @property
     def train_micro_batch_size_per_device(self) -> int:

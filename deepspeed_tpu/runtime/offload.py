@@ -1,0 +1,245 @@
+"""ZeRO-Offload / ZeRO-Infinity: host-resident optimizer state + native step.
+
+Reference: ``runtime/zero/stage_1_and_2.py:1096-1191`` (CPU offload of
+grads/optimizer states + DeepSpeedCPUAdam) and the ZeRO-Infinity swap stack
+(``runtime/swap_tensor/partitioned_optimizer_swapper.py``,
+``pipelined_optimizer_swapper.py``, ``csrc/aio/``).
+
+TPU-native shape of the same capability:
+- fp32 master params + moments live in **host DRAM** as numpy arrays; the
+  device holds only the bf16 compute copy (and transient grads).
+- the update runs through the **C++ host optimizer**
+  (``csrc/cpu_optimizer.cpp``, OpenMP + autovectorized AVX) with the bf16
+  compute copy written in the same pass.
+- ``device: nvme`` additionally pages the moment arrays to disk through the
+  **C++ aio thread pool** (``csrc/aio.cpp``) with double-buffered
+  prefetch: leaf i+1's moments stream in while leaf i updates — the
+  pipelined swapper of ``pipelined_optimizer_swapper.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ..ops import aio as aio_mod
+from ..ops import cpu_optimizer as host_opt
+from ..utils.logging import log_dist
+
+
+class HostOffloadOptimizer:
+    """Flat per-leaf host state + native in-place updates."""
+
+    def __init__(self, host_master: Any, optimizer, offload_cfg,
+                 compute_dtype=jnp.bfloat16, fp32_names: tuple = (),
+                 compute_shardings: Any = None):
+        self.opt_name = optimizer.name
+        self.hp = dict(optimizer.hyperparams)
+        if self.opt_name not in ("adam", "adamw", "lion", "adagrad"):
+            raise ValueError(
+                f"offload_optimizer supports adam/adamw/lion/adagrad, "
+                f"got '{self.opt_name}'")
+        self.compute_dtype = compute_dtype
+        self.nvme = offload_cfg.device == "nvme"
+        self.count = 0
+
+        leaves, self.treedef = jax.tree_util.tree_flatten_with_path(host_master)
+        self.paths = [p for p, _ in leaves]
+        self.names = [jax.tree_util.keystr(p) for p in self.paths]
+        # np.array(copy=True): device_get views are read-only; master must
+        # be writable and contiguous for the in-place native step
+        self.master = [np.array(x, np.float32, copy=True, order="C")
+                       for _, x in leaves]
+        self.shapes = [m.shape for m in self.master]
+        self.fp32_keep = [any(p[-1].key == n if hasattr(p[-1], "key") else False
+                              for n in fp32_names) for p in self.paths]
+        self.shardings = (jax.tree_util.tree_leaves(compute_shardings)
+                          if compute_shardings is not None
+                          else [None] * len(self.master))
+        # bf16 copy-back buffers (uint16 storage, viewed as bfloat16)
+        self.bf16 = [None if keep else np.zeros(m.size, np.uint16)
+                     for keep, m in zip(self.fp32_keep, self.master)]
+        self.two_moments = self.opt_name in ("adam", "adamw")
+
+        if not self.nvme:
+            self.m = [np.zeros(x.size, np.float32) for x in self.master]
+            self.v = ([np.zeros(x.size, np.float32) for x in self.master]
+                      if self.two_moments else [None] * len(self.master))
+            self.aio = None
+        else:
+            path = offload_cfg.nvme_path or "/tmp/dstpu_nvme"
+            os.makedirs(path, exist_ok=True)
+            self.nvme_dir = path
+            self.aio = aio_mod.AsyncIOHandle(
+                n_threads=max(2, int(offload_cfg.buffer_count)),
+                use_direct=False)
+            # two swap slots of max-leaf size (double buffering)
+            max_n = max(x.size for x in self.master)
+            n_slots = 2
+            self._slot_m = [np.zeros(max_n, np.float32) for _ in range(n_slots)]
+            self._slot_v = [np.zeros(max_n, np.float32) for _ in range(n_slots)]
+            self._slot_write_tickets = [0] * n_slots
+            # initialize moment files to zero
+            zero_max = np.zeros(max_n, np.float32)
+            for i, x in enumerate(self.master):
+                self.aio.sync_write(self._mfile(i), zero_max[:x.size])
+                if self.two_moments:
+                    self.aio.sync_write(self._vfile(i), zero_max[:x.size])
+            log_dist(f"nvme offload: {len(self.master)} moment tensors in "
+                     f"{path}", ranks=[0])
+
+    # ------------------------------------------------------------------ files
+    def _mfile(self, i):
+        return os.path.join(self.nvme_dir, f"moment1_{i}.bin")
+
+    def _vfile(self, i):
+        return os.path.join(self.nvme_dir, f"moment2_{i}.bin")
+
+    # ------------------------------------------------------------- leaf step
+    def _apply_leaf(self, i, p, m, v, g, lr):
+        kw = dict(p_bf16=self.bf16[i])
+        if self.opt_name in ("adam", "adamw"):
+            host_opt.adam_step(p, m, v, g, self.count, lr,
+                               betas=self.hp.get("betas", (0.9, 0.999)),
+                               eps=self.hp.get("eps", 1e-8),
+                               weight_decay=self.hp.get("weight_decay", 0.0),
+                               adamw=self.opt_name == "adamw", **kw)
+        elif self.opt_name == "lion":
+            host_opt.lion_step(p, m, g, lr,
+                               betas=self.hp.get("betas", (0.9, 0.99)),
+                               weight_decay=self.hp.get("weight_decay", 0.0),
+                               **kw)
+        else:
+            host_opt.adagrad_step(p, m, g, lr,
+                                  eps=self.hp.get("eps", 1e-10),
+                                  weight_decay=self.hp.get("weight_decay", 0.0),
+                                  **kw)
+
+    # ----------------------------------------------------------------- step
+    def step(self, grads_tree, lr: float, clip_coef: float = 1.0):
+        """Host update over all leaves; returns the new device compute tree."""
+        self.count += 1
+        g_leaves = [np.ascontiguousarray(
+            np.asarray(jax.device_get(g), np.float32).reshape(-1))
+            for g in jax.tree_util.tree_leaves(grads_tree)]
+        if clip_coef != 1.0:
+            # device_get views can be read-only; clipping allocates
+            g_leaves = [g * np.float32(clip_coef) for g in g_leaves]
+        n = len(self.master)
+        new_device = []
+
+        if not self.nvme:
+            for i in range(n):
+                p = self.master[i].reshape(-1)
+                self._apply_leaf(i, p, self.m[i], self.v[i], g_leaves[i], lr)
+                new_device.append(self._to_device(i))
+            return self.treedef.unflatten(new_device)
+
+        # NVMe: double-buffered pipeline — prefetch i+1 while updating i.
+        read_tickets = [None] * n
+        read_tickets[0] = self._prefetch(0, slot=0)
+        for i in range(n):
+            slot = i % 2
+            self.aio.wait(read_tickets[i])          # moments for leaf i ready
+            if i + 1 < n:
+                nxt_slot = (i + 1) % 2
+                # the next slot must have finished writing back leaf i-1
+                if self._slot_write_tickets[nxt_slot]:
+                    self.aio.wait(self._slot_write_tickets[nxt_slot])
+                read_tickets[i + 1] = self._prefetch(i + 1, slot=nxt_slot)
+            sz = self.master[i].size
+            m = self._slot_m[slot][:sz]
+            v = self._slot_v[slot][:sz] if self.two_moments else None
+            p = self.master[i].reshape(-1)
+            self._apply_leaf(i, p, m, v, g_leaves[i], lr)
+            t = self.aio.submit_write(self._mfile(i), m)
+            if self.two_moments:
+                t = self.aio.submit_write(self._vfile(i), v)
+            self._slot_write_tickets[slot] = t
+            new_device.append(self._to_device(i))
+        for t in self._slot_write_tickets:
+            if t:
+                self.aio.wait(t)
+        return self.treedef.unflatten(new_device)
+
+    def _prefetch(self, i, slot):
+        sz = self.master[i].size
+        t = self.aio.submit_read(self._mfile(i), self._slot_m[slot][:sz])
+        if self.two_moments:
+            t = self.aio.submit_read(self._vfile(i), self._slot_v[slot][:sz])
+        return t
+
+    def _to_device(self, i):
+        if self.fp32_keep[i]:
+            arr = self.master[i]
+        else:
+            arr = self.bf16[i].view(ml_dtypes.bfloat16).reshape(self.shapes[i])
+        s = self.shardings[i]
+        return jax.device_put(arr, s) if s is not None else jnp.asarray(arr)
+
+    # ------------------------------------------------------------ state views
+    def device_compute_params(self):
+        """Initial device compute copy from the host master."""
+        out = []
+        for i in range(len(self.master)):
+            if self.fp32_keep[i]:
+                out.append(self._to_device(i))
+            else:
+                host_opt._f32_to_bf16_np(self.master[i].reshape(-1), self.bf16[i])
+                out.append(self._to_device(i))
+        return self.treedef.unflatten(out)
+
+    def master_tree(self):
+        return self.treedef.unflatten([m.copy() for m in self.master])
+
+    def moment_trees(self):
+        """(m, v) host trees — NVMe moments are paged in for this call
+        (checkpointing path)."""
+        if not self.nvme:
+            m = self.treedef.unflatten([x.reshape(s) for x, s in
+                                        zip(self.m, self.shapes)])
+            v = (self.treedef.unflatten([x.reshape(s) for x, s in
+                                         zip(self.v, self.shapes)])
+                 if self.two_moments else None)
+            return m, v
+        ms, vs = [], []
+        for i, shape in enumerate(self.shapes):
+            sz = self.master[i].size
+            buf = np.zeros(sz, np.float32)
+            self.aio.sync_read(self._mfile(i), buf)
+            ms.append(buf.reshape(shape))
+            if self.two_moments:
+                buf2 = np.zeros(sz, np.float32)
+                self.aio.sync_read(self._vfile(i), buf2)
+                vs.append(buf2.reshape(shape))
+        return (self.treedef.unflatten(ms),
+                self.treedef.unflatten(vs) if self.two_moments else None)
+
+    def load_state(self, master_tree, m_tree=None, v_tree=None, count=0):
+        """Restore host state (checkpoint resume)."""
+        self.count = int(count)
+        for i, (_, x) in enumerate(
+                jax.tree_util.tree_flatten_with_path(master_tree)[0]):
+            np.copyto(self.master[i], np.asarray(x, np.float32))
+        if m_tree is not None:
+            m_leaves = jax.tree_util.tree_leaves(m_tree)
+            v_leaves = (jax.tree_util.tree_leaves(v_tree)
+                        if v_tree is not None else [None] * len(m_leaves))
+            for i in range(len(self.master)):
+                mi = np.ascontiguousarray(
+                    np.asarray(m_leaves[i], np.float32).reshape(-1))
+                if not self.nvme:
+                    np.copyto(self.m[i], mi)
+                    if self.two_moments and v_leaves[i] is not None:
+                        np.copyto(self.v[i], np.asarray(
+                            v_leaves[i], np.float32).reshape(-1))
+                else:
+                    self.aio.sync_write(self._mfile(i), mi)
+                    if self.two_moments and v_leaves[i] is not None:
+                        self.aio.sync_write(self._vfile(i), np.ascontiguousarray(
+                            np.asarray(v_leaves[i], np.float32).reshape(-1)))
