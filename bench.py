@@ -3,20 +3,33 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Baseline anchor (BASELINE.md): the reference reports 64 TFLOPS for its
-fused-kernel BERT-large on 1x V100 (seq128) and 272 samples/s; the headline
-north-star here is MFU-class throughput on the current chip. vs_baseline is
-model FLOPs utilization achieved / the reference's reported 50% (=64/125
-TFLOPS peak V100) kernel utilization — i.e. >1.0 means better hardware
-utilization than the reference's flagship kernel numbers.
+fused-kernel BERT-large on 1x V100 (seq128), i.e. 51.2% kernel utilization
+(64/125 fp16 peak).  vs_baseline = achieved MFU / 0.512 — >1.0 means better
+hardware utilization than the reference's flagship kernel numbers.
+
+Robustness (round-1 postmortem): the axon TPU tunnel admits ONE process at
+a time and can be wedged for minutes after an unclean exit.  So the parent
+process does NO jax import at all; it probes the backend from a throwaway
+subprocess with a timeout, retries with backoff, and only then runs the
+workload in a fresh child interpreter.  If the TPU never comes up it falls
+back to a small virtual-CPU run so the driver still records a finite
+artifact (clearly marked in "unit").
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+_CHILD_MARK = "_DSTPU_BENCH_CHILD"
+_PROBE_TIMEOUT_S = 150
+_CHILD_TIMEOUT_S = 1200
+_MAX_ATTEMPTS = 4
 
 
-def main():
+def _run_workload():
+    """Child: claim the backend, time real steps, print the JSON line."""
     import jax
 
     import deepspeed_tpu as ds
@@ -24,9 +37,16 @@ def main():
     from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
     from deepspeed_tpu.utils.timer import peak_flops_for
 
-    n_dev = len(jax.devices())
-    seq = 512
-    micro = 8
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+
+    if on_tpu:
+        seq, micro, n_steps, size = 512, 8, 10, "125m"
+    else:
+        # CPU fallback: tiny shapes so a 1-core box finishes in minutes.
+        seq, micro, n_steps, size = 128, 2, 3, "125m"
+
     cfg = {
         "train_batch_size": micro * n_dev,
         "train_micro_batch_size_per_gpu": micro,
@@ -37,7 +57,7 @@ def main():
         "zero_optimization": {"stage": 1},
         "remat": {"enabled": True, "policy": "dots_saveable"},
     }
-    model_cfg = gpt2("125m", max_seq=seq)
+    model_cfg = gpt2(size, max_seq=seq)
     model = build_model(model_cfg)
     engine = ds.initialize(cfg, model)
 
@@ -50,7 +70,6 @@ def main():
     engine.train_batch(batch)
     jax.block_until_ready(engine.state.step)
 
-    n_steps = 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         engine.train_batch(batch)
@@ -60,18 +79,115 @@ def main():
     tokens_per_sec = engine.train_batch_size * seq / dt
     flops_per_token = model_cfg.flops_per_token() * 3  # fwd + bwd
     achieved = tokens_per_sec * flops_per_token
-    peak = peak_flops_for(jax.devices()[0]) * n_dev
+    peak = peak_flops_for(devices[0]) * n_dev
     mfu = achieved / peak
     # Reference anchor: 64 TFLOPS / 125 TFLOPS fp16 peak V100 = 51.2% kernel MFU
     vs_baseline = mfu / 0.512
 
+    unit = (f"MFU (tokens/s={tokens_per_sec:.0f}, step={dt * 1000:.1f}ms, "
+            f"devices={n_dev}, platform={devices[0].platform}")
+    if not on_tpu:
+        unit += ", CPU-FALLBACK: TPU tunnel unavailable"
+    unit += ")"
+
     print(json.dumps({
         "metric": "gpt2_125m_zero1_mfu",
         "value": round(mfu, 4),
-        "unit": f"MFU (tokens/s={tokens_per_sec:.0f}, step={dt*1000:.1f}ms, "
-                f"devices={n_dev})",
+        "unit": unit,
         "vs_baseline": round(vs_baseline, 4),
-    }))
+    }), flush=True)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_backend(timeout: float = _PROBE_TIMEOUT_S) -> bool:
+    """Can a fresh interpreter claim the ambient backend right now?"""
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _log(f"backend probe timed out after {timeout}s (tunnel wedged?)")
+        return False
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-1:]
+        _log(f"backend probe failed rc={p.returncode}: {tail}")
+        return False
+    _log(f"backend probe ok: {p.stdout.strip()}")
+    return True
+
+
+def _warn_strays() -> None:
+    """The tunnel admits one process; list other pythons that may hold it."""
+    try:
+        out = subprocess.run(["ps", "-eo", "pid,etime,cmd"], capture_output=True,
+                             text=True, timeout=10).stdout
+    except Exception:
+        return
+    me = str(os.getpid())
+    for line in out.splitlines():
+        if "python" in line and "bench.py" not in line and me not in line.split()[:1]:
+            if any(k in line for k in ("jax", "pytest", "graft_entry", "deepspeed")):
+                _log(f"possible TPU-holding stray: {line.strip()}")
+
+
+def _run_child(env: dict, timeout: float = _CHILD_TIMEOUT_S):
+    """Run the workload in a fresh interpreter; return parsed JSON or None."""
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
+                           timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _log(f"workload child timed out after {timeout}s")
+        return None
+    sys.stderr.write(p.stderr or "")
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    _log(f"workload child rc={p.returncode}, no JSON line in stdout: "
+         f"{(p.stdout or '')[-300:]!r}")
+    return None
+
+
+def main() -> None:
+    if os.environ.get(_CHILD_MARK) == "1":
+        _run_workload()
+        return
+
+    _warn_strays()
+    child_env = dict(os.environ)
+    child_env[_CHILD_MARK] = "1"
+
+    result = None
+    for attempt in range(_MAX_ATTEMPTS):
+        if attempt:
+            backoff = 30 * attempt
+            _log(f"retrying in {backoff}s (attempt {attempt + 1}/{_MAX_ATTEMPTS})")
+            time.sleep(backoff)
+        if not _probe_backend():
+            continue
+        result = _run_child(child_env)
+        if result is not None:
+            break
+
+    if result is None:
+        _log("TPU unavailable after all attempts; falling back to virtual CPU")
+        cpu_env = dict(child_env)
+        cpu_env["PALLAS_AXON_POOL_IPS"] = ""   # skip axon relay registration
+        cpu_env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(f for f in cpu_env.get("XLA_FLAGS", "").split()
+                         if not f.startswith("--xla_force_host_platform_device_count"))
+        cpu_env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        result = _run_child(cpu_env, timeout=900)
+
+    if result is None:
+        raise SystemExit("bench failed on TPU and on CPU fallback")
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,141 @@
+"""Compressed data-parallel gradient synchronization.
+
+TPU-native analog of the reference's compressed collectives:
+
+- ``int8`` mode = ZeRO++ qgZ (``runtime/zero/config.py:268``
+  ``zero_quantized_gradients``; ``runtime/comm/coalesced_collectives.py:31``
+  quantized reduce-scatter): blockwise-int8 all-to-all, local reduction,
+  blockwise-int8 all-gather — 4x fewer bytes on the wire than fp32.
+- ``onebit`` mode = 1-bit Adam's error-feedback sign compression
+  (``runtime/comm/nccl.py:51`` ``compressed_allreduce``): worker-side
+  sign+scale with a worker error residual, all-to-all, server-side average
+  re-compressed with a server error residual, all-gather. Signs travel
+  bit-packed (8 signs/byte) — ~16x fewer bytes than bf16.
+
+These run *inside* a ``shard_map`` body whose ``data`` axis is manual: the
+engine computes per-rank local gradients there, calls one of these to
+complete the cross-data reduction explicitly, and XLA lowers the collectives
+onto ICI/DCN. The hierarchy falls out of the mesh: the fast ``zero``/
+``expert`` sub-axes stay GSPMD-managed (full-precision, ICI-local) and only
+the slow ``data`` hop is compressed — the reference's 2-hop qgZ design.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.quant import quant_blocks as _quant_blocks
+
+BLOCK = 2048  # elements per quantization scale
+
+
+# ------------------------------------------------------------------ flatten
+def flat_size(tree_or_shapes) -> int:
+    leaves = jax.tree.leaves(tree_or_shapes)
+    return int(sum(int(np.prod(getattr(l, "shape", l))) for l in leaves))
+
+
+def flatten_tree(tree):
+    """Pytree → (flat fp32 vector, unflatten closure)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+
+    def unflatten(v):
+        parts = jnp.split(v, np.cumsum(sizes)[:-1]) if len(sizes) > 1 else [v]
+        return jax.tree_util.tree_unflatten(
+            treedef, [p.reshape(s) for p, s in zip(parts, shapes)])
+
+    return flat, unflatten
+
+
+def chunk_elems(n: int, world: int, block: int = BLOCK) -> int:
+    """Per-rank chunk length: ceil to a whole number of scale blocks."""
+    per = -(-n // world)
+    return -(-per // block) * block
+
+
+# ------------------------------------------------------------------- int8
+
+
+def int8_allreduce_mean(flat: jax.Array, axis: str = "data",
+                        block: int = BLOCK) -> jax.Array:
+    """Mean-all-reduce of a flat fp32 vector over a *manual* mesh axis with
+    int8 payloads (qgZ). Bytes on the wire: ~N int8 for the a2a hop plus
+    ~N int8 for the gather hop, vs 2N fp32 for a ring all-reduce."""
+    world = lax.axis_size(axis)
+    if world == 1:
+        return flat
+    n = flat.shape[0]
+    per = chunk_elems(n, world, block)
+    x = jnp.pad(flat, (0, per * world - n)).reshape(world, per // block, block)
+    q, s = _quant_blocks(x)
+    # a2a: rank r keeps chunk r of every sender → reduce locally.
+    q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    s = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
+    mine = jnp.mean(q.astype(jnp.float32) * s, axis=0)        # (nb, block)
+    # second hop: re-quantize the reduced chunk and gather all chunks.
+    q2, s2 = _quant_blocks(mine)
+    qg = lax.all_gather(q2, axis, axis=0, tiled=False)         # (W, nb, block)
+    sg = lax.all_gather(s2, axis, axis=0, tiled=False)
+    return (qg.astype(jnp.float32) * sg).reshape(-1)[:n]
+
+
+# ------------------------------------------------------------------ onebit
+def _pack_signs(sign):
+    """(..., block) ±1 → (..., block/8) uint8 bitmap."""
+    bits = (sign > 0).astype(jnp.int32).reshape(sign.shape[:-1] + (-1, 8))
+    weights = jnp.asarray(1 << np.arange(8), jnp.int32)
+    return jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)
+
+
+def _unpack_signs(packed, block: int):
+    """(..., block/8) uint8 → (..., block) ±1 fp32."""
+    shifts = jnp.asarray(np.arange(8), jnp.uint8)
+    bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+    sign = bits.astype(jnp.float32) * 2.0 - 1.0
+    return sign.reshape(packed.shape[:-1] + (block // 8 * 8,))
+
+
+def onebit_allreduce_mean(flat: jax.Array, worker_err: jax.Array,
+                          server_err: jax.Array, axis: str = "data",
+                          block: int = BLOCK):
+    """Error-feedback sign-compressed mean-all-reduce (1-bit Adam's
+    ``compressed_allreduce``). Returns (reduced, new_worker_err,
+    new_server_err); both residuals must persist across steps in TrainState.
+    """
+    world = lax.axis_size(axis)
+    if world == 1:
+        return flat, worker_err, server_err
+    n = flat.shape[0]
+    per = chunk_elems(n, world, block)
+    total = per * world
+
+    comp = jnp.pad(flat, (0, total - n)) + worker_err           # (total,)
+    x = comp.reshape(world, per // block, block)
+    scale = jnp.mean(jnp.abs(x), axis=-1, keepdims=True)        # (W, nb, 1)
+    sign = jnp.where(x >= 0, 1.0, -1.0)
+    new_worker_err = (x - sign * scale).reshape(-1)             # residual
+
+    packed = _pack_signs(sign)                                  # (W, nb, b/8)
+    packed = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0, tiled=True)
+    scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
+    decoded = _unpack_signs(packed, block) * scale              # (W, nb, block)
+    mine = jnp.mean(decoded, axis=0)                            # my chunk, averaged
+
+    comp_s = mine + server_err.reshape(mine.shape)
+    scale2 = jnp.mean(jnp.abs(comp_s), axis=-1, keepdims=True)
+    sign2 = jnp.where(comp_s >= 0, 1.0, -1.0)
+    new_server_err = (comp_s - sign2 * scale2).reshape(-1)
+
+    packed2 = _pack_signs(sign2)                                # (nb, b/8)
+    pg = lax.all_gather(packed2, axis, axis=0, tiled=False)     # (W, nb, b/8)
+    sg = lax.all_gather(scale2, axis, axis=0, tiled=False)
+    reduced = (_unpack_signs(pg, block) * sg).reshape(-1)[:n]
+    return reduced, new_worker_err, new_server_err

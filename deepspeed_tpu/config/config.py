@@ -122,6 +122,9 @@ class MeshConfig(ConfigModel):
     pipe: int = 1
     seq: int = 1
     expert: int = 1
+    # hpZ/MiCS subgroup sub-axis (usually derived from zero_optimization.
+    # zero_hpz_partition_size / mics_shard_size rather than set directly).
+    zero: int = 1
 
 
 class RematConfig(ConfigModel):

@@ -123,7 +123,7 @@ class MoETransformerLM(TransformerLM):
         # 'expert' is the token all-to-all of the reference's _AllToAll
         # autograd fn (sharded_moe.py:299) — GSPMD emits it.
         xs = jnp.einsum("bsec,bsd->becd", dispatch.astype(y.dtype), y)
-        xs = constrain(xs, P("data", "expert", None, None))
+        xs = constrain(xs, P(("data", "zero"), "expert", None, None))
 
         u = jnp.einsum("becd,edf->becf", xs, p["w_in"].astype(y.dtype))
         u = self._expert_bias(u, p, "b_in")
@@ -134,10 +134,10 @@ class MoETransformerLM(TransformerLM):
             u = jax.nn.gelu(u)
         else:
             u = jax.nn.silu(u)
-        u = constrain(u, P("data", "expert", None, "model"))
+        u = constrain(u, P(("data", "zero"), "expert", None, "model"))
         out = jnp.einsum("becf,efd->becd", u, p["w_out"].astype(y.dtype))
         out = self._expert_bias(out, p, "b_out")
-        out = constrain(out, P("data", "expert", None, None))
+        out = constrain(out, P(("data", "zero"), "expert", None, None))
 
         # combine: (B,S,E,C) x (B,E,C,d) -> (B,S,d)  (the return all-to-all)
         res = jnp.einsum("bsec,becd->bsd", combine.astype(y.dtype), out)

@@ -54,7 +54,8 @@ class PipelinedTransformerLM(TransformerLM):
     """
 
     def __init__(self, config: TransformerConfig, n_stages: int,
-                 num_micro: int | None = None, attention_fn=None):
+                 num_micro: int | None = None, attention_fn=None,
+                 tick_remat: bool = False):
         super().__init__(config, attention_fn)
         assert config.n_layer % n_stages == 0, (
             f"n_layer {config.n_layer} not divisible by {n_stages} stages")
@@ -62,6 +63,11 @@ class PipelinedTransformerLM(TransformerLM):
         self.n_stages = n_stages
         # Default 2 microbatches per stage: bubble fraction (P-1)/(M+P-1).
         self.num_micro = num_micro or 2 * n_stages
+        # tick_remat: checkpoint each pipeline tick — backward recomputes the
+        # tick forward from its (Bm,S,d) input, so live activation memory is
+        # O(in-flight microbatch inputs) like the reference's 1F1B window
+        # (pipe/schedule.py:189) instead of O(M) full per-tick residuals.
+        self.tick_remat = tick_remat
 
     def param_specs(self) -> dict:
         specs = super().param_specs()
@@ -73,6 +79,21 @@ class PipelinedTransformerLM(TransformerLM):
 
     # ------------------------------------------------------------- schedule
     def _pipeline_body(self, prm, ids_mb, lm_mb, am_mb, *, remat_policy):
+        """One compiled pipeline schedule: M + P - 1 ticks.
+
+        Efficiency structure (vs the naive all-stage head):
+        - **vocab-sharded head**: each drained microbatch's unembedding runs
+          with the vocab dim split over ``pipe`` — every stage computes a
+          V/P logit slice and the cross-entropy is assembled with two scalar
+          psums (max / sum-exp) + a psum'd target-logit lookup. Head FLOPs
+          per stage drop P-fold; no stage computes the full vocab matmul.
+        - **in-scan loss**: the drained tick's loss is accumulated in the
+          scan carry, so no (M, Bm, S, d) activation stash survives the
+          scan — live memory is the carry plus per-tick residuals
+          (O(P)-class with ``tick_remat``).
+        - **embeddings precomputed once** for all M microbatches instead of
+          re-gathered on every one of the T ticks by every stage.
+        """
         cfg = self.cfg
         Pn, M = self.n_stages, self.num_micro
         p = lax.axis_index("pipe")
@@ -83,33 +104,82 @@ class PipelinedTransformerLM(TransformerLM):
         T = M + Pn - 1
         perm = [(i, i + 1) for i in range(Pn - 1)]    # non-cyclic shift fwd
 
-        def tick(x_recv, t):
+        # ---- embeddings once, not per tick
+        emb_all, positions_all = self._embed(prm, ids_mb.reshape(M * Bm, S))
+        emb_all = emb_all.reshape(M, Bm, S, cfg.d_model)
+        positions = positions_all[:Bm]
+
+        # ---- vocab-sharded unembedding slice for this stage
+        V = cfg.vocab_size
+        Vp = -(-V // Pn)                              # padded per-stage chunk
+        W = (prm["tok_embed"].astype(cfg.dtype).T if cfg.tie_embeddings
+             else prm["lm_head"].astype(cfg.dtype))   # (d, V)
+        Wpad = jnp.pad(W, ((0, 0), (0, Pn * Vp - V)))
+        Wl = lax.dynamic_slice_in_dim(Wpad, p * Vp, Vp, axis=1)  # (d, Vp)
+        v0 = p * Vp
+
+        def micro_loss(y, d_i):
+            """CE of one drained microbatch; y is last-stage output,
+            broadcast so all stages share the vocab-sharded matmul."""
+            y_bc = lax.psum(jnp.where(is_last, y, jnp.zeros_like(y)), "pipe")
+            z = self._head_norm(prm, y_bc)
+            logits_l = (z @ Wl).astype(jnp.float32)   # (Bm, S, Vp)
+            # padded vocab tail must not win the max / contribute to sum-exp
+            col = jnp.arange(Vp) + v0
+            logits_l = jnp.where(col[None, None, :] < V, logits_l,
+                                 jnp.float32(jnp.finfo(jnp.float32).min))
+            # stability max only — gradient stopped (pmax has no VJP; the
+            # log-sum-exp derivative is exact with the max held constant)
+            mx = lax.stop_gradient(
+                lax.pmax(jnp.max(logits_l, axis=-1), "pipe"))        # (Bm,S)
+            se = lax.psum(jnp.sum(jnp.exp(logits_l - mx[..., None]),
+                                  axis=-1), "pipe")                  # (Bm,S)
+            ids_d = lax.dynamic_index_in_dim(ids_mb, d_i, 0, keepdims=False)
+            w_d = lax.dynamic_index_in_dim(lm_mb, d_i, 0,
+                                           keepdims=False)[:, 1:]
+            tgt = ids_d[:, 1:]                                       # (Bm,S-1)
+            in_range = (tgt >= v0) & (tgt < v0 + Vp)
+            idx = jnp.clip(tgt - v0, 0, Vp - 1)
+            tl_local = jnp.take_along_axis(logits_l[:, :-1], idx[..., None],
+                                           axis=-1)[..., 0]
+            wf = w_d.astype(jnp.float32)
+            # Per-stage PARTIAL of sum(nll * w): each stage contributes its
+            # vocab chunk's target logits; stage 0 alone adds the (already
+            # globally-reduced) max/log-sum-exp term. One psum at schedule
+            # end assembles the total — and keeps the output provably
+            # replicated for shard_map's vma check.
+            part = -jnp.sum(jnp.where(in_range, tl_local, 0.0) * wf)
+            part += jnp.where(
+                is_first,
+                jnp.sum((mx[:, :-1] + jnp.log(se[:, :-1])) * wf), 0.0)
+            tok_part = jnp.where(is_first, jnp.sum(wf), 0.0)
+            return part, tok_part
+
+        def tick(carry, t):
+            x_recv, loss_acc, tok_acc = carry
             mb_i = jnp.clip(t, 0, M - 1)
-            mb_ids = lax.dynamic_index_in_dim(ids_mb, mb_i, 0, keepdims=False)
+            emb = lax.dynamic_index_in_dim(emb_all, mb_i, 0, keepdims=False)
             mb_am = (lax.dynamic_index_in_dim(am_mb, mb_i, 0, keepdims=False)
                      if am_mb is not None else None)
-            emb, positions = self._embed(prm, mb_ids)
             x_in = jnp.where(is_first, emb, x_recv)
             y, _aux = self._scan_layers(x_in, layers_local, positions, mb_am,
                                         remat_policy)
+            d_i = jnp.clip(t - (Pn - 1), 0, M - 1)    # drained micro index
+            valid = (t >= Pn - 1).astype(jnp.float32)
+            m_loss, m_tok = micro_loss(y, d_i)
             x_send = lax.ppermute(y, "pipe", perm)
-            return x_send, y
+            return (x_send, loss_acc + valid * m_loss,
+                    tok_acc + valid * m_tok), None
 
+        if self.tick_remat:
+            tick = jax.checkpoint(tick, prevent_cse=False)
         x0 = lax.pcast(jnp.zeros((Bm, S, cfg.d_model), cfg.dtype),
                        ("pipe",), to="varying")
-        _, ys = lax.scan(tick, x0, jnp.arange(T))
-        ys_out = ys[Pn - 1:]                          # (M, Bm, S, d) drained
-
-        logits = self._head(prm, ys_out.reshape(M * Bm, S, cfg.d_model))
-        ids_flat = ids_mb.reshape(M * Bm, S)
-        targets = ids_flat[:, 1:]
-        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        w = lm_mb.reshape(M * Bm, S)[:, 1:].astype(jnp.float32)
-        # Only the last stage drained real activations; everything else is
-        # bubble garbage — masked out by the select, then summed over pipe.
-        loss_sum = lax.psum(jnp.where(is_last, jnp.sum(nll * w), 0.0), "pipe")
-        tok_sum = lax.psum(jnp.where(is_last, jnp.sum(w), 0.0), "pipe")
+        zero = lax.pcast(jnp.float32(0.0), ("pipe",), to="varying")
+        (_, loss_part, tok_part), _ = lax.scan(tick, (x0, zero, zero),
+                                               jnp.arange(T))
+        loss_sum = lax.psum(loss_part, "pipe")
+        tok_sum = lax.psum(tok_part, "pipe")
         return loss_sum / jnp.maximum(tok_sum, 1.0)
 
     # ----------------------------------------------------------------- loss

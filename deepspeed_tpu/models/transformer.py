@@ -33,7 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..platform.mesh import BATCH_AXES, constrain
 
-B_AXES = BATCH_AXES  # ("data", "expert")
+B_AXES = BATCH_AXES  # ("data", "zero", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -337,22 +337,46 @@ class TransformerLM:
         """Scan the (remat-wrapped) layer body over a stacked layer pytree.
 
         ``layers`` may be the full stack or (under pipeline shard_map) the
-        local stage's slice. Returns (x, summed aux losses)."""
+        local stage's slice. Returns (x, summed aux losses).
+
+        When ``self.params_on_host`` is set (ZeRO-Infinity param offload,
+        reference ``runtime/swap_tensor/partitioned_param_swapper.py:36``),
+        the stacked weights live in pinned host memory and each scan step
+        copies its layer slice into device HBM right before use — XLA's
+        latency-hiding scheduler overlaps the next slice's DMA with the
+        current layer's compute, so HBM only ever holds ~2 layers of weights.
+        """
         body = partial(self._layer, positions=positions, attn_mask=attn_mask)
         if remat_policy is not None:
             body = jax.checkpoint(body, policy=remat_policy, prevent_cse=False)
+        stream = getattr(self, "params_on_host", False)
+        if stream:
+            from ..platform.mesh import to_device_memory
+
+            specs = self.param_specs()["layers"]
+            slice_specs = jax.tree.map(
+                lambda s: P(*tuple(s)[1:]), specs,
+                is_leaf=lambda x: isinstance(x, P))
 
         def scan_fn(carry, layer_params):
+            if stream:
+                layer_params = to_device_memory(layer_params, slice_specs)
             new_x, aux = body(carry, layer_params)
             return new_x, aux
 
         x, aux_losses = lax.scan(scan_fn, x, layers)
         return x, jnp.sum(aux_losses)
 
+    def _head_norm(self, params, x):
+        """Final layernorm only (the pipeline's vocab-sharded head applies
+        its own unembedding slice)."""
+        return _norm(x, params["lnf_scale"], params.get("lnf_bias"),
+                     self.cfg.norm)
+
     def _head(self, params, x):
         """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
         cfg = self.cfg
-        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm)
+        x = self._head_norm(params, x)
         if cfg.tie_embeddings:
             logits = x @ params["tok_embed"].astype(x.dtype).T
         else:

@@ -1,0 +1,58 @@
+"""Symmetric int8 block quantization used by the quantized collectives.
+
+TPU-native analog of the reference's fused quantizer kernels
+(``csrc/quantization/pt_binding.cpp``, ``deepspeed/ops/quantizer``) as they
+are used by ZeRO++ (``runtime/zero/config.py:256``: ``zero_quantized_weights``
+/ ``zero_quantized_gradients``) and the compressed-collective path
+(``runtime/comm/coalesced_collectives.py:31``). Pure XLA: the quant/dequant
+elementwise chains fuse into the surrounding program; the payoff is that the
+*collective* (all-gather / all-to-all) moves int8 bytes instead of bf16/fp32.
+
+Scales are per-row (last dim) for weight gathers and per-chunk-block for
+gradient reduction — matching the reference's groupwise symmetric scheme.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rowwise_quant_int8(x: jax.Array):
+    """Symmetric per-row int8: scale over the last dim. Returns (q, scale)
+    with ``scale`` shaped ``x.shape[:-1] + (1,)`` in fp32."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def rowwise_dequant(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quant_blocks(xb: jax.Array):
+    """(..., block) fp32 → symmetric int8 + per-block fp32 scale (last dim
+    is the scale group). The shared core of the weight-gather (qwZ) and
+    gradient (qgZ/1-bit) quantizers."""
+    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def blockwise_quant_int8(x: jax.Array, block: int = 2048):
+    """Symmetric int8 over a flat vector with one fp32 scale per ``block``
+    elements (pads internally; callers pass already-padded sizes)."""
+    n = x.shape[-1]
+    pad = (-n) % block
+    xf = jnp.pad(x.astype(jnp.float32), [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xb = xf.reshape(x.shape[:-1] + (-1, block))
+    return quant_blocks(xb)
+
+
+def blockwise_dequant(q: jax.Array, scale: jax.Array, n: int,
+                      dtype=jnp.float32):
+    xb = q.astype(jnp.float32) * scale
+    flat = xb.reshape(xb.shape[:-2] + (-1,))
+    return flat[..., :n].astype(dtype)

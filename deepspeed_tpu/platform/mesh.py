@@ -36,7 +36,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..utils.logging import logger
 
 # Canonical axis order, outermost (DCN-friendly) to innermost (ICI-friendly).
-AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+# ``zero`` is the hpZ/MiCS sub-axis: a fast-ICI subgroup carved out of the
+# data-parallel dimension (total DP world = data x zero). It sits inside
+# ``data`` so its collectives ride the tighter interconnect — the 2-level
+# hierarchy the reference builds by hand for ZeRO++ hpZ secondary shards
+# (runtime/zero/config.py:256) and MiCS sub-groups (runtime/zero/mics.py:55).
+AXIS_ORDER = ("pipe", "data", "zero", "expert", "seq", "model")
 
 # Axes that partition *examples* (the batch dim): DP, and expert-parallel
 # groups, which are carved out of the DP group in the reference
@@ -45,7 +50,7 @@ AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
 # gradient reduction spans data x expert x seq — the reference's "ZeRO dp
 # group becomes seq x dp" wiring (engine.py:1116-1122) falls out of XLA's
 # partial-sum handling automatically.
-BATCH_AXES = ("data", "expert")
+BATCH_AXES = ("data", "zero", "expert")
 SEQ_AXIS = "seq"
 
 
@@ -58,10 +63,11 @@ class MeshSpec:
     pipe: int = 1
     seq: int = 1
     expert: int = 1
+    zero: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
-        sizes = {"pipe": self.pipe, "data": self.data, "expert": self.expert,
-                 "seq": self.seq, "model": self.model}
+        sizes = {"pipe": self.pipe, "data": self.data, "zero": self.zero,
+                 "expert": self.expert, "seq": self.seq, "model": self.model}
         fixed = int(np.prod([v for v in sizes.values() if v != -1]))
         n_auto = sum(1 for v in sizes.values() if v == -1)
         if n_auto > 1:
@@ -147,20 +153,49 @@ def current_mesh():
 def constrain(x, *spec_or_pspec):
     """``with_sharding_constraint`` that no-ops when no mesh is in context
     (single-chip / un-meshed execution) and ignores axes the context mesh
-    doesn't carry. Models use this so the same code runs on a bare chip and
-    on any parallel mesh."""
-    ctx = current_mesh()
-    if ctx is None:
+    doesn't carry — or that are *manual* in the current ``shard_map`` body
+    (the caller already holds a per-device block of those). Models use this
+    so the same code runs on a bare chip, on any parallel mesh, and inside
+    partially-manual shard_maps (e.g. the compressed-gradient data axis)."""
+    if current_mesh() is None:
         return x
     spec = spec_or_pspec[0] if len(spec_or_pspec) == 1 and isinstance(
         spec_or_pspec[0], PartitionSpec) else PartitionSpec(*spec_or_pspec)
+    return jax.lax.with_sharding_constraint(x, filter_spec(spec))
+
+
+def filter_spec(spec: PartitionSpec) -> PartitionSpec:
+    """Drop axes the context mesh doesn't carry or that are manual."""
+    ctx = current_mesh()
+    if ctx is None:
+        return spec
+    manual = getattr(ctx, "manual_axes", frozenset())
 
     def filter_entry(e):
         if e is None:
             return None
         names = e if isinstance(e, (tuple, list)) else (e,)
-        kept = tuple(n for n in names if n in ctx.axis_names)
+        kept = tuple(n for n in names
+                     if n in ctx.axis_names and n not in manual)
         return kept if len(kept) > 1 else (kept[0] if kept else None)
 
-    spec = PartitionSpec(*(filter_entry(e) for e in spec))
-    return jax.lax.with_sharding_constraint(x, spec)
+    return PartitionSpec(*(filter_entry(e) for e in spec))
+
+
+def to_device_memory(tree, spec_tree=None):
+    """Copy a (host-memory-resident) pytree into device HBM inside jit —
+    the per-layer page-in of ZeRO-Infinity param offload. No-op outside a
+    mesh context. ``spec_tree`` preserves each leaf's sharding across the
+    memory-space move (device_put needs an explicit sharding in-jit)."""
+    ctx = current_mesh()
+    if ctx is None:
+        return tree
+
+    def put(x, spec):
+        spec = filter_spec(spec if isinstance(spec, PartitionSpec)
+                           else PartitionSpec())
+        return jax.device_put(x, NamedSharding(ctx, spec, memory_kind="device"))
+
+    if spec_tree is None:
+        return jax.tree.map(lambda x: put(x, None), tree)
+    return jax.tree.map(put, tree, spec_tree)

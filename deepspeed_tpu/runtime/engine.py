@@ -45,6 +45,10 @@ class TrainState(NamedTuple):
     opt_state: OptState            # same sharding as master
     loss_scale: LossScaleState
     skipped_steps: jnp.ndarray     # i32 (fp16 overflow skips)
+    # 1-bit compression error-feedback residuals (worker/server), per data
+    # rank (reference runtime/fp16/onebit/adam.py worker_error/server_error);
+    # () when compression is off.
+    comm_err: Any = ()
 
 
 def _remat_policy(cfg: Config):
@@ -77,9 +81,7 @@ class Engine:
         self.model = model
         self.acc = get_accelerator()
         m = self.config.mesh
-        self.mesh = mesh or build_mesh(MeshSpec(data=m.data, model=m.model,
-                                                pipe=m.pipe, seq=m.seq,
-                                                expert=m.expert))
+        self.mesh = mesh or build_mesh(self._mesh_spec(m))
         self.dp_world = dp_world_size(self.mesh)
         self.config = self.config.resolve_batch_sizes(self.dp_world)
         self.seed = self.config.seed if seed is None else seed
@@ -87,6 +89,16 @@ class Engine:
         zcfg = self.config.zero_optimization
         self.offload = False
         self.partitioner = ZeroPartitioner(zcfg, self.mesh)
+        gc = self.config.gradient_compression
+        self.grad_comp: Optional[str] = (
+            gc.type if gc.enabled
+            else ("int8" if zcfg.zero_quantized_gradients else None))
+        if self.grad_comp and zcfg.stage >= 3 \
+                and not (self.partitioner.hpz or self.partitioner.mics):
+            raise ValueError(
+                "gradient compression (qgZ / 1-bit) under ZeRO-3 requires "
+                "zero_hpz_partition_size > 1 or mics_shard_size > 0: compute "
+                "params must not be sharded over the compressed 'data' axis")
         self.optimizer: Optimizer = build_optimizer(self.config.optimizer.type,
                                                     self.config.optimizer.params)
         base_lr = float(self.config.optimizer.params.get("lr", 1e-3))
@@ -100,6 +112,7 @@ class Engine:
         rng = jax.random.PRNGKey(self.seed)
         abstract = jax.eval_shape(self.model.init, rng)
         shapes = jax.tree.map(lambda a: a.shape, abstract)
+        self._shapes = shapes
         model_specs = self.model.param_specs()
         stacked = self.model.stacked_fn() if hasattr(self.model, "stacked_fn") else (lambda s: False)
         self.compute_specs = self.partitioner.compute_specs(model_specs, shapes, stacked)
@@ -118,6 +131,19 @@ class Engine:
         # ---------------- ZeRO-Offload / Infinity: host-resident optimizer
         zoff = zcfg.offload_optimizer
         self.offload = zoff.device in ("cpu", "nvme")
+        self.param_offload = False
+        if zcfg.offload_param.enabled and not self.offload:
+            raise ValueError(
+                "offload_param requires offload_optimizer device cpu/nvme: "
+                "ZeRO-Infinity param streaming operates against the "
+                "host-resident optimizer (set zero_optimization."
+                "offload_optimizer.device)")
+        if self.grad_comp and self.offload:
+            raise ValueError(
+                "gradient_compression / zero_quantized_gradients is not "
+                "supported with offload_optimizer (the host-optimizer path "
+                "syncs gradients outside the compressed collective); disable "
+                "one of the two")
         if self.offload:
             self._init_offload(rng, zoff)
             self._post_init()
@@ -125,6 +151,15 @@ class Engine:
 
         # ---------------- init state (sharded at construction: the zero.Init
         # analog — params are born partitioned, never materialized replicated)
+        self._comm_err_shapes = {}
+        if self.grad_comp == "onebit":
+            from ..comm.compressed import chunk_elems
+
+            D = int(self.mesh.shape["data"])
+            per = chunk_elems(self.param_count, D)
+            self._comm_err_shapes = {"worker": (D, per * D), "server": (D, per)}
+        comm_err_shardings = {k: NamedSharding(self.mesh, P("data"))
+                              for k in self._comm_err_shapes}
         self.state_shardings = TrainState(
             step=NamedSharding(self.mesh, P()),
             master_params=self.master_shardings,
@@ -132,6 +167,7 @@ class Engine:
                                count=NamedSharding(self.mesh, P())),
             loss_scale=LossScaleState(*(NamedSharding(self.mesh, P()),) * 3),
             skipped_steps=NamedSharding(self.mesh, P()),
+            comm_err=comm_err_shardings,
         )
         with self.mesh:
             init_fn = jax.jit(self._init_state, out_shardings=self.state_shardings)
@@ -151,6 +187,44 @@ class Engine:
                                   in_shardings=(self.state_shardings.master_params,
                                                 self._batch_sharding(gas_dim=False)))
         self._post_init()
+
+    def _mesh_spec(self, m) -> MeshSpec:
+        """Resolve the ``zero`` sub-axis (ZeRO++ hpZ / MiCS subgroup) from the
+        zero config. An explicit ``mesh.data`` is the TOTAL data-parallel
+        degree; the subgroup is carved out of it (data = total / zero)."""
+        zc = self.config.zero_optimization
+        hpz = int(zc.zero_hpz_partition_size)
+        mics = int(zc.mics_shard_size or 0)
+        if hpz > 1 and mics > 0 and hpz != mics:
+            raise ValueError(
+                f"zero_hpz_partition_size ({hpz}) and mics_shard_size ({mics}) "
+                "both set but disagree; they share the mesh 'zero' sub-axis")
+        mzero = int(getattr(m, "zero", 1) or 1)
+        if mzero < 1:
+            raise ValueError(
+                "mesh.zero cannot be auto (-1): the hpZ/MiCS subgroup size "
+                "must be explicit (zero_hpz_partition_size / mics_shard_size)")
+        want = hpz if hpz > 1 else (mics if mics > 0 else 1)
+        if mzero > 1 and want > 1 and mzero != want:
+            raise ValueError(
+                f"mesh.zero ({mzero}) conflicts with the configured "
+                f"hpZ/MiCS subgroup size ({want})")
+        zsize = max(mzero, want)
+        if zc.zero_quantized_weights and hpz <= 1:
+            raise ValueError(
+                "zero_quantized_weights needs a cross-subgroup weight gather "
+                "to quantize: set zero_optimization.zero_hpz_partition_size "
+                "> 1 (under MiCS or a bare mesh.zero the master shard never "
+                "spans 'data', so there is no gather to compress)")
+        data = m.data
+        if data != -1 and zsize > 1:
+            if data % zsize != 0:
+                raise ValueError(
+                    f"data-parallel degree {data} not divisible by "
+                    f"hpZ/MiCS subgroup size {zsize}")
+            data //= zsize
+        return MeshSpec(data=data, model=m.model, pipe=m.pipe, seq=m.seq,
+                        expert=m.expert, zero=zsize)
 
     def _post_init(self):
         self.timers = WallClockTimers()
@@ -176,6 +250,33 @@ class Engine:
 
         assert not self.config.fp16.enabled, \
             "offload_optimizer requires bf16/fp32 (no dynamic loss scaling)"
+
+        # ZeRO-Infinity param offload: the bf16 compute copy lives in pinned
+        # host memory; the model streams each layer's slice into HBM inside
+        # the scan (reference partitioned_param_swapper.py:36 +
+        # parameter_offload.py:342). HBM never holds the full model.
+        zoff_param = self.config.zero_optimization.offload_param
+        self.param_offload = zoff_param.enabled
+        if self.param_offload:
+            self.model.params_on_host = True
+            on_tpu = self.acc.current_device().platform == "tpu"
+            if on_tpu:
+                stacked = (self.model.stacked_fn()
+                           if hasattr(self.model, "stacked_fn")
+                           else (lambda s: False))
+                thresh = int(self.config.zero_optimization
+                             .param_persistence_threshold or 0)
+                self.compute_shardings = jax.tree.map(
+                    lambda sh, shp: (NamedSharding(
+                        self.mesh, sh.spec, memory_kind="pinned_host")
+                        if stacked(shp) and int(np.prod(shp)) >= thresh
+                        else sh),
+                    self.compute_shardings, self._shapes)
+            else:
+                log_dist("offload_param: non-TPU platform — params stay in "
+                         "(host-backed) device memory; streaming is inert",
+                         ranks=[0])
+
         with self.mesh:
             init_params = jax.jit(self._init_master)(rng)
         host_master = jax.tree.map(np.asarray, init_params)
@@ -204,25 +305,7 @@ class Engine:
 
     def _grad_step_impl(self, compute_params, batch):
         """Forward+backward only — the update happens on the host."""
-        cfg = self.config
-        gas = int(cfg.gradient_accumulation_steps)
-
-        def loss_fn(cp, mb):
-            return self.model.loss(cp, mb, remat_policy=self.remat_policy) / gas
-
-        grad_fn = jax.value_and_grad(loss_fn)
-        acc_dtype = jnp.dtype(cfg.data_types.grad_accum_dtype or "float32")
-
-        def gas_body(carry, mb):
-            g_acc, loss_acc = carry
-            loss, g = grad_fn(compute_params, mb)
-            g_acc = jax.tree.map(lambda a, gg: a + gg.astype(acc_dtype), g_acc, g)
-            return (g_acc, loss_acc + loss), None
-
-        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype),
-                                  compute_params)
-        (grads, loss), _ = lax.scan(gas_body, (zero_grads, jnp.float32(0.0)),
-                                    batch)
+        grads, loss = self._gas_scan(compute_params, batch, jnp.float32(1.0))
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                              for g in jax.tree.leaves(grads)))
@@ -273,6 +356,8 @@ class Engine:
             opt_state=self.optimizer.init(master),
             loss_scale=init_loss_scale(self.config.fp16),
             skipped_steps=jnp.zeros((), jnp.int32),
+            comm_err={k: jnp.zeros(s, jnp.float32)
+                      for k, s in self._comm_err_shapes.items()},
         )
 
     def _fix_empty_moment_shardings(self):
@@ -288,24 +373,60 @@ class Engine:
                                count=self.state_shardings.opt_state.count))
 
     # ------------------------------------------------------------- train step
+    @staticmethod
+    def _spec_has(spec, axis: str) -> bool:
+        if not isinstance(spec, P):
+            return False
+        for e in spec:
+            names = e if isinstance(e, (tuple, list)) else (e,)
+            if axis in names:
+                return True
+        return False
+
     def _cast_compute(self, master):
         """bf16/fp16 compute cast; leaves named in the model's
-        ``fp32_param_names()`` (e.g. MoE routers) stay fp32."""
+        ``fp32_param_names()`` (e.g. MoE routers) stay fp32.
+
+        With ZeRO++ qwZ (``zero_quantized_weights`` + hpZ), leaves whose
+        secondary (compute) shard drops the ``data`` axis are gathered as
+        int8 + per-row scales instead of bf16 — the cross-subgroup weight
+        all-gather moves 2x fewer bytes (4x vs fp32), the TPU shape of the
+        reference's quantized weight gather
+        (``runtime/zero/partition_parameters.py:1032``)."""
         keep = set(getattr(self.model, "fp32_param_names", lambda: ())())
+        qwz = (self.config.zero_optimization.zero_quantized_weights
+               and self.partitioner.hpz)
 
-        def cast(path, p):
+        def cast(path, p, mspec, cspec):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-            return p if name in keep else p.astype(self.compute_dtype)
+            if name in keep:
+                return p
+            if qwz and self._spec_has(mspec, "data") \
+                    and not self._spec_has(cspec, "data"):
+                from ..ops.quant import rowwise_dequant, rowwise_quant_int8
 
-        cp = jax.tree_util.tree_map_with_path(cast, master)
+                q, s = rowwise_quant_int8(p)
+                # Pin the int8 payload (and scales) to the secondary-shard
+                # sharding: GSPMD emits the 'data'-axis all-gather on int8.
+                q = jax.lax.with_sharding_constraint(q, cspec)
+                s = jax.lax.with_sharding_constraint(
+                    s, P(*(tuple(cspec)[:p.ndim - 1] if len(tuple(cspec))
+                           else ()), None))
+                return rowwise_dequant(q, s, self.compute_dtype)
+            return p.astype(self.compute_dtype)
+
+        cp = jax.tree_util.tree_map_with_path(cast, master, self.master_specs,
+                                              self.compute_specs)
         return jax.lax.with_sharding_constraint(cp, self.compute_specs)
 
-    def _train_step_impl(self, state: TrainState, batch: dict):
+    def _gas_scan(self, compute_params, batch, scale, vary_axes=()):
+        """Gradient-accumulation scan: (params, (gas, B, ...) batch) →
+        (summed grads, mean loss). Runs either directly under jit (GSPMD
+        inserts the cross-data grad reduction) or inside the manual-data
+        shard_map of the compressed path (no data reduction inserted;
+        ``vary_axes`` marks the carry as device-varying over those axes)."""
         cfg = self.config
         gas = int(cfg.gradient_accumulation_steps)
-        scale = state.loss_scale.scale
-
-        compute_params = self._cast_compute(state.master_params)
 
         def loss_fn(cp, mb):
             loss = self.model.loss(cp, mb, remat_policy=self.remat_policy)
@@ -320,17 +441,81 @@ class Engine:
             g_acc = jax.tree.map(lambda a, gg: a + gg.astype(acc_dtype), g_acc, g)
             return (g_acc, loss_acc + scaled_loss / scale), None
 
-        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), compute_params)
-        (grads, loss), _ = lax.scan(gas_body, (zero_grads, jnp.float32(0.0)), batch)
+        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype),
+                                  compute_params)
+        carry = (zero_grads, jnp.float32(0.0))
+        if vary_axes:
+            carry = jax.tree.map(lambda t: lax.pvary(t, vary_axes), carry)
+        (grads, loss), _ = lax.scan(gas_body, carry, batch)
+        return grads, loss
+
+    def _compressed_grads(self, compute_params, batch, scale, comm_err):
+        """Per-rank local grads under a manual-``data`` shard_map + explicit
+        compressed all-reduce (qgZ int8 / 1-bit error feedback). The fast
+        sub-axes (zero/expert/seq/model) stay GSPMD-managed inside — only the
+        slow data hop moves compressed bytes."""
+        from ..comm.compressed import (flatten_tree, int8_allreduce_mean,
+                                       onebit_allreduce_mean)
+
+        D = int(self.mesh.shape["data"])
+        mode = self.grad_comp
+
+        def body(cp, b, ce):
+            grads, loss = self._gas_scan(cp, b, scale, vary_axes=("data",))
+            flat, unflatten = flatten_tree(grads)
+            # Unscale BEFORE compressing so the error-feedback residuals are
+            # stored in true gradient units — otherwise a dynamic loss-scale
+            # change would leave stale residuals off by the scale ratio.
+            flat = flat / scale
+            if D > 1 and mode == "onebit":
+                red, nw, ns = onebit_allreduce_mean(
+                    flat, ce["worker"][0], ce["server"][0], "data")
+                ce = {"worker": nw[None], "server": ns[None]}
+            elif D > 1:
+                red = int8_allreduce_mean(flat, "data")
+            else:
+                red = flat
+            loss = lax.pmean(loss, "data")
+            return unflatten(red), loss, ce
+
+        # check_vma=False: grads/loss really are replicated over 'data' (they
+        # come out of an all-gather of identical chunks + a pmean), but the
+        # vma inference can't prove it and would reject the P() out_specs.
+        fn = jax.shard_map(
+            body, mesh=self.mesh, axis_names=frozenset({"data"}),
+            in_specs=(P(), P(None, "data"), P("data")),
+            out_specs=(P(), P(), P("data")), check_vma=False)
+        return fn(compute_params, batch, comm_err)
+
+    def _train_step_impl(self, state: TrainState, batch: dict):
+        cfg = self.config
+        scale = state.loss_scale.scale
+
+        compute_params = self._cast_compute(state.master_params)
+
+        new_comm = state.comm_err
+        if self.grad_comp:
+            grads, loss, new_comm = self._compressed_grads(
+                compute_params, batch, scale, state.comm_err)
+        else:
+            grads, loss = self._gas_scan(compute_params, batch, scale)
 
         # ZeRO >= 2: constrain grads to the master (partitioned) sharding so the
-        # cross-data reduction lowers to reduce-scatter, not all-reduce.
+        # cross-data reduction lowers to reduce-scatter, not all-reduce (in the
+        # compressed path the reduction already happened; this slices locally).
         grad_specs = self.partitioner.grad_spec_tree(self.master_specs)
         if grad_specs is not None:
             grads = jax.lax.with_sharding_constraint(grads, grad_specs)
 
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+        if self.grad_comp:  # compressed path already unscaled inside
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        else:
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
         finite = grads_finite(grads) if cfg.fp16.enabled else jnp.bool_(True)
+        # Never let an overflow step poison the error-feedback residuals.
+        if self.grad_comp and self._comm_err_shapes:
+            new_comm = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
+                                    new_comm, state.comm_err)
 
         # gradient clipping (reference engine gradient_clipping / global norm)
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
@@ -361,6 +546,7 @@ class Engine:
             opt_state=new_opt,
             loss_scale=new_ls,
             skipped_steps=state.skipped_steps + skipped,
+            comm_err=new_comm,
         )
         metrics = {"loss": loss, "grad_norm": gnorm, "lr": lr,
                    "loss_scale": scale, "skipped": skipped}

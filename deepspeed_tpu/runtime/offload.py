@@ -83,15 +83,23 @@ class HostOffloadOptimizer:
             n_slots = 2
             self._slot_m = [np.zeros(max_n, np.float32) for _ in range(n_slots)]
             self._slot_v = [np.zeros(max_n, np.float32) for _ in range(n_slots)]
+            self._slot_p = [np.zeros(max_n, np.float32) for _ in range(n_slots)]
             self._slot_write_tickets = [0] * n_slots
-            # initialize moment files to zero
+            # initialize moment files to zero; page the fp32 master to disk
+            # too (the reference swaps master fp32 in the Infinity path,
+            # swap_tensor/optimizer_utils.py) — host DRAM keeps only the
+            # bf16 staging buffers + the fp32-kept (small) leaves.
             zero_max = np.zeros(max_n, np.float32)
             for i, x in enumerate(self.master):
                 self.aio.sync_write(self._mfile(i), zero_max[:x.size])
                 if self.two_moments:
                     self.aio.sync_write(self._vfile(i), zero_max[:x.size])
-            log_dist(f"nvme offload: {len(self.master)} moment tensors in "
-                     f"{path}", ranks=[0])
+                if not self.fp32_keep[i]:
+                    self.aio.sync_write(self._pfile(i), x.reshape(-1))
+                    host_opt._f32_to_bf16_np(x.reshape(-1), self.bf16[i])
+                    self.master[i] = None  # paged out
+            log_dist(f"nvme offload: {len(self.master)} master+moment "
+                     f"tensors in {path}", ranks=[0])
 
     # ------------------------------------------------------------------ files
     def _mfile(self, i):
@@ -99,6 +107,12 @@ class HostOffloadOptimizer:
 
     def _vfile(self, i):
         return os.path.join(self.nvme_dir, f"moment2_{i}.bin")
+
+    def _pfile(self, i):
+        return os.path.join(self.nvme_dir, f"master_{i}.bin")
+
+    def _paged_master(self, i) -> bool:
+        return self.nvme and self.master[i] is None
 
     # ------------------------------------------------------------- leaf step
     def _apply_leaf(self, i, p, m, v, g, lr):
@@ -124,13 +138,21 @@ class HostOffloadOptimizer:
     def step(self, grads_tree, lr: float, clip_coef: float = 1.0):
         """Host update over all leaves; returns the new device compute tree."""
         self.count += 1
+        g_arrays = jax.tree_util.tree_leaves(grads_tree)
+        # start all device→host DMAs before the first blocking device_get
+        # (overlaps transfers with the per-leaf native updates below)
+        for g in g_arrays:
+            try:
+                g.copy_to_host_async()
+            except Exception:
+                pass
         g_leaves = [np.ascontiguousarray(
             np.asarray(jax.device_get(g), np.float32).reshape(-1))
-            for g in jax.tree_util.tree_leaves(grads_tree)]
+            for g in g_arrays]
         if clip_coef != 1.0:
             # device_get views can be read-only; clipping allocates
             g_leaves = [g * np.float32(clip_coef) for g in g_leaves]
-        n = len(self.master)
+        n = len(self.shapes)
         new_device = []
 
         if not self.nvme:
@@ -140,26 +162,30 @@ class HostOffloadOptimizer:
                 new_device.append(self._to_device(i))
             return self.treedef.unflatten(new_device)
 
-        # NVMe: double-buffered pipeline — prefetch i+1 while updating i.
+        # NVMe: double-buffered pipeline — prefetch i+1's master+moments
+        # while updating i (pipelined_optimizer_swapper.py semantics).
         read_tickets = [None] * n
         read_tickets[0] = self._prefetch(0, slot=0)
         for i in range(n):
             slot = i % 2
-            self.aio.wait(read_tickets[i])          # moments for leaf i ready
+            self.aio.wait(read_tickets[i])     # master+moments for i ready
             if i + 1 < n:
                 nxt_slot = (i + 1) % 2
                 # the next slot must have finished writing back leaf i-1
                 if self._slot_write_tickets[nxt_slot]:
                     self.aio.wait(self._slot_write_tickets[nxt_slot])
                 read_tickets[i + 1] = self._prefetch(i + 1, slot=nxt_slot)
-            sz = self.master[i].size
+            sz = int(np.prod(self.shapes[i]))
             m = self._slot_m[slot][:sz]
             v = self._slot_v[slot][:sz] if self.two_moments else None
-            p = self.master[i].reshape(-1)
+            p = (self._slot_p[slot][:sz] if self._paged_master(i)
+                 else self.master[i].reshape(-1))
             self._apply_leaf(i, p, m, v, g_leaves[i], lr)
             t = self.aio.submit_write(self._mfile(i), m)
             if self.two_moments:
                 t = self.aio.submit_write(self._vfile(i), v)
+            if self._paged_master(i):
+                t = self.aio.submit_write(self._pfile(i), p)
             self._slot_write_tickets[slot] = t
             new_device.append(self._to_device(i))
         for t in self._slot_write_tickets:
@@ -168,10 +194,12 @@ class HostOffloadOptimizer:
         return self.treedef.unflatten(new_device)
 
     def _prefetch(self, i, slot):
-        sz = self.master[i].size
+        sz = int(np.prod(self.shapes[i]))
         t = self.aio.submit_read(self._mfile(i), self._slot_m[slot][:sz])
         if self.two_moments:
             t = self.aio.submit_read(self._vfile(i), self._slot_v[slot][:sz])
+        if self._paged_master(i):
+            t = self.aio.submit_read(self._pfile(i), self._slot_p[slot][:sz])
         return t
 
     def _to_device(self, i):
@@ -186,16 +214,23 @@ class HostOffloadOptimizer:
     def device_compute_params(self):
         """Initial device compute copy from the host master."""
         out = []
-        for i in range(len(self.master)):
-            if self.fp32_keep[i]:
-                out.append(self._to_device(i))
-            else:
+        for i in range(len(self.shapes)):
+            if not self.fp32_keep[i] and not self._paged_master(i):
                 host_opt._f32_to_bf16_np(self.master[i].reshape(-1), self.bf16[i])
-                out.append(self._to_device(i))
+            # paged leaves: bf16 staging was refreshed at page-out time
+            out.append(self._to_device(i))
         return self.treedef.unflatten(out)
 
     def master_tree(self):
-        return self.treedef.unflatten([m.copy() for m in self.master])
+        leaves = []
+        for i, shape in enumerate(self.shapes):
+            if self._paged_master(i):
+                buf = np.zeros(int(np.prod(shape)), np.float32)
+                self.aio.sync_read(self._pfile(i), buf)
+                leaves.append(buf.reshape(shape))
+            else:
+                leaves.append(self.master[i].copy())
+        return self.treedef.unflatten(leaves)
 
     def moment_trees(self):
         """(m, v) host trees — NVMe moments are paged in for this call
@@ -209,7 +244,7 @@ class HostOffloadOptimizer:
             return m, v
         ms, vs = [], []
         for i, shape in enumerate(self.shapes):
-            sz = self.master[i].size
+            sz = int(np.prod(shape))
             buf = np.zeros(sz, np.float32)
             self.aio.sync_read(self._mfile(i), buf)
             ms.append(buf.reshape(shape))
@@ -225,7 +260,12 @@ class HostOffloadOptimizer:
         self.count = int(count)
         for i, (_, x) in enumerate(
                 jax.tree_util.tree_flatten_with_path(master_tree)[0]):
-            np.copyto(self.master[i], np.asarray(x, np.float32))
+            xf = np.ascontiguousarray(np.asarray(x, np.float32))
+            if self._paged_master(i):
+                self.aio.sync_write(self._pfile(i), xf.reshape(-1))
+                host_opt._f32_to_bf16_np(xf.reshape(-1), self.bf16[i])
+            else:
+                np.copyto(self.master[i], xf)
         if m_tree is not None:
             m_leaves = jax.tree_util.tree_leaves(m_tree)
             v_leaves = (jax.tree_util.tree_leaves(v_tree)

@@ -52,19 +52,22 @@ def _axis_factor(entry, mesh: Mesh) -> int:
 
 
 def add_axis_to_spec(spec: Optional[PartitionSpec], shape: tuple[int, ...],
-                     mesh: Mesh, axis: str = "data",
+                     mesh: Mesh, axis="data",
                      skip_dims: tuple[int, ...] = ()) -> PartitionSpec:
-    """Shard one more dimension of ``shape`` over ``axis``, composing with the
-    existing ``spec``. Picks the largest free (unsharded, divisible) dim;
-    falls back to stacking onto an already-sharded dim; returns ``spec``
-    unchanged (replicated w.r.t. ``axis``) if nothing divides.
+    """Shard one more dimension of ``shape`` over ``axis`` (a mesh axis name
+    or tuple of names, sharded jointly), composing with the existing ``spec``.
+    Picks the largest free (unsharded, divisible) dim; falls back to stacking
+    onto an already-sharded dim; returns ``spec`` unchanged (replicated
+    w.r.t. ``axis``) if nothing divides.
     """
-    size = mesh.shape[axis]
+    names = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    names = tuple(a for a in names if mesh.shape[a] > 1)
+    size = int(np.prod([mesh.shape[a] for a in names])) if names else 1
     if size == 1:
         return spec if spec is not None else PartitionSpec()
     entries = _spec_entries(spec, len(shape))
-    if any(axis in (e if isinstance(e, (tuple, list)) else (e,))
-           for e in entries if e is not None):
+    if any(a in (e if isinstance(e, (tuple, list)) else (e,))
+           for e in entries if e is not None for a in names):
         return PartitionSpec(*entries)
 
     # Prefer free dims, largest first (ties → later dims, which are usually
@@ -77,10 +80,10 @@ def add_axis_to_spec(spec: Optional[PartitionSpec], shape: tuple[int, ...],
         existing = _axis_factor(entries[d], mesh)
         if shape[d] % (existing * size) == 0:
             if entries[d] is None:
-                entries[d] = axis
+                entries[d] = names if len(names) > 1 else names[0]
             else:
                 prev = entries[d] if isinstance(entries[d], (tuple, list)) else (entries[d],)
-                entries[d] = tuple(prev) + (axis,)
+                entries[d] = tuple(prev) + names
             return PartitionSpec(*entries)
     return PartitionSpec(*entries)
 
@@ -100,6 +103,18 @@ class ZeroPartitioner:
         # over `data` would turn balanced all-gathers into single-owner
         # broadcasts, so they are excluded from partitioning.
         self.scan_dims = scan_dims
+        self.has_zero_axis = mesh.shape.get("zero", 1) > 1
+        self.hpz = self.has_zero_axis and int(zero_config.zero_hpz_partition_size) > 1
+        self.mics = self.has_zero_axis and int(zero_config.mics_shard_size or 0) > 0
+
+    @property
+    def dp_axes(self) -> tuple:
+        """Axes the full DP/ZeRO partition spans. Under MiCS the partition
+        group is only the ``zero`` subgroup (state replicated across groups,
+        reference runtime/zero/mics.py:55)."""
+        if self.mics:
+            return ("zero",)
+        return ("data", "zero") if self.has_zero_axis else ("data",)
 
     # ------------------------------------------------------------- per-param
     def compute_spec(self, model_spec: Optional[PartitionSpec],
@@ -111,7 +126,11 @@ class ZeroPartitioner:
         if param_size(shape) < int(self.cfg.param_persistence_threshold):
             return base
         skip = tuple(range(1 if stacked else 0))
-        return add_axis_to_spec(base, shape, self.mesh, "data", skip_dims=skip)
+        # hpZ: the secondary (compute) shard spans only the fast ``zero``
+        # subgroup, so per-layer forward all-gathers never leave it
+        # (reference ZeRO++ hpZ, partition_parameters.py:1032).
+        axes = ("zero",) if self.hpz else self.dp_axes
+        return add_axis_to_spec(base, shape, self.mesh, axes, skip_dims=skip)
 
     def master_spec(self, model_spec: Optional[PartitionSpec],
                     shape: tuple[int, ...], *, stacked: bool = False) -> PartitionSpec:
@@ -120,7 +139,8 @@ class ZeroPartitioner:
         if self.cfg.stage < 1:
             return base
         skip = tuple(range(1 if stacked else 0))
-        return add_axis_to_spec(base, shape, self.mesh, "data", skip_dims=skip)
+        return add_axis_to_spec(base, shape, self.mesh, self.dp_axes,
+                                skip_dims=skip)
 
     # ----------------------------------------------------------------- trees
     def _tree_map_specs(self, fn, model_specs, shapes, stacked_fn):

@@ -1,0 +1,140 @@
+"""Compressed data-parallel gradient sync: qgZ int8 + 1-bit error feedback.
+
+Reference: ``runtime/comm/nccl.py:51`` (compressed_allreduce with worker/
+server error feedback), ``runtime/comm/coalesced_collectives.py:31``
+(quantized reduce-scatter), ``runtime/zero/config.py:268``
+(zero_quantized_gradients). Checks: primitive accuracy vs exact mean,
+engine convergence vs uncompressed, and compiled-HLO wire-bytes reduction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm.compressed import (chunk_elems, int8_allreduce_mean,
+                                           onebit_allreduce_mean)
+from deepspeed_tpu.comm.hlo_analysis import collective_summary
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def _mesh():
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=8))
+
+
+class TestPrimitives:
+    def test_int8_close_to_exact_mean(self):
+        mesh = _mesh()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 10_000)).astype(np.float32)
+
+        fn = jax.jit(jax.shard_map(
+            lambda v: int8_allreduce_mean(v[0], "data")[None],
+            mesh=mesh, axis_names=frozenset({"data"}),
+            in_specs=P("data"), out_specs=P("data"), check_vma=False))
+        with mesh:
+            out = np.asarray(fn(x))
+        exact = x.mean(axis=0)
+        for r in range(8):
+            np.testing.assert_allclose(out[r], exact, atol=2e-2)
+
+    def test_onebit_error_feedback_converges(self):
+        """Feeding the SAME vector repeatedly with error feedback: the
+        running average of decompressed outputs converges to the true mean
+        (the unbiasing property of error feedback)."""
+        mesh = _mesh()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(8, 4096)).astype(np.float32)
+        n = 4096
+        per = chunk_elems(n, 8)
+
+        def body(v, w, s):
+            red, nw, ns = onebit_allreduce_mean(v[0], w[0], s[0], "data")
+            return red[None], nw[None], ns[None]
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, axis_names=frozenset({"data"}),
+            in_specs=(P("data"), P("data"), P("data")),
+            out_specs=(P("data"), P("data"), P("data")), check_vma=False))
+        w = np.zeros((8, per * 8), np.float32)
+        s = np.zeros((8, per), np.float32)
+        acc = np.zeros(n, np.float32)
+        exact = x.mean(axis=0)
+        corrs = []
+        with mesh:
+            for i in range(30):
+                red, w, s = fn(x, w, s)
+                acc += np.asarray(red)[0]
+                corrs.append(np.corrcoef(acc / (i + 1), exact)[0, 1])
+        # error feedback debiases over steps: correlation with the exact
+        # mean climbs monotonically-ish and ends strong
+        assert corrs[-1] > 0.97, corrs[-1]
+        assert corrs[-1] > corrs[4] > corrs[0]
+        assert np.mean(np.abs(acc / 30 - exact)) < 0.3
+
+
+def _engine(mode=None, zero=None, lr=2e-3):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": lr}},
+        "zero_optimization": {"stage": 2, **(zero or {})},
+        "mesh": {"data": 8},
+        "seed": 3,
+    }
+    if mode:
+        cfg["gradient_compression"] = {"enabled": True, "type": mode}
+    return ds.initialize(cfg, build_model(tiny_test()))
+
+
+def _batch(n=8):
+    data = random_token_dataset(n, 32, 256, learnable=True)
+    return DataLoader(data, local_batch_size=n,
+                      shuffle=False).collate_fn(data[:n])
+
+
+class TestEngine:
+    def test_convergence_matches_uncompressed(self):
+        b = _batch()
+        ref = _engine(None)
+        ref_losses = [float(ref.train_batch(b)["loss"]) for _ in range(6)]
+        for mode in ("int8", "onebit"):
+            eng = _engine(mode)
+            losses = [float(eng.train_batch(b)["loss"]) for _ in range(6)]
+            assert losses[-1] < losses[0], (mode, losses)
+            # within a loose band of the exact-gradient trajectory
+            assert abs(losses[-1] - ref_losses[-1]) < 0.35, (mode, losses,
+                                                             ref_losses)
+
+    def test_qgz_knob_enables_int8(self):
+        eng = _engine(None, zero={"zero_quantized_gradients": True})
+        assert eng.grad_comp == "int8"
+        m = eng.train_batch(_batch())
+        assert np.isfinite(m["loss"])
+
+    def test_wire_bytes_drop(self):
+        """Compiled-step collective payload must shrink under compression."""
+        b = _batch()
+        ref, comp = _engine(None), _engine("onebit")
+        gref = ref._make_global(b)
+        gcmp = comp._make_global(b)
+        with ref.mesh:
+            href = ref._train_step.lower(ref.state, gref).compile().as_text()
+        with comp.mesh:
+            hcmp = comp._train_step.lower(comp.state, gcmp).compile().as_text()
+        sref, scmp = collective_summary(href), collective_summary(hcmp)
+        # the uncompressed grad sync all-reduces fp32 grads; the compressed
+        # one moves u8 bitmaps through all-to-all/all-gather
+        ar_ref = sref.get("all-reduce", {"mbytes": 0})["mbytes"]
+        ar_cmp = scmp.get("all-reduce", {"mbytes": 0})["mbytes"]
+        assert ar_cmp < ar_ref, (sref, scmp)
+        assert "u8[" in hcmp  # packed sign bitmaps on the wire
+
+    def test_zero3_requires_hpz(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="hpz"):
+            _engine("int8", zero={"stage": 3})
