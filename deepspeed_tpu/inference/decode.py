@@ -68,7 +68,7 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions):
     B, T, d = x.shape
     h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
-    y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm)
+    y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm, cfg.norm_eps)
     q = model._maybe_bias(y @ p["wq"].astype(y.dtype), p, "bq").reshape(B, T, h, hd)
     k = model._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, T, kv, hd)
     v = model._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, T, kv, hd)
@@ -84,7 +84,7 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions):
     o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
                           p, "bo")
     x = x + o
-    y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm)
+    y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
     out, _aux = model._mlp_block(y2, p)
     return x + out, cache_k, cache_v
 

@@ -48,6 +48,7 @@ class TransformerConfig:
     # family switches
     pos_embedding: str = "learned"        # "learned" (gpt2) | "rope" (llama)
     norm: str = "layernorm"               # "layernorm" | "rmsnorm"
+    norm_eps: float = 1e-5                # HF llama checkpoints vary (1e-5/1e-6)
     activation: str = "gelu"              # "gelu" | "silu_glu" (llama)
     use_bias: bool = True                 # gpt2 yes, llama no
     tie_embeddings: bool = True
@@ -277,7 +278,7 @@ class TransformerLM:
         cfg = self.cfg
         B, S, d = x.shape
         h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
-        y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm)
+        y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm, cfg.norm_eps)
         q = self._maybe_bias(y @ p["wq"].astype(y.dtype), p, "bq").reshape(B, S, h, hd)
         kk = self._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, S, kv, hd)
         vv = self._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, S, kv, hd)
@@ -318,7 +319,7 @@ class TransformerLM:
         cfg = self.cfg
         p = layer_params
         x = self._attention_block(x, p, positions, attn_mask)
-        y = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm)
+        y = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
         out, aux = self._mlp_block(y, p)
         x = x + out
         return constrain(x, P(B_AXES, "seq", None)), aux
@@ -371,7 +372,7 @@ class TransformerLM:
         """Final layernorm only (the pipeline's vocab-sharded head applies
         its own unembedding slice)."""
         return _norm(x, params["lnf_scale"], params.get("lnf_bias"),
-                     self.cfg.norm)
+                     self.cfg.norm, self.cfg.norm_eps)
 
     def _head(self, params, x):
         """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""

@@ -1,0 +1,121 @@
+"""HF-checkpoint importer: logits equivalence vs transformers reference.
+
+The oracle mirrors the reference's inference test strategy
+(``tests/unit/inference/test_inference.py`` runs HF model zoo members and
+compares outputs): we build *tiny random* HF models locally (no downloads),
+run their torch forward, import the state dict onto the native trunk, and
+require logits to agree to fp32 tolerance.  Covers GPT-2 (fused c_attn,
+Conv1D layout, learned positions) and Llama (GQA, RoPE basis permutation,
+rmsnorm, GLU) — the two mapping families — plus the directory round-trip
+through safetensors + config.json.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.models import (TransformerConfig, build_model,
+                                  import_state_dict, load_hf_checkpoint)
+
+
+def _native_logits(cfg, params, ids: np.ndarray) -> np.ndarray:
+    cfg = TransformerConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+    model = build_model(cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    return np.asarray(model.apply(params, jnp.asarray(ids)))
+
+
+def _hf_logits(model, ids: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        return model(torch.tensor(ids)).logits.float().numpy()
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt2():
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4)
+    return transformers.GPT2LMHeadModel(hf_cfg).eval(), hf_cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    torch.manual_seed(1)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=144,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, tie_word_embeddings=False)
+    return transformers.LlamaForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+def test_gpt2_logits_match(tiny_gpt2):
+    model, hf_cfg = tiny_gpt2
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16), dtype=np.int64)
+    cfg, params = import_state_dict(model.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    assert cfg.n_layer == 2 and cfg.tie_embeddings
+    got = _native_logits(cfg, params, ids.astype(np.int32))
+    want = _hf_logits(model, ids)
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_llama_logits_match(tiny_llama):
+    model, hf_cfg = tiny_llama
+    ids = np.random.default_rng(1).integers(0, 128, (2, 16), dtype=np.int64)
+    cfg, params = import_state_dict(model.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    assert cfg.kv_heads == 2 and cfg.norm == "rmsnorm" and not cfg.use_bias
+    got = _native_logits(cfg, params, ids.astype(np.int32))
+    want = _hf_logits(model, ids)
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_family_autodetect(tiny_gpt2, tiny_llama):
+    gpt2_model, gpt2_cfg = tiny_gpt2
+    llama_model, llama_cfg = tiny_llama
+    # No hf_config: family + sizes must come from a native config
+    from deepspeed_tpu.models.importer import _detect_family
+    assert _detect_family(gpt2_model.state_dict()) == "gpt2"
+    assert _detect_family(llama_model.state_dict()) == "llama"
+
+
+def test_checkpoint_dir_roundtrip(tiny_llama, tmp_path):
+    """Save HF-style dir (config.json + safetensors), load via the public
+    entry, check logits again — exercises the file-loading path."""
+    from safetensors.torch import save_file
+
+    model, hf_cfg = tiny_llama
+    ckpt = tmp_path / "llama-tiny"
+    os.makedirs(ckpt)
+    with open(ckpt / "config.json", "w") as f:
+        json.dump({**hf_cfg.to_dict(), "model_type": "llama"}, f)
+    sd = {k: v.contiguous() for k, v in model.state_dict().items()}
+    save_file(sd, str(ckpt / "model.safetensors"))
+
+    cfg, params = load_hf_checkpoint(str(ckpt))
+    ids = np.random.default_rng(2).integers(0, 128, (1, 8), dtype=np.int64)
+    got = _native_logits(cfg, params, ids.astype(np.int32))
+    want = _hf_logits(model, ids)
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_max_seq_override(tiny_gpt2, tmp_path):
+    from safetensors.torch import save_file
+
+    model, hf_cfg = tiny_gpt2
+    ckpt = tmp_path / "gpt2-tiny"
+    os.makedirs(ckpt)
+    with open(ckpt / "config.json", "w") as f:
+        json.dump(hf_cfg.to_dict(), f)
+    sd = {k: v.contiguous() for k, v in model.state_dict().items()
+          if k != "lm_head.weight"}  # tied to wte; safetensors rejects aliases
+    save_file(sd, str(ckpt / "model.safetensors"))
+    cfg, _ = load_hf_checkpoint(str(ckpt), max_seq=32)
+    assert cfg.max_seq == 32
