@@ -20,7 +20,10 @@ from jax import lax
 
 from ..models.transformer import TransformerConfig, _norm, _rope
 
-BIG_NEG = jnp.float32(-2.0 ** 30)
+# Host constant, NOT jnp.float32(...): a device constant here would run a
+# computation at import time and initialize the XLA backend — which breaks
+# multi-host jobs that must call jax.distributed.initialize() first.
+BIG_NEG = -2.0 ** 30
 
 
 class KVCache(NamedTuple):

@@ -1,0 +1,5 @@
+from .hostfile import filter_resources, parse_hostfile, parse_inclusion_exclusion
+from .runner import main
+
+__all__ = ["parse_hostfile", "filter_resources", "parse_inclusion_exclusion",
+           "main"]

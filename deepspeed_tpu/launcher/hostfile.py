@@ -1,0 +1,106 @@
+"""Hostfile parsing + include/exclude filtering.
+
+Analog of the reference launcher's resource-pool handling
+(``launcher/runner.py:201`` ``fetch_hostfile`` and ``:256``
+``parse_inclusion_exclusion``): a hostfile lists one host per line as
+``hostname slots=N``; ``--include``/``--exclude`` filters select hosts and
+per-host slots with the syntax ``host1@host2:0,2`` (``@`` separates hosts,
+``:`` introduces a slot list).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+_LINE = re.compile(r"^(?P<host>\S+)(\s+slots=(?P<slots>\d+))?\s*(#.*)?$")
+
+
+def parse_hostfile(text: str) -> "OrderedDict[str, int]":
+    """Hostfile text → ordered {hostname: slot_count}. Blank lines and
+    ``#`` comments are skipped; a missing ``slots=`` means 1."""
+    pool: "OrderedDict[str, int]" = OrderedDict()
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            raise ValueError(f"hostfile line {lineno} unparsable: {raw!r}")
+        host = m.group("host")
+        if host in pool:
+            raise ValueError(f"hostfile line {lineno}: duplicate host {host!r}")
+        pool[host] = int(m.group("slots") or 1)
+    if not pool:
+        raise ValueError("hostfile contains no hosts")
+    return pool
+
+
+def _parse_filter(spec: str) -> "OrderedDict[str, list[int] | None]":
+    """``host1@host2:0,2`` → {host1: None, host2: [0, 2]} (None = all slots)."""
+    out: "OrderedDict[str, list[int] | None]" = OrderedDict()
+    for part in filter(None, spec.split("@")):
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = sorted(int(s) for s in slots.split(",") if s != "")
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(pool: "OrderedDict[str, int]",
+                              include: str = "",
+                              exclude: str = "") -> "OrderedDict[str, list[int]]":
+    """Apply include/exclude specs to a {host: slots} pool, returning
+    ordered {host: [slot ids]}. ``include`` and ``exclude`` are mutually
+    exclusive (reference behavior)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    active: "OrderedDict[str, list[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in pool.items())
+    if include:
+        spec = _parse_filter(include)
+        unknown = [h for h in spec if h not in active]
+        if unknown:
+            raise ValueError(f"--include names unknown hosts: {unknown}")
+        picked: "OrderedDict[str, list[int]]" = OrderedDict()
+        for host, slots in spec.items():
+            avail = active[host]
+            if slots is None:
+                picked[host] = avail
+            else:
+                bad = [s for s in slots if s not in avail]
+                if bad:
+                    raise ValueError(f"--include slot(s) {bad} not in {host} "
+                                     f"(has {len(avail)})")
+                picked[host] = slots
+        return picked
+    if exclude:
+        spec = _parse_filter(exclude)
+        unknown = [h for h in spec if h not in active]
+        if unknown:
+            raise ValueError(f"--exclude names unknown hosts: {unknown}")
+        for host, slots in spec.items():
+            if slots is None:
+                del active[host]
+            else:
+                active[host] = [s for s in active[host] if s not in slots]
+                if not active[host]:
+                    del active[host]
+    return active
+
+
+def filter_resources(pool: "OrderedDict[str, int]", include: str = "",
+                     exclude: str = "", num_nodes: int = -1,
+                     num_procs: int = -1) -> "OrderedDict[str, list[int]]":
+    """Full resource resolution: filters, then ``--num_nodes`` /
+    ``--num_procs`` truncation (reference ``parse_resource_filter``)."""
+    res = parse_inclusion_exclusion(pool, include, exclude)
+    if num_nodes > 0:
+        if num_nodes > len(res):
+            raise ValueError(f"--num_nodes={num_nodes} but only {len(res)} "
+                             "hosts available after filtering")
+        res = OrderedDict(list(res.items())[:num_nodes])
+    if num_procs > 0:
+        res = OrderedDict((h, s[:num_procs]) for h, s in res.items())
+    return res

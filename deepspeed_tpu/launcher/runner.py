@@ -1,0 +1,195 @@
+"""``dstpu`` CLI: the multi-node entry point.
+
+Analog of the reference launcher (``launcher/runner.py:389`` / ``bin/deepspeed``):
+parse a hostfile, apply ``--include``/``--exclude`` filters, propagate the
+environment, and start one per-node launcher on every host.  On a single
+host this execs ``launcher.launch`` directly; across hosts it builds ssh (or
+pdsh) command lines — the TPU-pod equivalent of the reference's PDSH/MPI
+multinode runners (``launcher/multinode_runner.py:18-366``).
+
+Differences from the reference that are deliberate TPU choices:
+- One process per host by default (JAX owns all local chips per process);
+  ``--nproc`` overrides for CPU-simulation and tests.
+- No MPI dependency: process coordination is JAX's builtin distributed
+  service (process 0 is the coordinator), so the launcher only has to get
+  processes *started* with the right env.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+
+from .hostfile import filter_resources, parse_hostfile
+
+# Env prefixes forwarded to remote nodes (reference propagates a curated
+# .deepspeed_env list; we forward the framework/runtime-relevant prefixes).
+_FORWARD_PREFIXES = ("DSTPU_", "JAX_", "XLA_", "LIBTPU_", "TPU_", "PYTHON")
+_ENV_FILE = ".dstpu_env"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu multi-host launcher")
+    p.add_argument("-H", "--hostfile", default=None,
+                   help="path to a 'host slots=N' hostfile; absent = localhost")
+    p.add_argument("-i", "--include", default="",
+                   help="host/slot filter, e.g. 'node1@node2:0,1'")
+    p.add_argument("-e", "--exclude", default="",
+                   help="host/slot filter to drop")
+    p.add_argument("--num_nodes", type=int, default=-1,
+                   help="use only the first N filtered hosts")
+    p.add_argument("--nproc", type=int, default=0,
+                   help="processes per node; 0 (default) = one per hostfile "
+                        "slot, or 1 on a bare localhost (JAX owns all chips)")
+    p.add_argument("--master_addr", default=None,
+                   help="coordinator host (default: first host / 127.0.0.1)")
+    p.add_argument("--master_port", type=int, default=12321)
+    p.add_argument("--ssh_port", type=int, default=22)
+    p.add_argument("--launcher", choices=("ssh", "pdsh"), default="ssh")
+    p.add_argument("--env_file", default=_ENV_FILE,
+                   help="extra KEY=VALUE lines to export on every node")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--module", action="store_true",
+                   help="run the script as a python module")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def gather_env(env_file: str | None) -> "OrderedDict[str, str]":
+    """Environment to propagate: matching prefixes + env-file overrides."""
+    out: "OrderedDict[str, str]" = OrderedDict()
+    for k, v in os.environ.items():
+        if k.startswith(_FORWARD_PREFIXES) and k != "PYTHONPATH":
+            out[k] = v
+    if env_file and os.path.isfile(env_file):
+        with open(env_file) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                out[k.strip()] = v.strip()
+    return out
+
+
+def node_proc_counts(args, resources: "OrderedDict[str, list[int]]") -> list[int]:
+    """Per-node process counts: hostfile slots by default, ``--nproc``
+    overrides uniformly (hosts may be heterogeneous)."""
+    return [args.nproc if args.nproc > 0 else len(slots)
+            for slots in resources.values()]
+
+
+def _launch_cmd(args, node_rank: int, nnodes: int, nproc: int,
+                num_processes: int, proc_id_base: int,
+                coordinator: str) -> list[str]:
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           "--nnodes", str(nnodes), "--node_rank", str(node_rank),
+           "--nproc", str(nproc), "--num_processes", str(num_processes),
+           "--proc_id_base", str(proc_id_base), "--coordinator", coordinator]
+    if args.log_dir:
+        cmd += ["--log_dir", args.log_dir]
+    if args.module:
+        cmd += ["--module"]
+    cmd.append(args.script)
+    cmd += args.script_args
+    return cmd
+
+
+def build_remote_commands(args, resources: "OrderedDict[str, list[int]]",
+                          coordinator: str) -> "OrderedDict[str, list[str]]":
+    """Per-host shell commands for the multi-node case (unit-testable;
+    reference ``multinode_runner.py`` command builders)."""
+    exports = gather_env(args.env_file)
+    export_str = " ".join(f"export {k}={shlex.quote(v)};" for k, v in exports.items())
+    cwd = os.path.abspath(os.getcwd())
+    counts = node_proc_counts(args, resources)
+    total = sum(counts)
+    cmds: "OrderedDict[str, list[str]]" = OrderedDict()
+    base = 0
+    for node_rank, host in enumerate(resources):
+        inner = _launch_cmd(args, node_rank, len(resources), counts[node_rank],
+                            total, base, coordinator)
+        base += counts[node_rank]
+        remote = f"{export_str} cd {shlex.quote(cwd)}; " + \
+                 " ".join(shlex.quote(c) for c in inner)
+        if args.launcher == "pdsh":
+            cmds[host] = ["pdsh", "-S", "-w", host, remote]
+        else:
+            cmds[host] = ["ssh", "-o", "StrictHostKeyChecking=no",
+                          "-p", str(args.ssh_port), host, remote]
+    return cmds
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            pool = parse_hostfile(f.read())
+    else:
+        pool = OrderedDict([("localhost", args.nproc if args.nproc > 0 else 1)])
+    resources = filter_resources(pool, args.include, args.exclude,
+                                 num_nodes=args.num_nodes)
+    if not resources:
+        raise SystemExit("dstpu: no hosts left after filtering")
+
+    first_host = next(iter(resources))
+    master = args.master_addr or (
+        "127.0.0.1" if first_host == "localhost" else first_host)
+    coordinator = f"{master}:{args.master_port}"
+
+    if len(resources) == 1 and first_host in ("localhost", "127.0.0.1"):
+        # Single node: run the per-node launcher in-process.
+        from . import launch as launch_mod
+
+        nproc = node_proc_counts(args, resources)[0]
+        largs = launch_mod.parse_args(
+            ["--nnodes", "1", "--node_rank", "0", "--nproc", str(nproc),
+             "--coordinator", coordinator]
+            + (["--log_dir", args.log_dir] if args.log_dir else [])
+            + (["--module"] if args.module else [])
+            + [args.script] + args.script_args)
+        sys.exit(launch_mod.launch_local(largs))
+
+    cmds = build_remote_commands(args, resources, coordinator)
+    procs = {h: subprocess.Popen(c) for h, c in cmds.items()}
+    rc = 0
+    try:
+        # Poll ALL nodes; the first failure terminates the survivors so no
+        # node hangs in a dead rendezvous (reference sigkill_handler).
+        import time as _time
+
+        live = dict(procs)
+        while live and rc == 0:
+            _time.sleep(0.5)
+            for host in list(live):
+                code = live[host].poll()
+                if code is None:
+                    continue
+                del live[host]
+                if code != 0:
+                    print(f"dstpu: node {host} exited rc={code}; "
+                          "terminating remaining nodes", file=sys.stderr)
+                    rc = code
+        for proc in live.values():
+            proc.terminate()
+        for proc in live.values():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    except KeyboardInterrupt:
+        for proc in procs.values():
+            proc.terminate()
+        rc = 130
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,16 +35,25 @@ __all__ = ["load_hf_checkpoint", "import_state_dict", "config_from_hf"]
 
 # ----------------------------------------------------------- tensor plumbing
 def _to_numpy(t) -> np.ndarray:
-    """torch / jax / numpy tensor → fp32 numpy (bf16-safe)."""
+    """torch / jax / numpy tensor → numpy, preserving the storage dtype.
+
+    bf16 checkpoints stay bf16 (``ml_dtypes.bfloat16`` numpy arrays — the
+    stack/transpose/permute ops all work on them), so a 70B import costs
+    ~1× the checkpoint size in host RAM, not 3×; fp32 master creation
+    upcasts leaf-by-leaf downstream in the engine."""
     if isinstance(t, np.ndarray):
-        return t.astype(np.float32) if t.dtype != np.float32 else t
+        return t
     if isinstance(t, jnp.ndarray):
-        return np.asarray(t.astype(jnp.float32))
-    # torch tensor (possibly bf16, which numpy can't represent)
+        return np.asarray(t)          # bf16 → ml_dtypes.bfloat16 view
     import torch
 
     if isinstance(t, torch.Tensor):
-        return t.detach().to(torch.float32).cpu().numpy()
+        t = t.detach().cpu()
+        if t.dtype == torch.bfloat16:
+            import ml_dtypes
+
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return t.numpy()
     raise TypeError(f"unsupported tensor type {type(t)!r}")
 
 
@@ -147,6 +156,15 @@ def _gpt2_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
 
 # ------------------------------------------------------ family: llama-like
 def _llama_config(hf: dict) -> TransformerConfig:
+    if hf.get("rope_scaling"):
+        raise ValueError(
+            "checkpoint uses rope_scaling (extended-context RoPE remap); the "
+            "native trunk applies plain rope_theta positions — importing "
+            "would silently change long-range attention. Unsupported.")
+    if hf.get("sliding_window"):
+        log_dist("importer: checkpoint declares sliding_window="
+                 f"{hf['sliding_window']} — the native trunk runs full causal "
+                 "attention, so outputs diverge from HF beyond the window")
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
         n_layer=hf["num_hidden_layers"],
@@ -263,6 +281,12 @@ def import_state_dict(state_dict: Dict[str, Any],
         config = config_fn(hf_config)
     sd = _SDict(state_dict, strip=strip)
     params = convert_fn(sd, config)
+    if (config.pos_embedding == "learned"
+            and config.max_seq > params["pos_embed"].shape[0]):
+        raise ValueError(
+            f"max_seq={config.max_seq} exceeds the checkpoint's learned "
+            f"position table ({params['pos_embed'].shape[0]} rows); "
+            "positions past the table would silently clamp")
     leftovers = [k for k in sd.unused()
                  if not k.endswith(("rotary_emb.inv_freq", "attn.bias",
                                     "attn.masked_bias", "lm_head.weight"))]
@@ -331,6 +355,7 @@ def load_hf_checkpoint(path: str,
         cfg = TransformerConfig(**{**cfg.__dict__, **overrides})
         if (cfg.pos_embedding == "learned"
                 and cfg.max_seq > params["pos_embed"].shape[0]):
+            # same guard as import_state_dict, re-checked post-override
             raise ValueError(
                 f"max_seq={cfg.max_seq} exceeds the checkpoint's learned "
                 f"position table ({params['pos_embed'].shape[0]} rows); "

@@ -128,10 +128,12 @@ class PipelinedTransformerLM(TransformerLM):
             col = jnp.arange(Vp) + v0
             logits_l = jnp.where(col[None, None, :] < V, logits_l,
                                  jnp.float32(jnp.finfo(jnp.float32).min))
-            # stability max only — gradient stopped (pmax has no VJP; the
+            # stability max only — gradient stopped (pmax has no JVP rule;
+            # stop_gradient must wrap the OPERAND so the tangent entering
+            # pmax is a symbolic zero and the rule is never invoked; the
             # log-sum-exp derivative is exact with the max held constant)
-            mx = lax.stop_gradient(
-                lax.pmax(jnp.max(logits_l, axis=-1), "pipe"))        # (Bm,S)
+            mx = lax.pmax(jnp.max(lax.stop_gradient(logits_l), axis=-1),
+                          "pipe")                                    # (Bm,S)
             se = lax.psum(jnp.sum(jnp.exp(logits_l - mx[..., None]),
                                   axis=-1), "pipe")                  # (Bm,S)
             ids_d = lax.dynamic_index_in_dim(ids_mb, d_i, 0, keepdims=False)
