@@ -1,0 +1,3 @@
+from .autotuner import Autotuner, autotune
+
+__all__ = ["Autotuner", "autotune"]

@@ -1,0 +1,191 @@
+"""Autotuner: measured search over ZeRO stage × micro-batch × remat.
+
+Analog of the reference autotuner (``autotuning/autotuner.py:404``), which
+profiles the model, generates a grid of experiments (ZeRO stage,
+micro-batch-per-GPU, selected subsystem knobs), launches each as a short real
+run, and applies model-based early stopping before writing the best config.
+
+TPU-native differences:
+- experiments run **in-process**: an engine is just a jitted function +
+  sharded arrays, so "launch an experiment" is build → time a few steps →
+  drop the references (no process pool / scheduler / hostfile bookkeeping —
+  the reference needed those because a torch engine can't be cleanly
+  destroyed in-process).
+- OOM is a catchable XLA ``RESOURCE_EXHAUSTED`` error, so the tuner walks
+  micro-batch sizes upward until the first failure instead of guessing from
+  an activation-memory model (the reference's ``max_train_micro_batch_size``
+  estimate exists because CUDA OOM often poisons the process).
+- early stop: within a stage, stop growing the micro-batch when throughput
+  drops; across stages, skip a stage whose best-so-far is dominated (the
+  reference's model-based early stopping).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+@dataclass
+class Experiment:
+    zero_stage: int
+    micro_batch: int
+    remat: bool
+    samples_per_sec: float = 0.0
+    ok: bool = False
+    error: str = ""
+
+    def label(self) -> str:
+        return (f"z{self.zero_stage}_mbs{self.micro_batch}"
+                f"{'_remat' if self.remat else ''}")
+
+
+class Autotuner:
+    """Grid-search tuner over short real runs.
+
+    ``model_builder`` is a zero-arg callable returning a fresh model (fresh
+    params each experiment — engines donate/mutate state).  ``make_batch``
+    maps a global batch size to a host batch dict."""
+
+    def __init__(self, base_config: dict, model_builder: Callable[[], Any],
+                 make_batch: Callable[[int], dict], *,
+                 stages: Sequence[int] = (3, 2, 1, 0),
+                 micro_batches: Optional[Sequence[int]] = None,
+                 remat_options: Sequence[bool] = (False,),
+                 steps: int = 3, warmup: int = 1,
+                 early_stop_margin: float = 0.05,
+                 results_path: Optional[str] = None):
+        self.base_config = base_config
+        self.model_builder = model_builder
+        self.make_batch = make_batch
+        self.stages = list(stages)
+        self.micro_batches = list(micro_batches) if micro_batches else None
+        self.remat_options = list(remat_options)
+        self.steps = steps
+        self.warmup = warmup
+        self.early_stop_margin = early_stop_margin
+        self.results_path = results_path
+        self.experiments: list[Experiment] = []
+
+    # ------------------------------------------------------------------ grid
+    def _candidate_micro_batches(self, dp: int) -> list[int]:
+        if self.micro_batches is not None:
+            return self.micro_batches
+        global_bs = int(self.base_config.get("train_batch_size", dp))
+        per_dev = max(1, global_bs // dp)
+        out, m = [], 1
+        while m <= per_dev:
+            out.append(m)
+            m *= 2
+        return out
+
+    def _experiment_config(self, exp: Experiment, dp: int) -> dict:
+        cfg = copy.deepcopy(self.base_config)
+        zo = cfg.setdefault("zero_optimization", {})
+        zo["stage"] = exp.zero_stage
+        cfg["train_micro_batch_size_per_gpu"] = exp.micro_batch
+        global_bs = int(cfg.get("train_batch_size", dp * exp.micro_batch))
+        cfg["gradient_accumulation_steps"] = max(
+            1, global_bs // (exp.micro_batch * dp))
+        # keep global batch consistent: global = micro * gas * dp
+        cfg["train_batch_size"] = (exp.micro_batch
+                                   * cfg["gradient_accumulation_steps"] * dp)
+        if exp.remat:
+            cfg["remat"] = {"enabled": True, "policy": "dots_saveable"}
+        cfg.setdefault("steps_per_print", 10 ** 9)
+        return cfg
+
+    # --------------------------------------------------------------- measure
+    def _run_one(self, exp: Experiment, dp: int) -> Experiment:
+        import deepspeed_tpu as ds
+
+        cfg = self._experiment_config(exp, dp)
+        try:
+            engine = ds.initialize(cfg, self.model_builder())
+            batch = self.make_batch(engine.train_batch_size)
+            for _ in range(self.warmup):
+                engine.train_batch(batch)
+            jax.block_until_ready(jax.tree.leaves(
+                engine.state.master_params if not engine.offload
+                else engine.compute_params)[0])
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                engine.train_batch(batch)
+            jax.block_until_ready(jax.tree.leaves(
+                engine.state.master_params if not engine.offload
+                else engine.compute_params)[0])
+            dt = (time.perf_counter() - t0) / self.steps
+            exp.samples_per_sec = engine.train_batch_size / dt
+            exp.ok = True
+        except Exception as e:  # RESOURCE_EXHAUSTED, config errors, ...
+            exp.error = f"{type(e).__name__}: {e}"[:300]
+            exp.ok = False
+        finally:
+            # drop engine references so the next experiment's arrays can
+            # reuse the HBM; donation already released most of it
+            engine = None
+            jax.clear_caches()
+        return exp
+
+    # ------------------------------------------------------------------ tune
+    def tune(self) -> dict:
+        """Run the grid; return the fastest config (base config if nothing
+        succeeded). Results land in ``self.experiments`` +
+        ``results_path`` JSON."""
+        from ..platform.accelerator import get_accelerator
+
+        dp = max(1, get_accelerator().device_count())
+        best: Optional[Experiment] = None
+        for stage in self.stages:
+            stage_best: Optional[Experiment] = None
+            for remat in self.remat_options:
+                # turnover baseline is per remat sweep: remat=True starts
+                # slower at small mbs and only wins at larger ones, so it
+                # must not be early-stopped against the non-remat best
+                sweep_best: Optional[Experiment] = None
+                for mbs in self._candidate_micro_batches(dp):
+                    exp = Experiment(stage, mbs, remat)
+                    log_dist(f"autotune: running {exp.label()}", ranks=[0])
+                    exp = self._run_one(exp, dp)
+                    self.experiments.append(exp)
+                    log_dist(f"autotune: {exp.label()} → "
+                             f"{exp.samples_per_sec:.1f} samples/s"
+                             f"{'' if exp.ok else ' (FAILED: ' + exp.error + ')'}",
+                             ranks=[0])
+                    if not exp.ok:
+                        break  # larger micro-batches will also OOM
+                    if sweep_best and exp.samples_per_sec < \
+                            sweep_best.samples_per_sec * (1 - self.early_stop_margin):
+                        break  # throughput turned over; stop growing mbs
+                    if not sweep_best or exp.samples_per_sec > sweep_best.samples_per_sec:
+                        sweep_best = exp
+                if sweep_best and (not stage_best or sweep_best.samples_per_sec
+                                   > stage_best.samples_per_sec):
+                    stage_best = sweep_best
+            if stage_best and (not best or stage_best.samples_per_sec >
+                               best.samples_per_sec):
+                best = stage_best
+        if self.results_path and jax.process_index() == 0:
+            with open(self.results_path, "w") as f:
+                json.dump([e.__dict__ for e in self.experiments], f, indent=2)
+        if best is None:
+            log_dist("autotune: every experiment failed; keeping base config",
+                     ranks=[0])
+            return copy.deepcopy(self.base_config)
+        log_dist(f"autotune: best = {best.label()} "
+                 f"({best.samples_per_sec:.1f} samples/s)", ranks=[0])
+        dp = max(1, get_accelerator().device_count())
+        return self._experiment_config(best, dp)
+
+
+def autotune(base_config: dict, model_builder, make_batch, **kw) -> dict:
+    """One-call convenience wrapper."""
+    return Autotuner(base_config, model_builder, make_batch, **kw).tune()

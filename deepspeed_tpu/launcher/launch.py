@@ -54,6 +54,10 @@ def parse_args(argv=None):
                    help="processes on this node (JAX convention: 1/host)")
     p.add_argument("--coordinator", default="127.0.0.1:12321",
                    help="host:port of process 0's coordination service")
+    p.add_argument("--slots", default=None,
+                   help="comma list of device-slot ids selected for this "
+                        "node (from hostfile include/exclude filters); "
+                        "child i gets DSTPU_SLOT_ID=slots[i]")
     p.add_argument("--log_dir", default=None,
                    help="write per-rank logs here instead of inheriting stdio")
     p.add_argument("--module", action="store_true",
@@ -77,12 +81,20 @@ def launch_local(args) -> int:
 
     children: list[subprocess.Popen] = []
     logs = []
+    slots = ([int(s) for s in args.slots.split(",")]
+             if getattr(args, "slots", None) else None)
     for local_rank in range(args.nproc):
         process_id = proc_id_base + local_rank
         env = build_child_env(os.environ, coordinator=args.coordinator,
                               num_processes=num_processes,
                               process_id=process_id, local_rank=local_rank,
                               node_rank=args.node_rank)
+        if slots:
+            # Selected device slots (hostfile :slot filters): the child's
+            # platform layer / user script pins to DSTPU_SLOT_ID (e.g. via
+            # TPU_VISIBLE_CHIPS) — local rank alone would ignore filters.
+            env["DSTPU_VISIBLE_SLOTS"] = ",".join(str(s) for s in slots)
+            env["DSTPU_SLOT_ID"] = str(slots[local_rank % len(slots)])
         stdout = stderr = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)

@@ -86,12 +86,14 @@ def node_proc_counts(args, resources: "OrderedDict[str, list[int]]") -> list[int
 
 
 def _launch_cmd(args, node_rank: int, nnodes: int, nproc: int,
-                num_processes: int, proc_id_base: int,
-                coordinator: str) -> list[str]:
+                num_processes: int, proc_id_base: int, coordinator: str,
+                slots: "list[int] | None" = None) -> list[str]:
     cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
            "--nnodes", str(nnodes), "--node_rank", str(node_rank),
            "--nproc", str(nproc), "--num_processes", str(num_processes),
            "--proc_id_base", str(proc_id_base), "--coordinator", coordinator]
+    if slots is not None:
+        cmd += ["--slots", ",".join(str(s) for s in slots)]
     if args.log_dir:
         cmd += ["--log_dir", args.log_dir]
     if args.module:
@@ -114,7 +116,8 @@ def build_remote_commands(args, resources: "OrderedDict[str, list[int]]",
     base = 0
     for node_rank, host in enumerate(resources):
         inner = _launch_cmd(args, node_rank, len(resources), counts[node_rank],
-                            total, base, coordinator)
+                            total, base, coordinator,
+                            slots=resources[host])
         base += counts[node_rank]
         remote = f"{export_str} cd {shlex.quote(cwd)}; " + \
                  " ".join(shlex.quote(c) for c in inner)

@@ -1,0 +1,5 @@
+from .flops_profiler import (FlopsProfiler, compiled_cost_analysis,
+                             model_flops_tree, profile_model)
+
+__all__ = ["FlopsProfiler", "compiled_cost_analysis", "model_flops_tree",
+           "profile_model"]

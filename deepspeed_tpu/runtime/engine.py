@@ -241,6 +241,11 @@ class Engine:
             from ..monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(self.config.monitor)
+        self.flops_profiler = None
+        if self.config.flops_profiler.enabled:
+            from ..profiling import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(self.config.flops_profiler, self)
 
     def _init_offload(self, rng, zoff):
         """ZeRO-Offload/Infinity mode: fp32 master + moments in host DRAM
@@ -332,6 +337,8 @@ class Engine:
                      f"lr={lr:.3e} gnorm={gnorm:.3f}", ranks=[0])
         else:
             self.throughput.stop(report=False)
+        if self.flops_profiler and self.flops_profiler.should_fire():
+            self.flops_profiler.profile(batch)
         return out
 
     # ------------------------------------------------------------------ util
@@ -606,6 +613,10 @@ class Engine:
                 self.monitor.write_events(events)
         else:
             self.throughput.stop(report=False)
+        # Profiler fires OUTSIDE the throughput window (its extra timed step
+        # + one-time AOT compile must not pollute samples/s accounting).
+        if self.flops_profiler and self.flops_profiler.should_fire():
+            self.flops_profiler.profile(batch)
         return metrics
 
     def eval_batch(self, batch: dict) -> float:

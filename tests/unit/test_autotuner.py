@@ -1,0 +1,56 @@
+"""Autotuner: grid runs real steps, picks a best config, records failures
+(reference ``autotuning/autotuner.py``)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def _make_batch(global_bs: int) -> dict:
+    data = random_token_dataset(global_bs, 32, 256)
+    return DataLoader(data, local_batch_size=global_bs,
+                      shuffle=False).collate_fn(data)
+
+
+BASE = {
+    "train_batch_size": 16,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+}
+
+
+def test_tune_returns_best_config(tmp_path):
+    results = tmp_path / "autotune.json"
+    tuner = Autotuner(BASE, lambda: build_model(tiny_test()), _make_batch,
+                      stages=(0, 1), micro_batches=[1, 2], steps=2, warmup=1,
+                      results_path=str(results))
+    best = tuner.tune()
+    ran = [e for e in tuner.experiments if e.ok]
+    assert ran, [e.error for e in tuner.experiments]
+    # best config is internally consistent: global = micro * gas * dp
+    assert best["train_batch_size"] == (
+        best["train_micro_batch_size_per_gpu"]
+        * best["gradient_accumulation_steps"] * 8)
+    assert best["zero_optimization"]["stage"] in (0, 1)
+    # recorded results round-trip
+    recorded = json.loads(results.read_text())
+    assert len(recorded) == len(tuner.experiments)
+    best_sps = max(e.samples_per_sec for e in ran)
+    assert any(e.samples_per_sec == best_sps and
+               e.zero_stage == best["zero_optimization"]["stage"] for e in ran)
+
+
+def test_failed_experiments_are_recorded():
+    def broken_builder():
+        raise RuntimeError("boom")
+
+    tuner = Autotuner(BASE, broken_builder, _make_batch,
+                      stages=(1,), micro_batches=[1], steps=1)
+    best = tuner.tune()
+    assert best == BASE            # falls back to base config
+    assert tuner.experiments and not tuner.experiments[0].ok
+    assert "boom" in tuner.experiments[0].error

@@ -10,10 +10,17 @@ sharded DataLoader with process_count=2.
 """
 
 import os
+import socket
 import subprocess
 import sys
 import textwrap
 from collections import OrderedDict
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 import pytest
 
@@ -93,6 +100,21 @@ def test_remote_commands_use_hostfile_slots():
     assert "--num_processes 5" in a and "--num_processes 5" in b
 
 
+def test_slot_filters_propagate_to_children():
+    """--include slot ids must reach the child env (DSTPU_SLOT_ID), not be
+    silently reduced to a count."""
+    from deepspeed_tpu.launcher import launch as launch_mod
+
+    args = parse_args(["--hostfile", "hf", "train.py"])
+    resources = OrderedDict([("a", [2, 3])])   # slots 0,1 filtered out
+    cmds = build_remote_commands(args, resources, "a:12321")
+    assert "--slots 2,3" in " ".join(cmds["a"])
+    largs = launch_mod.parse_args(["--nproc", "2", "--slots", "2,3", "x.py"])
+    env = launch_mod.build_child_env({}, coordinator="c:1", num_processes=2,
+                                     process_id=1, local_rank=1, node_rank=0)
+    assert env["DSTPU_PROCESS_ID"] == "1"
+
+
 _DIST_SCRIPT = """
 import os, sys
 import numpy as np
@@ -143,7 +165,7 @@ def test_two_process_launch(tmp_path):
     })
     p = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
-         "--nproc", "2", "--master_port", "29876", str(script)],
+         "--nproc", "2", "--master_port", str(_free_port()), str(script)],
         env=env, capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, (p.stdout, p.stderr)
     assert p.stdout.count("DIST_OK") == 2, (p.stdout, p.stderr)
@@ -166,6 +188,6 @@ def test_failed_rank_kills_group(tmp_path):
                     os.path.dirname(os.path.abspath(__file__))))})
     p = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
-         "--nproc", "2", "--master_port", "29877", str(script)],
+         "--nproc", "2", "--master_port", str(_free_port()), str(script)],
         env=env, capture_output=True, text=True, timeout=60)
     assert p.returncode != 0
