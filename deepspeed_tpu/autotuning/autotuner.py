@@ -15,9 +15,11 @@ TPU-native differences:
   micro-batch sizes upward until the first failure instead of guessing from
   an activation-memory model (the reference's ``max_train_micro_batch_size``
   estimate exists because CUDA OOM often poisons the process).
-- early stop: within a stage, stop growing the micro-batch when throughput
-  drops; across stages, skip a stage whose best-so-far is dominated (the
-  reference's model-based early stopping).
+- early stop: within each (stage, remat) sweep, stop growing the micro-batch
+  once throughput turns over (the reference's model-based early stopping,
+  reduced to the one signal that matters under a compiled step: measured
+  samples/s). Stages always run — on TPU a whole-stage sweep is a handful of
+  compiles, not a cluster job per cell like the reference's scheduler.
 """
 
 from __future__ import annotations
@@ -100,6 +102,10 @@ class Autotuner:
                                    * cfg["gradient_accumulation_steps"] * dp)
         if exp.remat:
             cfg["remat"] = {"enabled": True, "policy": "dots_saveable"}
+        else:
+            # remat=False must really measure remat-off even when the base
+            # config enables it, or the grid dimension compares identical runs
+            cfg.pop("remat", None)
         cfg.setdefault("steps_per_print", 10 ** 9)
         return cfg
 

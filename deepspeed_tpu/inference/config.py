@@ -27,6 +27,17 @@ class InferenceConfig:
     quant_group_size: int = 128
     eos_token_id: Optional[int] = None
     seed: int = 0
+    # Pallas streaming cache-attention for the 1-token decode step
+    # (ops/decode_attention.py). None = auto: on for TPU, off elsewhere
+    # (interpret-mode Pallas inside the decode scan is test-only slow).
+    flash_decode: Optional[bool] = None
+
+    def flash_decode_resolved(self) -> bool:
+        if self.flash_decode is not None:
+            return self.flash_decode
+        import jax
+
+        return jax.default_backend() == "tpu"
 
     @classmethod
     def from_any(cls, cfg: "InferenceConfig | dict | None") -> "InferenceConfig":

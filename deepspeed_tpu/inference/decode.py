@@ -40,10 +40,18 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                    length=jnp.zeros((), jnp.int32))
 
 
-def _cache_attend(q, ck, cv, length):
+def _cache_attend(q, ck, cv, length, flash_decode: bool = False):
     """q: (B, T, H, hd) vs cache (B, max_len, KV, hd); positions >= length
-    masked. For prefill T = prompt len (with causal offset); decode T = 1."""
+    masked. For prefill T = prompt len (with causal offset); decode T = 1.
+
+    ``flash_decode`` routes the T == 1 hot path to the Pallas streaming
+    kernel (ops/decode_attention.py) instead of materializing the full
+    (B, H, 1, max_len) score tensor."""
     B, T, H, hd = q.shape
+    if flash_decode and T == 1 and ck.shape[1] % min(128, ck.shape[1]) == 0:
+        from ..ops.decode_attention import decode_attention
+
+        return decode_attention(q, ck, cv, length)
     KV = ck.shape[2]
     if KV != H:
         ck = jnp.repeat(ck, H // KV, axis=2)
@@ -60,7 +68,8 @@ def _cache_attend(q, ck, cv, length):
     return jnp.einsum("bhts,bshd->bthd", probs, cv)
 
 
-def _layer_step(model, x, p, cache_k, cache_v, length, positions):
+def _layer_step(model, x, p, cache_k, cache_v, length, positions,
+                flash_decode: bool = False):
     """One transformer layer over x: (B, T, d), reading/writing the cache.
 
     Returns (x_out, new_cache_k, new_cache_v). Mirrors
@@ -83,7 +92,7 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions):
                                        (0, start, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                        (0, start, 0, 0))
-    o = _cache_attend(q, cache_k, cache_v, length)
+    o = _cache_attend(q, cache_k, cache_v, length, flash_decode=flash_decode)
     o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
                           p, "bo")
     x = x + o
@@ -93,7 +102,7 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions):
 
 
 def forward_with_cache(model, params, input_ids, cache: KVCache,
-                       positions=None):
+                       positions=None, flash_decode: bool = False):
     """Run T tokens through all layers, appending to the cache.
 
     input_ids: (B, T). Works for both prefill (T = prompt length, cache
@@ -112,7 +121,8 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     def scan_fn(carry, layer_in):
         x = carry
         lp, ck, cv = layer_in
-        x, ck, cv = _layer_step(model, x, lp, ck, cv, new_len, positions)
+        x, ck, cv = _layer_step(model, x, lp, ck, cv, new_len, positions,
+                                flash_decode=flash_decode)
         return x, (ck, cv)
 
     x, (ck, cv) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
@@ -121,7 +131,8 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
 
 
 def generate_tokens(model, params, input_ids, rng, *, max_new: int,
-                    sampler, eos_token_id=None, cache_dtype=None):
+                    sampler, eos_token_id=None, cache_dtype=None,
+                    flash_decode: bool = False):
     """Shared prefill + decode-scan generation loop.
 
     Used by both :class:`~deepspeed_tpu.inference.InferenceEngine` and the
@@ -140,7 +151,8 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
 
     def step(carry, _):
         tok, cache, rng, done = carry
-        lg, cache = forward_with_cache(model, params, tok[:, None], cache)
+        lg, cache = forward_with_cache(model, params, tok[:, None], cache,
+                                       flash_decode=flash_decode)
         rng, sub = jax.random.split(rng)
         nxt = sampler(lg[:, 0], sub)
         if eos is not None:

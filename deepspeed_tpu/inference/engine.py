@@ -100,7 +100,8 @@ class InferenceEngine:
                                input_ids, rng, max_new=max_new,
                                sampler=sampler,
                                eos_token_id=self.config.eos_token_id,
-                               cache_dtype=self.compute_dtype)
+                               cache_dtype=self.compute_dtype,
+                               flash_decode=self.config.flash_decode_resolved())
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)

@@ -27,7 +27,8 @@ import time
 
 
 def build_child_env(base: dict, *, coordinator: str, num_processes: int,
-                    process_id: int, local_rank: int, node_rank: int) -> dict:
+                    process_id: int, local_rank: int, node_rank: int,
+                    slots: "list[int] | None" = None) -> dict:
     env = dict(base)
     env.update({
         "DSTPU_COORDINATOR": coordinator,
@@ -36,6 +37,12 @@ def build_child_env(base: dict, *, coordinator: str, num_processes: int,
         "DSTPU_LOCAL_RANK": str(local_rank),
         "DSTPU_NODE_RANK": str(node_rank),
     })
+    if slots is not None:
+        # Selected device slots (hostfile :slot filters): the child's
+        # platform layer / user script pins to DSTPU_SLOT_ID (e.g. via
+        # TPU_VISIBLE_CHIPS) — local rank alone would ignore filters.
+        env["DSTPU_VISIBLE_SLOTS"] = ",".join(str(s) for s in slots)
+        env["DSTPU_SLOT_ID"] = str(slots[local_rank])
     return env
 
 
@@ -83,18 +90,18 @@ def launch_local(args) -> int:
     logs = []
     slots = ([int(s) for s in args.slots.split(",")]
              if getattr(args, "slots", None) else None)
+    if slots is not None and len(slots) != args.nproc:
+        raise SystemExit(
+            f"dstpu-launch: {args.nproc} processes but {len(slots)} selected "
+            f"slots ({slots}); refusing to oversubscribe/underuse device "
+            "slots — adjust --nproc or the hostfile include/exclude filters")
     for local_rank in range(args.nproc):
         process_id = proc_id_base + local_rank
         env = build_child_env(os.environ, coordinator=args.coordinator,
                               num_processes=num_processes,
                               process_id=process_id, local_rank=local_rank,
-                              node_rank=args.node_rank)
-        if slots:
-            # Selected device slots (hostfile :slot filters): the child's
-            # platform layer / user script pins to DSTPU_SLOT_ID (e.g. via
-            # TPU_VISIBLE_CHIPS) — local rank alone would ignore filters.
-            env["DSTPU_VISIBLE_SLOTS"] = ",".join(str(s) for s in slots)
-            env["DSTPU_SLOT_ID"] = str(slots[local_rank % len(slots)])
+                              node_rank=args.node_rank,
+                              slots=slots)
         stdout = stderr = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)

@@ -37,8 +37,12 @@ SUBLANES = 8  # fp32 sublane tile: lse/delta rows replicated to (8, S)
 
 
 # ---------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block: int,
-                scale: float, causal: bool):
+def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool):
+    if masked:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
     iq = pl.program_id(2)
     q = q_ref[...].astype(jnp.float32) * scale          # (blk, hd)
     nkb = k_ref.shape[0] // block
@@ -49,14 +53,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block: int,
         k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
         v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        keep = None
         if causal:
             kpos = jk * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
             keep = q_pos >= kpos
+        if mask_ref is not None:
+            # key-padding mask row for this k block: (blk,) of {0., 1.}
+            mk = mask_ref[0, pl.ds(jk * block, block)] > 0.5
+            keep = mk[None, :] if keep is None else (keep & mk[None, :])
+        if keep is not None:
             s = jnp.where(keep, s, BIG_NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if keep is not None:
             p = jnp.where(keep, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
@@ -69,25 +79,42 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block: int,
     acc0 = jnp.zeros(q.shape, jnp.float32)
     ub = iq + 1 if causal else nkb
     m, l, acc = jax.lax.fori_loop(0, ub, body, (m0, l0, acc0))
-    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # l == 0 only for rows whose keys are ALL masked (e.g. left-padded
+    # queries); clamp so o is 0, not NaN (their loss contribution is masked)
+    l_safe = jnp.maximum(l, jnp.float32(1e-30))
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
     # (8, blk): replicated across sublanes to satisfy TPU (8, 128) tiling
-    lse_ref[...] = jnp.broadcast_to((m[:, 0] + jnp.log(l[:, 0]))[None, :],
+    lse_ref[...] = jnp.broadcast_to((m[:, 0] + jnp.log(l_safe[:, 0]))[None, :],
                                     (SUBLANES, block))
 
 
-def _fwd_call(q, k, v, *, block: int, causal: bool, interpret: bool):
+def _mask_operand(mask, S):
+    """(B, S) {0,1} key mask → (B, SUBLANES, S) fp32 kernel operand."""
+    m = mask.astype(jnp.float32).reshape(mask.shape[0], 1, S)
+    return jnp.broadcast_to(m, (mask.shape[0], SUBLANES, S))
+
+
+def _fwd_call(q, k, v, mask, *, block: int, causal: bool, interpret: bool):
     B, H, S, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     grid = (B, H, S // block)
-    kernel = partial(_fwd_kernel, block=block, scale=scale, causal=causal)
+    masked = mask is not None
+    kernel = partial(_fwd_kernel, block=block, scale=scale, causal=causal,
+                     masked=masked)
+    in_specs = [
+        pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
+    ]
+    args = [q, k, v]
+    if masked:
+        in_specs.append(pl.BlockSpec((None, SUBLANES, S),
+                                     lambda b, h, i: (b, 0, 0)))
+        args.append(mask)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((None, None, SUBLANES, block),
@@ -98,13 +125,18 @@ def _fwd_call(q, k, v, *, block: int, causal: bool, interpret: bool):
             jax.ShapeDtypeStruct((B, H, SUBLANES, S), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 # ---------------------------------------------------------------- backward
-def _make_bwd_dq_kernel(block: int, scale: float, causal: bool):
+def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool):
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+    def kernel(*refs):
+        if masked:
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dq_ref = refs
+        else:
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+            mask_ref = None
         iq = pl.program_id(2)
         q = q_ref[...].astype(jnp.float32) * scale
         do = do_ref[...].astype(jnp.float32)
@@ -118,11 +150,21 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool):
             k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
             v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-            p = jnp.exp(s - lse[:, None])
+            keep = None
             if causal:
                 kpos = jk * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 1)
-                p = jnp.where(q_pos >= kpos, p, 0.0)
+                keep = q_pos >= kpos
+            if mask_ref is not None:
+                mk = mask_ref[0, pl.ds(jk * block, block)] > 0.5
+                keep = mk[None, :] if keep is None else (keep & mk[None, :])
+            # mask BEFORE exp: for all-masked rows lse ~ BIG_NEG and a raw
+            # exp(s - lse) would overflow to inf
+            if keep is not None:
+                s = jnp.where(keep, s, BIG_NEG)
+            p = jnp.exp(s - lse[:, None])
+            if keep is not None:
+                p = jnp.where(keep, p, 0.0)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
             return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -134,15 +176,23 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool):
     return kernel
 
 
-def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool):
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dk_ref, dv_ref):
+def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool):
+    def kernel(*refs):
+        if masked:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+             dk_ref, dv_ref) = refs
+        else:
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs
+            mask_ref = None
         jk = pl.program_id(2)
         k = k_ref[...].astype(jnp.float32)               # (blk, hd)
         v = v_ref[...].astype(jnp.float32)
         nqb = q_ref.shape[0] // block
         k_pos = jk * block + jax.lax.broadcasted_iota(
             jnp.int32, (block, block), 1)
+        mk = None
+        if mask_ref is not None:
+            mk = mask_ref[0, pl.ds(jk * block, block)] > 0.5  # this k block
 
         def body(iq, carry):
             dk, dv = carry
@@ -151,11 +201,18 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool):
             lse = lse_ref[0, pl.ds(iq * block, block)]
             delta = delta_ref[0, pl.ds(iq * block, block)]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-            p = jnp.exp(s - lse[:, None])
+            keep = None
             if causal:
                 q_pos = iq * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, block), 0)
-                p = jnp.where(q_pos >= k_pos, p, 0.0)
+                keep = q_pos >= k_pos
+            if mk is not None:
+                keep = mk[None, :] if keep is None else (keep & mk[None, :])
+            if keep is not None:
+                s = jnp.where(keep, s, BIG_NEG)
+            p = jnp.exp(s - lse[:, None])
+            if keep is not None:
+                p = jnp.where(keep, p, 0.0)
             dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
@@ -171,60 +228,90 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool):
     return kernel
 
 
-def _bwd_call(q, k, v, o, lse, do, *, block: int, causal: bool,
+def _bwd_call(q, k, v, o, lse, do, mask, *, block: int, causal: bool,
               interpret: bool):
     B, H, S, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, :, None, :], (B, H, SUBLANES, S))
     grid = (B, H, S // block)
+    masked = mask is not None
     blk_spec = pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0))
     full_spec = pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0))
     row_blk = pl.BlockSpec((None, None, SUBLANES, block),
                            lambda b, h, i: (b, h, 0, i))
     row_full = pl.BlockSpec((None, None, SUBLANES, S),
                             lambda b, h, i: (b, h, 0, 0))
+    mask_spec = pl.BlockSpec((None, SUBLANES, S), lambda b, h, i: (b, 0, 0))
+    mask_args = [mask] if masked else []
 
     dq = pl.pallas_call(
-        _make_bwd_dq_kernel(block, scale, causal),
+        _make_bwd_dq_kernel(block, scale, causal, masked),
         grid=grid,
-        in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk, row_blk],
+        in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk, row_blk]
+                 + ([mask_spec] if masked else []),
         out_specs=[blk_spec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)[0]
+    )(q, k, v, do, lse, delta, *mask_args)[0]
 
     dk, dv = pl.pallas_call(
-        _make_bwd_dkv_kernel(block, scale, causal),
+        _make_bwd_dkv_kernel(block, scale, causal, masked),
         grid=grid,
-        in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full, row_full],
+        in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full, row_full]
+                 + ([mask_spec] if masked else []),
         out_specs=[blk_spec, blk_spec],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *mask_args)
     return dq, dk, dv
 
 
 # ------------------------------------------------------------- custom VJP
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash(block, causal, interpret, q, k, v):
-    o, _ = _fwd_call(q, k, v, block=block, causal=causal, interpret=interpret)
+    o, _ = _fwd_call(q, k, v, None, block=block, causal=causal,
+                     interpret=interpret)
     return o
 
 
 def _flash_fwd(block, causal, interpret, q, k, v):
-    o, lse = _fwd_call(q, k, v, block=block, causal=causal, interpret=interpret)
+    o, lse = _fwd_call(q, k, v, None, block=block, causal=causal,
+                       interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(block, causal, interpret, res, g):
     q, k, v, o, lse = res
-    return _bwd_call(q, k, v, o, lse, g, block=block, causal=causal,
+    return _bwd_call(q, k, v, o, lse, g, None, block=block, causal=causal,
                      interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash_masked(block, causal, interpret, q, k, v, mask):
+    o, _ = _fwd_call(q, k, v, mask, block=block, causal=causal,
+                     interpret=interpret)
+    return o
+
+
+def _flash_masked_fwd(block, causal, interpret, q, k, v, mask):
+    o, lse = _fwd_call(q, k, v, mask, block=block, causal=causal,
+                       interpret=interpret)
+    return o, (q, k, v, o, lse, mask)
+
+
+def _flash_masked_bwd(block, causal, interpret, res, g):
+    q, k, v, o, lse, mask = res
+    dq, dk, dv = _bwd_call(q, k, v, o, lse, g, mask, block=block,
+                           causal=causal, interpret=interpret)
+    return dq, dk, dv, jnp.zeros_like(mask)   # mask is {0,1} data, no grad
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
 
 
 # ------------------------------------------------------------- public API
@@ -233,12 +320,21 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                     interpret: Optional[bool] = None):
     """Fused causal attention. q: (B, S, H, hd); k/v: (B, S, KV, hd).
 
-    Falls back to the plain XLA attention for padding masks or shapes the
-    kernel doesn't tile (S not divisible by the block size).
+    ``mask`` is a (B, S) key-padding mask ({0,1}); it is applied INSIDE the
+    kernel (fwd and both bwd kernels), so padded/packed batches stay on the
+    fused path — the reference-parity requirement the round-1 fallback
+    violated. The only remaining fallback is S not divisible by the block
+    tile.
     """
     B, S, H, hd = q.shape
     blk = min(block, S)
-    if mask is not None or S % blk != 0:
+    if S % blk != 0:
+        if not causal:
+            # causal_attention() always applies the causal mask; a silent
+            # fallback would return wrong (triangular) outputs here
+            raise ValueError(
+                f"flash_attention(causal=False) needs S ({S}) divisible by "
+                f"the block size ({blk}); pad the sequence or pick a block")
         from ..models.transformer import causal_attention
 
         return causal_attention(q, k, v, mask=mask)
@@ -250,7 +346,11 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
         v = jnp.repeat(v, H // KV, axis=2)
     # (B, S, H, hd) -> (B, H, S, hd)
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    o = _flash(blk, causal, interpret, qt, kt, vt)
+    if mask is not None:
+        o = _flash_masked(blk, causal, interpret, qt, kt, vt,
+                          _mask_operand(mask, S))
+    else:
+        o = _flash(blk, causal, interpret, qt, kt, vt)
     return o.swapaxes(1, 2)
 
 

@@ -138,17 +138,16 @@ class FlopsProfiler:
         except Exception as e:  # cost analysis is best-effort per backend
             cost = {}
             log_dist(f"flops_profiler: cost_analysis unavailable ({e})")
-        # The timed step is a REAL training step (the train-step jit donates
-        # its state input, so the old buffers are gone either way); commit
-        # its output as the new state and count it.
-        with eng.mesh:
-            t0 = time.perf_counter()
-            out = step_fn(*step_args)
-            jax.block_until_ready(jax.tree.leaves(out)[0])
-            dt = time.perf_counter() - t0
-        if not eng.offload:
-            eng.state = out[0]
-            eng.global_steps += 1
+        # The timed step is a REAL engine step (train_batch: includes the
+        # host optimizer update in offload mode — timing only _grad_step
+        # would overstate MFU — and commits state/global_steps normally;
+        # self.done is already True so this cannot recurse).
+        t0 = time.perf_counter()
+        eng.train_batch(batch)
+        jax.block_until_ready(
+            jax.tree.leaves(eng.compute_params if eng.offload
+                            else eng.state.master_params)[0])
+        dt = time.perf_counter() - t0
 
         lines = [f"-------- deepspeed_tpu flops profiler "
                  f"(step {eng.global_steps}) --------",

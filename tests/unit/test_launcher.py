@@ -109,10 +109,21 @@ def test_slot_filters_propagate_to_children():
     resources = OrderedDict([("a", [2, 3])])   # slots 0,1 filtered out
     cmds = build_remote_commands(args, resources, "a:12321")
     assert "--slots 2,3" in " ".join(cmds["a"])
-    largs = launch_mod.parse_args(["--nproc", "2", "--slots", "2,3", "x.py"])
     env = launch_mod.build_child_env({}, coordinator="c:1", num_processes=2,
-                                     process_id=1, local_rank=1, node_rank=0)
+                                     process_id=1, local_rank=1, node_rank=0,
+                                     slots=[2, 3])
     assert env["DSTPU_PROCESS_ID"] == "1"
+    assert env["DSTPU_SLOT_ID"] == "3"          # local_rank 1 → slot 3
+    assert env["DSTPU_VISIBLE_SLOTS"] == "2,3"
+
+
+def test_slot_oversubscription_rejected(tmp_path):
+    """--nproc larger than the selected slot list must fail fast, not wrap."""
+    from deepspeed_tpu.launcher import launch as launch_mod
+
+    largs = launch_mod.parse_args(["--nproc", "4", "--slots", "2,3", "x.py"])
+    with pytest.raises(SystemExit):
+        launch_mod.launch_local(largs)
 
 
 _DIST_SCRIPT = """
