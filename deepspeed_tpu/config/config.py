@@ -181,6 +181,40 @@ class GradientCompressionConfig(ConfigModel):
     type: Literal["onebit", "int8"] = "int8"
 
 
+class CurriculumConfig(ConfigModel):
+    """Seqlen curriculum (reference ``data_pipeline/curriculum_scheduler.py``;
+    config shape follows ``data_efficiency.data_sampling.curriculum_learning``)."""
+
+    enabled: bool = False
+    min_difficulty: int = 64
+    max_difficulty: int = 1024
+    total_curriculum_step: int = 10000
+    schedule_type: Literal["fixed_linear", "fixed_root",
+                           "fixed_discrete"] = "fixed_linear"
+    difficulty_step: int = 8
+    root_degree: int = 2
+    difficulties: list[int] = Field(default_factory=list)
+    max_steps: list[int] = Field(default_factory=list)
+
+
+class RandomLTDConfig(ConfigModel):
+    """Random layerwise token dropping (reference
+    ``data_routing/basic_layer.py:113`` + its scheduler)."""
+
+    enabled: bool = False
+    # kept-token schedule: linear from start_tokens to the full seqlen over
+    # total_steps, quantized to difficulty_step
+    start_tokens: int = 128
+    total_steps: int = 10000
+    difficulty_step: int = 64
+    seed: int = 17
+
+
+class DataEfficiencyConfig(ConfigModel):
+    curriculum_learning: CurriculumConfig = Field(default_factory=CurriculumConfig)
+    random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
+
+
 class MoEConfig(ConfigModel):
     enabled: bool = False
     num_experts: int = 1
@@ -220,6 +254,8 @@ class Config(ConfigModel):
     gradient_compression: GradientCompressionConfig = Field(
         default_factory=GradientCompressionConfig)
     moe: MoEConfig = Field(default_factory=MoEConfig)
+    data_efficiency: DataEfficiencyConfig = Field(
+        default_factory=DataEfficiencyConfig)
 
     DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {"zero": "zero_optimization"}
 

@@ -1,0 +1,7 @@
+from .curriculum import CurriculumScheduler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+from .random_ltd import convert_to_random_ltd
+from .sampler import CurriculumSampler
+
+__all__ = ["CurriculumScheduler", "CurriculumSampler", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder", "convert_to_random_ltd"]

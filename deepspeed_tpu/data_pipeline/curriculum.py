@@ -1,0 +1,64 @@
+"""Curriculum-learning difficulty scheduler.
+
+Analog of the reference's ``data_pipeline/curriculum_scheduler.py:11``
+(CurriculumScheduler): maps the global step to a difficulty value (typically
+the training sequence length) under one of the reference's schedule types —
+``fixed_linear``, ``fixed_root``, ``fixed_discrete``.  Difficulties are
+rounded down to a multiple of ``difficulty_step`` (the reference does this so
+seqlen stays tile/TP-friendly; on TPU it also bounds the number of distinct
+compiled shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+class CurriculumScheduler:
+    def __init__(self, *, min_difficulty: int, max_difficulty: int,
+                 total_curriculum_step: int,
+                 schedule_type: str = "fixed_linear",
+                 difficulty_step: int = 8,
+                 root_degree: int = 2,
+                 difficulties: Sequence[int] = (),
+                 max_steps: Sequence[int] = ()):
+        if schedule_type not in ("fixed_linear", "fixed_root", "fixed_discrete"):
+            raise ValueError(f"unknown curriculum schedule {schedule_type!r}")
+        if schedule_type == "fixed_discrete" and (
+                not difficulties or len(max_steps) != len(difficulties) - 1):
+            raise ValueError(
+                "fixed_discrete needs `difficulties` (N values) and "
+                "`max_steps` (N-1 boundaries)")
+        self.min = int(min_difficulty)
+        self.max = int(max_difficulty)
+        self.total = max(1, int(total_curriculum_step))
+        self.kind = schedule_type
+        self.step_quantum = max(1, int(difficulty_step))
+        self.root = root_degree
+        self.difficulties = list(difficulties)
+        self.boundaries = list(max_steps)
+
+    def __call__(self, step: int) -> int:
+        if self.kind == "fixed_discrete":
+            for d, bound in zip(self.difficulties, self.boundaries):
+                if step < bound:
+                    return int(d)
+            return int(self.difficulties[-1])
+        frac = min(1.0, max(0.0, step / self.total))
+        if self.kind == "fixed_root":
+            frac = frac ** (1.0 / self.root)
+        d = self.min + (self.max - self.min) * frac
+        d = int(d) // self.step_quantum * self.step_quantum
+        return max(self.min, min(self.max, d))
+
+    @classmethod
+    def from_config(cls, cfg) -> "CurriculumScheduler":
+        """Build from a CurriculumConfig pydantic node (config/config.py)."""
+        return cls(min_difficulty=cfg.min_difficulty,
+                   max_difficulty=cfg.max_difficulty,
+                   total_curriculum_step=cfg.total_curriculum_step,
+                   schedule_type=cfg.schedule_type,
+                   difficulty_step=cfg.difficulty_step,
+                   root_degree=cfg.root_degree,
+                   difficulties=cfg.difficulties,
+                   max_steps=cfg.max_steps)

@@ -1,0 +1,94 @@
+"""Random layerwise token dropping (random-LTD).
+
+Analog of the reference's ``data_pipeline/data_routing/basic_layer.py:113``
+(``RandomLayerTokenDrop``) + scheduler: middle transformer layers process a
+random *subset* of tokens (gather → layer → scatter-back), cutting attention
+and FFN cost per dropped token while the first/last layers see the full
+sequence.  The kept-token count follows a schedule over training steps.
+
+TPU-native shape discipline: the kept count is a **static** value per
+compiled step (dynamic shapes don't exist under jit).  The schedule has few
+distinct values (it moves in ``difficulty_step`` quanta), so each change
+costs one retrace — the engine passes the current value as a static argument
+so the jit cache keys on it.
+
+Subset causality: kept indices are sorted ascending, so the subset's
+triangular mask equals true causality restricted to the subset (token i
+attends kept token j iff pos_j ≤ pos_i) — the same approximation the
+reference makes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class RandomLTDMixin:
+    """Overrides ``_scan_layers``: full first/last layer, token-dropped
+    middle layers. Activated when ``self.ltd_tokens`` ∈ (0, S)."""
+
+    ltd_tokens: int = 0      # kept tokens per middle layer; 0 = off
+    ltd_seed: int = 17
+
+    def set_ltd_tokens(self, r: int) -> None:
+        self.ltd_tokens = int(r)
+
+    def _scan_layers(self, x, layers, positions, attn_mask, remat_policy):
+        B, S, d = x.shape
+        r = int(self.ltd_tokens)
+        L = jax.tree.leaves(layers)[0].shape[0]
+        if r <= 0 or r >= S or L < 3:
+            return super()._scan_layers(x, layers, positions, attn_mask,
+                                        remat_policy)
+        first = jax.tree.map(lambda a: a[:1], layers)
+        middle = jax.tree.map(lambda a: a[1:-1], layers)
+        last = jax.tree.map(lambda a: a[-1:], layers)
+
+        x, aux0 = super()._scan_layers(x, first, positions, attn_mask,
+                                       remat_policy)
+
+        # Per-step entropy: loss() has no step argument, so fold the raw BITS
+        # of the first activation row into the key — activations depend on
+        # the (updated-every-step) params, so the pattern varies per step.
+        # (A plain float→int cast would truncate ~0.02-magnitude values to 0.)
+        bits = lax.bitcast_convert_type(x[0, 0].astype(jnp.float32), jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.ltd_seed),
+                                 jnp.sum(bits, dtype=jnp.int32) & 0x7fffffff)
+
+        def mid_layer(carry, layer_params):
+            x, key = carry
+            key, sub = jax.random.split(key)
+            # sorted random subset per batch row: (B, r)
+            scores = jax.random.uniform(sub, (B, S))
+            idx = jnp.sort(jnp.argsort(scores, axis=-1)[:, :r], axis=-1)
+            brow = jnp.arange(B)[:, None]
+            x_sub = x[brow, idx]                            # (B, r, d)
+            pos_sub = positions[brow, idx]
+            mask_sub = attn_mask[brow, idx] if attn_mask is not None else None
+            body = self._layer
+            if remat_policy is not None:
+                body = jax.checkpoint(self._layer, policy=remat_policy,
+                                      prevent_cse=False)
+            y_sub, aux = body(x_sub, layer_params, pos_sub, mask_sub)
+            x = x.at[brow, idx].set(y_sub)
+            return (x, key), aux
+
+        (x, _), auxs = lax.scan(mid_layer, (x, key), middle)
+        x, aux1 = super()._scan_layers(x, last, positions, attn_mask,
+                                       remat_policy)
+        return x, aux0 + jnp.sum(auxs) + aux1
+
+
+def convert_to_random_ltd(model, *, seed: int = 17):
+    """Wrap a built model (TransformerLM or MoE trunk) with random-LTD
+    (reference ``convert_to_random_ltd``). Same params/specs/pytree; only
+    ``_scan_layers`` changes."""
+    cls = type(model)
+    new_cls = type(f"RandomLTD{cls.__name__}", (RandomLTDMixin, cls), {})
+    new = object.__new__(new_cls)
+    new.__dict__.update(model.__dict__)
+    new.ltd_tokens = 0
+    new.ltd_seed = seed
+    return new

@@ -1,0 +1,74 @@
+"""Curriculum-aware data sampler.
+
+Analog of the reference's ``data_pipeline/data_sampler.py:36``
+(``DeepSpeedDataSampler``): given a per-sample difficulty metric (e.g. token
+length, loss-based score), restrict sampling at step t to samples whose
+metric ≤ the scheduler's current difficulty, with deterministic per-epoch
+shuffling and per-host sharding (composes with the engine DataLoader the same
+way the reference sampler feeds its dataloader).
+
+The reference clusters samples by metric value into index files; at this
+scale a sorted index + binary search over thresholds gives the same access
+pattern without the clustering machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from .curriculum import CurriculumScheduler
+
+
+class CurriculumSampler:
+    """Iterator over dataset indices eligible at the current difficulty.
+
+    ``metric`` maps a sample (or its index) to a difficulty value; ``None``
+    uses ``len(sample["input_ids"])`` (seqlen curriculum, the reference's
+    default metric).
+    """
+
+    def __init__(self, dataset, scheduler: CurriculumScheduler, *,
+                 metric: Callable | None = None, seed: int = 0,
+                 batch_size: int = 1, drop_last: bool = True,
+                 shard_by_process: bool = True):
+        self.dataset = dataset
+        self.scheduler = scheduler
+        self.seed = seed
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.global_step = 0
+        self.rank = jax.process_index() if shard_by_process else 0
+        self.world = jax.process_count() if shard_by_process else 1
+        metric = metric or (lambda s: len(s["input_ids"]))
+        self._metrics = np.asarray([metric(dataset[i])
+                                    for i in range(len(dataset))])
+        self._order = np.argsort(self._metrics, kind="stable")
+        self._sorted_metrics = self._metrics[self._order]
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def eligible_indices(self, difficulty) -> np.ndarray:
+        """All dataset indices with metric ≤ difficulty (sorted by metric)."""
+        n = int(np.searchsorted(self._sorted_metrics, difficulty, side="right"))
+        return self._order[:max(n, 1)]   # never empty: easiest sample stays
+
+    def __iter__(self):
+        """Yields per-host index batches; difficulty advances per batch
+        (one batch == one optimizer step, reference semantics)."""
+        rng = np.random.default_rng(self.seed + self.epoch)
+        while True:
+            difficulty = self.scheduler(self.global_step)
+            pool = self.eligible_indices(difficulty)
+            need = self.batch_size * self.world
+            if len(pool) < need and self.drop_last:
+                pool = np.concatenate([pool] * (need // len(pool) + 1))
+            picks = rng.choice(pool, size=need, replace=len(pool) < need)
+            local = picks[self.rank * self.batch_size:
+                          (self.rank + 1) * self.batch_size]
+            self.global_step += 1
+            yield local.tolist(), difficulty

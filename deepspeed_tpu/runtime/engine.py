@@ -79,6 +79,21 @@ class Engine:
                  mesh: Optional[Mesh] = None, seed: Optional[int] = None):
         self.config = Config.from_any(config)
         self.model = model
+        de = self.config.data_efficiency
+        self.curriculum = None
+        if de.curriculum_learning.enabled:
+            from ..data_pipeline.curriculum import CurriculumScheduler
+
+            self.curriculum = CurriculumScheduler.from_config(
+                de.curriculum_learning)
+        self._ltd = de.random_ltd if de.random_ltd.enabled else None
+        self._ltd_tokens = -1
+        self._warned_device_batch = False
+        if self._ltd is not None:
+            from ..data_pipeline.random_ltd import convert_to_random_ltd
+
+            self.model = model = convert_to_random_ltd(model,
+                                                       seed=self._ltd.seed)
         self.acc = get_accelerator()
         m = self.config.mesh
         self.mesh = mesh or build_mesh(self._mesh_spec(m))
@@ -138,6 +153,11 @@ class Engine:
                 "ZeRO-Infinity param streaming operates against the "
                 "host-resident optimizer (set zero_optimization."
                 "offload_optimizer.device)")
+        if self.offload and self._ltd is not None:
+            raise ValueError(
+                "random_ltd is not supported with offload_optimizer (the "
+                "host-optimizer grad step is not rebuilt on schedule "
+                "changes); disable one of the two")
         if self.grad_comp and self.offload:
             raise ValueError(
                 "gradient_compression / zero_quantized_gradients is not "
@@ -177,16 +197,24 @@ class Engine:
         # arrays; fix their shardings to replicated to avoid spec-rank mismatch.
         self._fix_empty_moment_shardings()
 
-        self._train_step = jax.jit(
-            self._train_step_impl,
-            donate_argnums=(0,),
-            in_shardings=(self.state_shardings, self._batch_sharding()),
-            out_shardings=(self.state_shardings, None),
-        )
+        self._build_train_step()
         self._eval_step = jax.jit(self._eval_step_impl,
                                   in_shardings=(self.state_shardings.master_params,
                                                 self._batch_sharding(gas_dim=False)))
         self._post_init()
+
+    def _build_train_step(self) -> None:
+        """Create the jitted train step. The random-LTD kept-token count is a
+        STATIC argument — the jit cache keys on (shapes, ltd_tokens), so each
+        schedule quantum is one retrace and previously compiled (seqlen, r)
+        variants stay cached (curriculum + LTD compose)."""
+        self._train_step = jax.jit(
+            self._train_step_impl,
+            donate_argnums=(0,),
+            static_argnums=(2,),
+            in_shardings=(self.state_shardings, self._batch_sharding()),
+            out_shardings=(self.state_shardings, None),
+        )
 
     def _mesh_spec(self, m) -> MeshSpec:
         """Resolve the ``zero`` sub-axis (ZeRO++ hpZ / MiCS subgroup) from the
@@ -318,6 +346,8 @@ class Engine:
 
     def _train_batch_offload(self, batch: dict) -> dict:
         self.throughput.start()
+        if self.curriculum is not None:
+            batch = self._apply_data_efficiency(batch)
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
         with self.mesh:
@@ -494,8 +524,12 @@ class Engine:
             out_specs=(P(), P(), P("data")), check_vma=False)
         return fn(compute_params, batch, comm_err)
 
-    def _train_step_impl(self, state: TrainState, batch: dict):
+    def _train_step_impl(self, state: TrainState, batch: dict,
+                         ltd_tokens: int = 0):
         cfg = self.config
+        if self._ltd is not None:
+            # static per-trace constant; set before the loss is traced
+            self.model.set_ltd_tokens(ltd_tokens)
         scale = state.loss_scale.scale
 
         compute_params = self._cast_compute(state.master_params)
@@ -561,6 +595,10 @@ class Engine:
 
     def _eval_step_impl(self, master_params, batch: dict):
         cp = self._cast_compute(master_params)
+        if self._ltd is not None:
+            # eval ALWAYS runs the full sequence — token dropping is a
+            # training-cost technique, not an eval semantic
+            self.model.set_ltd_tokens(0)
         return self.model.loss(cp, batch)
 
     # ------------------------------------------------------------ public API
@@ -583,6 +621,44 @@ class Engine:
 
         return {k: to_global(v) for k, v in batch.items()}
 
+    # ------------------------------------------------- data efficiency hooks
+    def _ltd_schedule_tokens(self, step: int, seq_len: int) -> int:
+        """Linear kept-token schedule start_tokens → seq_len, quantized
+        (reference random-LTD scheduler semantics). Returns seq_len exactly
+        once the schedule completes, so 'finished' is reachable even when
+        seq_len is not a multiple of difficulty_step."""
+        c = self._ltd
+        frac = min(1.0, step / max(1, c.total_steps))
+        if frac >= 1.0:
+            return seq_len
+        r = int(c.start_tokens + (seq_len - c.start_tokens) * frac)
+        r = r // c.difficulty_step * c.difficulty_step
+        return max(min(r, seq_len), min(c.start_tokens, seq_len))
+
+    def _apply_data_efficiency(self, batch: dict) -> dict:
+        """Curriculum seqlen truncation (host-side, before global assembly —
+        each new length is one extra compiled shape) + random-LTD kept-token
+        schedule (a static jit argument: each quantum is one retrace)."""
+        is_host = not isinstance(next(iter(batch.values())), jax.Array)
+        seq = int(batch["input_ids"].shape[-1])
+        if self.curriculum is not None and is_host:
+            L = min(self.curriculum(self.global_steps), seq)
+            batch = {k: (v[..., :L] if getattr(v, "ndim", 0) >= 2
+                         and v.shape[-1] == seq else v)
+                     for k, v in batch.items()}
+            seq = L
+        elif self.curriculum is not None and not self._warned_device_batch:
+            self._warned_device_batch = True
+            log_dist("curriculum_learning: batch arrived as pre-assembled "
+                     "jax.Arrays — seqlen truncation only applies to host "
+                     "batches; the curriculum is NOT in effect", ranks=[0])
+        if self._ltd is not None:
+            r = self._ltd_schedule_tokens(self.global_steps, seq)
+            if r >= seq:
+                r = 0          # schedule finished: full sequence again
+            self._ltd_tokens = r
+        return batch
+
     def train_batch(self, batch: dict) -> dict:
         """One optimizer step over train_batch_size samples (micro-stepping,
         grad accumulation, and the update are all inside the compiled step;
@@ -590,10 +666,13 @@ class Engine:
         if self.offload:
             return self._train_batch_offload(batch)
         self.throughput.start()
+        if self.curriculum is not None or self._ltd is not None:
+            batch = self._apply_data_efficiency(batch)
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
         with self.mesh:
-            self.state, metrics = self._train_step(self.state, batch)
+            self.state, metrics = self._train_step(
+                self.state, batch, max(0, self._ltd_tokens))
         self.global_steps += 1
         if self.config.wall_clock_breakdown or \
                 self.global_steps % self.config.steps_per_print == 0:

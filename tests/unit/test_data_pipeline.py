@@ -1,0 +1,177 @@
+"""Data-efficiency pipeline: curriculum scheduler, curriculum sampler,
+mmap indexed dataset, random-LTD (reference ``data_pipeline/``,
+``data_routing/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.data_pipeline import (CurriculumSampler, CurriculumScheduler,
+                                         MMapIndexedDataset,
+                                         MMapIndexedDatasetBuilder,
+                                         convert_to_random_ltd)
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+# ------------------------------------------------------------- curriculum
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler(min_difficulty=64, max_difficulty=512,
+                            total_curriculum_step=100, difficulty_step=8)
+    assert s(0) == 64
+    assert s(100) == 512 and s(10 ** 6) == 512
+    mid = s(50)
+    assert 64 < mid < 512 and mid % 8 == 0
+    assert all(s(t + 1) >= s(t) for t in range(0, 120, 3))
+
+
+def test_fixed_root_reaches_faster_than_linear():
+    lin = CurriculumScheduler(min_difficulty=0, max_difficulty=1000,
+                              total_curriculum_step=100, difficulty_step=1,
+                              schedule_type="fixed_linear")
+    root = CurriculumScheduler(min_difficulty=0, max_difficulty=1000,
+                               total_curriculum_step=100, difficulty_step=1,
+                               schedule_type="fixed_root")
+    assert root(25) > lin(25)
+
+
+def test_fixed_discrete():
+    s = CurriculumScheduler(min_difficulty=0, max_difficulty=0,
+                            total_curriculum_step=1,
+                            schedule_type="fixed_discrete",
+                            difficulties=[32, 64, 128], max_steps=[10, 20])
+    assert s(0) == 32 and s(10) == 64 and s(19) == 64 and s(20) == 128
+
+
+# ---------------------------------------------------------------- sampler
+def test_curriculum_sampler_respects_difficulty():
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": np.zeros(int(L), np.int32)}
+            for L in rng.integers(8, 65, 100)]
+    sched = CurriculumScheduler(min_difficulty=16, max_difficulty=64,
+                                total_curriculum_step=10, difficulty_step=8)
+    sampler = CurriculumSampler(data, sched, batch_size=4,
+                                shard_by_process=False)
+    it = iter(sampler)
+    for step in range(12):
+        idx, diff = next(it)
+        assert len(idx) == 4
+        assert all(len(data[i]["input_ids"]) <= diff for i in idx), step
+    assert diff == 64   # schedule exhausted → full difficulty
+
+
+# --------------------------------------------------------- indexed dataset
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "tokens")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    seqs = [np.arange(n, dtype=np.int32) * 3 for n in (5, 1, 900, 17)]
+    for s in seqs:
+        builder.add_item(s)
+    builder.finalize()
+
+    dset = MMapIndexedDataset(prefix)
+    assert len(dset) == 4
+    np.testing.assert_array_equal(dset.lengths, [5, 1, 900, 17])
+    for i, s in enumerate(seqs):
+        np.testing.assert_array_equal(dset[i], s)
+    np.testing.assert_array_equal(dset.get(2, offset=10, length=5),
+                                  seqs[2][10:15])
+    np.testing.assert_array_equal(dset[-1], seqs[-1])
+
+
+def test_indexed_dataset_merge(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    bb = MMapIndexedDatasetBuilder(b)
+    bb.add_item([7, 8, 9])
+    bb.finalize()
+    ba = MMapIndexedDatasetBuilder(a)
+    ba.add_item([1, 2])
+    ba.merge_file_(b)
+    ba.finalize()
+    dset = MMapIndexedDataset(a)
+    assert len(dset) == 2
+    np.testing.assert_array_equal(dset[1], [7, 8, 9])
+
+
+# -------------------------------------------------------------- random-LTD
+def test_random_ltd_matches_shapes_and_differs():
+    cfg = tiny_test(n_layer=4, dtype=jnp.float32)
+    base = build_model(cfg)
+    params = base.init(jax.random.PRNGKey(0))
+    model = convert_to_random_ltd(build_model(cfg))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 32)),
+                      jnp.int32)
+    model.set_ltd_tokens(0)
+    full = model.apply(params, ids)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(base.apply(params, ids)), rtol=1e-6)
+    model.set_ltd_tokens(16)
+    dropped = model.apply(params, ids)
+    assert dropped.shape == full.shape
+    assert np.all(np.isfinite(np.asarray(dropped, np.float32)))
+    assert not np.allclose(np.asarray(dropped), np.asarray(full))
+
+
+def test_random_ltd_grads_flow():
+    cfg = tiny_test(n_layer=4, dtype=jnp.float32)
+    model = convert_to_random_ltd(build_model(cfg))
+    model.set_ltd_tokens(16)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {"input_ids": jnp.asarray(
+        np.random.default_rng(1).integers(0, 256, (2, 32)), jnp.int32)}
+    grads = jax.grad(lambda p: model.loss(p, batch))(params)
+    flat = jax.tree.leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    # middle-layer weights still receive gradient through the subset path
+    gmid = np.asarray(grads["layers"]["w_in"])[1:-1]
+    assert np.abs(gmid).sum() > 0
+
+
+# ------------------------------------------------------- engine integration
+def test_engine_curriculum_and_ltd_convergence():
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 1},
+        "data_efficiency": {
+            "curriculum_learning": {"enabled": True, "min_difficulty": 16,
+                                    "max_difficulty": 32,
+                                    "total_curriculum_step": 3,
+                                    "difficulty_step": 8},
+            "random_ltd": {"enabled": True, "start_tokens": 8,
+                           "total_steps": 4, "difficulty_step": 8},
+        },
+    }, build_model(tiny_test(n_layer=4)))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"]) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # schedules exhausted: full seqlen, LTD off
+    assert engine.curriculum(engine.global_steps) == 32
+    assert engine._ltd_tokens == 0
+
+
+def test_ltd_schedule_finishes_on_nondivisible_seq():
+    """Regression: seq not a multiple of difficulty_step must still reach
+    'schedule finished' (r == seq → LTD off), not drop tokens forever."""
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "data_efficiency": {"random_ltd": {"enabled": True, "start_tokens": 8,
+                                           "total_steps": 4,
+                                           "difficulty_step": 64}},
+    }, build_model(tiny_test(n_layer=4)))
+    assert engine._ltd_schedule_tokens(10 ** 6, 100) == 100
+
+
+def test_indexed_dataset_merge_dtype_mismatch(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    bb = MMapIndexedDatasetBuilder(b, dtype=np.int64)
+    bb.add_item([1])
+    bb.finalize()
+    ba = MMapIndexedDatasetBuilder(a, dtype=np.int32)
+    with pytest.raises(ValueError, match="dtype"):
+        ba.merge_file_(b)
