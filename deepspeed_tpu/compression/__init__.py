@@ -1,0 +1,9 @@
+from .compress import (apply_compression, clean_params, convert_to_compressed,
+                       init_compression)
+from .layer_reduction import reduce_layers
+from .pruning import head_mask, magnitude_mask, row_masks
+from .quantization import fake_quant
+
+__all__ = ["fake_quant", "magnitude_mask", "row_masks", "head_mask",
+           "reduce_layers", "init_compression", "convert_to_compressed",
+           "apply_compression", "clean_params"]

@@ -1,0 +1,113 @@
+"""Compression entry points: config-driven QAT + pruning over the trunk.
+
+Analog of the reference ``compression/compress.py`` (``init_compression``
+``:100`` / ``redundancy_clean`` ``:148``) and its scheduler: where the
+reference swaps ``nn.Linear`` for ``LinearLayer_Compress`` modules matched by
+name patterns, the TPU-native version is a **pure function over the param
+pytree** applied inside the loss — the engine's compiled step quantizes/masks
+the compute weights each forward, the optimizer still updates full-precision
+masters, and gradients flow through STE/mask products.
+
+Technique activation follows the config ``schedule_offset`` (reference
+scheduler semantics); the engine passes the active-technique set as a static
+jit argument, so crossing an offset is one retrace.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+
+from .pruning import head_mask, magnitude_mask, row_masks
+from .quantization import fake_quant
+
+# leaves eligible for weight quantization / sparse pruning (matmul weights,
+# the reference's Linear targets)
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate")
+
+
+def apply_compression(params: dict, cfg, active: Iterable[str], *,
+                      n_head: int) -> dict:
+    """Return params with the ``active`` techniques applied to the layer
+    stack. ``cfg`` is the CompressionConfig node; ``active`` ⊆
+    {'weight_quantization', 'sparse_pruning', 'row_pruning', 'head_pruning'}."""
+    active = set(active)
+    if not active:
+        return params
+    layers = dict(params["layers"])
+
+    if "weight_quantization" in active:
+        wq = cfg.weight_quantization
+        for name in _QUANT_LEAVES:
+            if name in layers:
+                layers[name] = fake_quant(layers[name], wq.bits,
+                                          group_size=wq.group_size or None,
+                                          symmetric=wq.symmetric)
+    if "sparse_pruning" in active:
+        for name in _QUANT_LEAVES:
+            if name in layers:
+                layers[name] = layers[name] * magnitude_mask(
+                    layers[name], cfg.sparse_pruning.density)
+    if "row_pruning" in active and "w_in" in layers and "w_out" in layers:
+        m_in, m_out = row_masks(layers["w_in"], layers["w_out"],
+                                cfg.row_pruning.density)
+        layers["w_in"] = layers["w_in"] * m_in
+        layers["w_out"] = layers["w_out"] * m_out
+        if "b_in" in layers:
+            layers["b_in"] = layers["b_in"] * m_in[:, 0, :]
+    if "head_pruning" in active and "wo" in layers:
+        layers["wo"] = layers["wo"] * head_mask(layers["wo"], n_head,
+                                                cfg.head_pruning.density)
+    return {**params, "layers": layers}
+
+
+class CompressionMixin:
+    """Model wrapper: compresses compute params inside loss/apply.
+
+    ``comp_active`` is set by the engine per trace (static argument), like
+    random-LTD's kept-token count."""
+
+    comp_cfg = None
+    comp_active: tuple = ()
+
+    def set_compression_active(self, names) -> None:
+        self.comp_active = tuple(names)
+
+    def _compress(self, params):
+        if self.comp_cfg is None or not self.comp_active:
+            return params
+        return apply_compression(params, self.comp_cfg, self.comp_active,
+                                 n_head=self.cfg.n_head)
+
+    def loss(self, params, batch, **kw):
+        return super().loss(self._compress(params), batch, **kw)
+
+    def apply(self, params, input_ids, **kw):
+        return super().apply(self._compress(params), input_ids, **kw)
+
+
+def convert_to_compressed(model, compression_cfg):
+    """Wrap a built model with config-driven compression (reference
+    ``init_compression``). Same params/specs; loss/apply compress first."""
+    cls = type(model)
+    new_cls = type(f"Compressed{cls.__name__}", (CompressionMixin, cls), {})
+    new = object.__new__(new_cls)
+    new.__dict__.update(model.__dict__)
+    new.comp_cfg = compression_cfg
+    new.comp_active = ()
+    return new
+
+
+# keep the reference's entry-point name
+init_compression = convert_to_compressed
+
+
+def clean_params(params: dict, cfg, *, n_head: int) -> dict:
+    """Bake all enabled techniques into the weights for export (reference
+    ``redundancy_clean``): the returned params ARE the compressed network."""
+    active = [name for name in ("weight_quantization", "sparse_pruning",
+                                "row_pruning", "head_pruning")
+              if getattr(cfg, name).enabled]
+    out = apply_compression(params, cfg, active, n_head=n_head)
+    return jax.tree.map(lambda a: a, out)   # materialize fresh leaves

@@ -1,0 +1,31 @@
+"""Layer reduction: initialize a shallower student from a teacher.
+
+Analog of the reference's layer-reduction path in
+``compression/compress.py:100`` (``init_compression`` with
+``layer_reduction``): pick ``keep_layers`` of the teacher's L layers (e.g.
+[0, 3, 7, 11] for 12→4 distillation init), remap the student's layer stack.
+In the stacked (L, ...) layout this is one gather along dim 0 per leaf —
+no module surgery."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+
+
+def reduce_layers(cfg, params: dict, keep_layers: Sequence[int]):
+    """(teacher cfg, teacher params, kept indices) → (student cfg, params).
+
+    Non-layer leaves (embeddings, final norm, head) carry over unchanged."""
+    keep = list(keep_layers)
+    L = cfg.n_layer
+    if not keep or any(not 0 <= i < L for i in keep):
+        raise ValueError(f"keep_layers {keep} out of range for n_layer={L}")
+    idx = np.asarray(keep)
+    student_cfg = dataclasses.replace(cfg, n_layer=len(keep))
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda a: a[idx], params["layers"])
+    return student_cfg, out

@@ -1,0 +1,47 @@
+"""Pruning masks: unstructured (sparse), row (FFN channel), and head.
+
+Analog of the reference ``compression/basic_layer.py`` pruning branches
+(``sparse_pruning``, ``row_pruning``, ``head_pruning``): masks are computed
+from current weight magnitudes each forward (dynamic sparse training) and
+multiply the weights — gradients flow to surviving entries via the product
+rule, matching the reference's mask-buffer semantics.
+
+All weights here carry the stacked layer dim (L, ...) — statistics are per
+layer (axis 0 excluded from reductions).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def magnitude_mask(w, density: float):
+    """Unstructured keep-top-|density| mask per layer. w: (L, ...)."""
+    L = w.shape[0]
+    flat = jnp.abs(w.reshape(L, -1)).astype(jnp.float32)
+    thresh = jnp.quantile(flat, 1.0 - density, axis=1, keepdims=True)
+    mask = (flat >= thresh).astype(w.dtype).reshape(w.shape)
+    return mask
+
+
+def row_masks(w_in, w_out, density: float):
+    """FFN channel pruning: drop low-norm intermediate channels
+    consistently — columns of w_in (L, d, f) and rows of w_out (L, f, d)."""
+    norms = jnp.linalg.norm(w_in.astype(jnp.float32), axis=1)      # (L, f)
+    thresh = jnp.quantile(norms, 1.0 - density, axis=1, keepdims=True)
+    keep = (norms >= thresh)                                        # (L, f)
+    return (keep[:, None, :].astype(w_in.dtype),                    # w_in cols
+            keep[:, :, None].astype(w_out.dtype))                   # w_out rows
+
+
+def head_mask(wo, n_head: int, density: float):
+    """Attention head pruning: drop low-norm heads — row-groups of
+    wo (L, h*hd, d). Keeps ceil(density * n_head) heads per layer."""
+    L, hhd, d = wo.shape
+    hd = hhd // n_head
+    per_head = jnp.linalg.norm(
+        wo.astype(jnp.float32).reshape(L, n_head, hd * d), axis=-1)  # (L, h)
+    n_keep = max(1, int(round(density * n_head)))
+    kth = jnp.sort(per_head, axis=1)[:, n_head - n_keep][:, None]
+    keep = (per_head >= kth).astype(wo.dtype)                        # (L, h)
+    return jnp.repeat(keep, hd, axis=1)[:, :, None]                  # (L,h*hd,1)

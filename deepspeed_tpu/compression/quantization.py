@@ -1,0 +1,56 @@
+"""Quantization-aware training (QAT) fake quantization with STE.
+
+Analog of the reference's weight/activation quantization in
+``compression/basic_layer.py`` (``LinearLayer_Compress`` weight-quantization
+branch) and ``compression/utils.py``: quantize→dequantize in the forward so
+the network learns under quantization noise, straight-through estimator in
+the backward. Symmetric or asymmetric, per-tensor or per-group along the
+last axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_bwd(_, g):
+    return (g,)   # straight-through: d round(x)/dx := 1
+
+
+ste_round.defvjp(_ste_fwd, _ste_bwd)
+
+
+def fake_quant(w, bits: int = 8, *, group_size: int | None = None,
+               symmetric: bool = True):
+    """Quantize-dequantize ``w`` to ``bits`` (QAT forward). Scales are
+    computed per group of ``group_size`` along the LAST axis (None =
+    per-tensor-row granularity of that axis)."""
+    orig_dtype = w.dtype
+    x = w.astype(jnp.float32)
+    shape = x.shape
+    if group_size and shape[-1] % group_size == 0:
+        x = x.reshape(shape[:-1] + (shape[-1] // group_size, group_size))
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-10)
+        q = jnp.clip(ste_round(x / scale), -qmax - 1, qmax)
+        x = q * scale
+    else:
+        levels = 2.0 ** bits - 1
+        lo = jnp.min(x, axis=-1, keepdims=True)
+        hi = jnp.max(x, axis=-1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / levels, 1e-10)
+        q = jnp.clip(ste_round((x - lo) / scale), 0, levels)
+        x = q * scale + lo
+    return x.reshape(shape).astype(orig_dtype)

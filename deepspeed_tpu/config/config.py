@@ -215,6 +215,50 @@ class DataEfficiencyConfig(ConfigModel):
     random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
 
 
+class WeightQuantConfig(ConfigModel):
+    """QAT (reference ``compression/basic_layer.py`` weight quantization)."""
+
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 0            # 0 = per-row scales
+    symmetric: bool = True
+    schedule_offset: int = 0
+
+
+class SparsePruningConfig(ConfigModel):
+    enabled: bool = False
+    density: float = 0.5
+    schedule_offset: int = 0
+
+
+class RowPruningConfig(ConfigModel):
+    enabled: bool = False
+    density: float = 0.5
+    schedule_offset: int = 0
+
+
+class HeadPruningConfig(ConfigModel):
+    enabled: bool = False
+    density: float = 0.5
+    schedule_offset: int = 0
+
+
+class CompressionConfig(ConfigModel):
+    """Compression suite (reference ``compression/compress.py:100``)."""
+
+    weight_quantization: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
+    sparse_pruning: SparsePruningConfig = Field(default_factory=SparsePruningConfig)
+    row_pruning: RowPruningConfig = Field(default_factory=RowPruningConfig)
+    head_pruning: HeadPruningConfig = Field(default_factory=HeadPruningConfig)
+
+    def enabled_techniques(self) -> list[tuple[str, int]]:
+        """[(name, schedule_offset)] for every enabled technique."""
+        return [(n, getattr(self, n).schedule_offset)
+                for n in ("weight_quantization", "sparse_pruning",
+                          "row_pruning", "head_pruning")
+                if getattr(self, n).enabled]
+
+
 class MoEConfig(ConfigModel):
     enabled: bool = False
     num_experts: int = 1
@@ -256,6 +300,7 @@ class Config(ConfigModel):
     moe: MoEConfig = Field(default_factory=MoEConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
+    compression: CompressionConfig = Field(default_factory=CompressionConfig)
 
     DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {"zero": "zero_optimization"}
 

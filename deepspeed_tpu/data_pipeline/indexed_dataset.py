@@ -113,7 +113,20 @@ class MMapIndexedDataset:
         return self._data[self._offsets[i]:self._offsets[i + 1]]
 
     def get(self, i: int, offset: int = 0, length: int | None = None):
-        """Partial read (the reference API used by packed-sample builders)."""
+        """Partial read (the reference API used by packed-sample builders).
+        Bounds-checked: an over-long read raises instead of silently leaking
+        the next sequence's tokens into this one."""
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        seq_len = int(self._offsets[i + 1] - self._offsets[i])
+        if not 0 <= offset <= seq_len:
+            raise IndexError(f"offset {offset} outside sequence {i} "
+                             f"(length {seq_len})")
+        if length is not None and offset + length > seq_len:
+            raise IndexError(f"read [{offset}, {offset + length}) exceeds "
+                             f"sequence {i} (length {seq_len})")
         start = self._offsets[i] + offset
         stop = self._offsets[i + 1] if length is None else start + length
         return self._data[start:stop]

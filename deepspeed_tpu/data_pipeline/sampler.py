@@ -31,21 +31,31 @@ class CurriculumSampler:
     """
 
     def __init__(self, dataset, scheduler: CurriculumScheduler, *,
-                 metric: Callable | None = None, seed: int = 0,
-                 batch_size: int = 1, drop_last: bool = True,
+                 metric: Callable | None = None,
+                 metrics: Sequence[float] | np.ndarray | None = None,
+                 seed: int = 0, batch_size: int = 1,
                  shard_by_process: bool = True):
         self.dataset = dataset
         self.scheduler = scheduler
         self.seed = seed
         self.batch_size = batch_size
-        self.drop_last = drop_last
         self.epoch = 0
         self.global_step = 0
         self.rank = jax.process_index() if shard_by_process else 0
         self.world = jax.process_count() if shard_by_process else 1
-        metric = metric or (lambda s: len(s["input_ids"]))
-        self._metrics = np.asarray([metric(dataset[i])
-                                    for i in range(len(dataset))])
+        if metrics is not None:
+            # precomputed per-sample metrics (O(1) startup — pass
+            # MMapIndexedDataset.lengths for a seqlen curriculum)
+            self._metrics = np.asarray(metrics)
+            if len(self._metrics) != len(dataset):
+                raise ValueError(
+                    f"{len(self._metrics)} metrics for {len(dataset)} samples")
+        elif metric is None and hasattr(dataset, "lengths"):
+            self._metrics = np.asarray(dataset.lengths)   # mmap index only
+        else:
+            metric = metric or (lambda s: len(s["input_ids"]))
+            self._metrics = np.asarray([metric(dataset[i])
+                                        for i in range(len(dataset))])
         self._order = np.argsort(self._metrics, kind="stable")
         self._sorted_metrics = self._metrics[self._order]
 
@@ -58,15 +68,15 @@ class CurriculumSampler:
         return self._order[:max(n, 1)]   # never empty: easiest sample stays
 
     def __iter__(self):
-        """Yields per-host index batches; difficulty advances per batch
-        (one batch == one optimizer step, reference semantics)."""
+        """Yields per-host index batches; difficulty advances per batch (one
+        batch == one optimizer step, reference semantics). Batches are always
+        full — if the eligible pool is smaller than the global batch, samples
+        repeat (the pool is never empty by construction)."""
         rng = np.random.default_rng(self.seed + self.epoch)
         while True:
             difficulty = self.scheduler(self.global_step)
             pool = self.eligible_indices(difficulty)
             need = self.batch_size * self.world
-            if len(pool) < need and self.drop_last:
-                pool = np.concatenate([pool] * (need // len(pool) + 1))
             picks = rng.choice(pool, size=need, replace=len(pool) < need)
             local = picks[self.rank * self.batch_size:
                           (self.rank + 1) * self.batch_size]

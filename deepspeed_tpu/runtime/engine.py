@@ -89,6 +89,12 @@ class Engine:
         self._ltd = de.random_ltd if de.random_ltd.enabled else None
         self._ltd_tokens = -1
         self._warned_device_batch = False
+        self._comp = self.config.compression.enabled_techniques()
+        if self._comp:
+            from ..compression import convert_to_compressed
+
+            self.model = model = convert_to_compressed(
+                model, self.config.compression)
         if self._ltd is not None:
             from ..data_pipeline.random_ltd import convert_to_random_ltd
 
@@ -97,6 +103,12 @@ class Engine:
         self.acc = get_accelerator()
         m = self.config.mesh
         self.mesh = mesh or build_mesh(self._mesh_spec(m))
+        if self._ltd is not None and int(self.mesh.shape.get("pipe", 1)) > 1:
+            raise ValueError(
+                "random_ltd is not supported with pipeline parallelism: the "
+                "pipe shard_map scans stage-local layer slices, so the "
+                "first/last-layer-full rule would apply per stage, not "
+                "globally; disable one of the two")
         self.dp_world = dp_world_size(self.mesh)
         self.config = self.config.resolve_batch_sizes(self.dp_world)
         self.seed = self.config.seed if seed is None else seed
@@ -158,6 +170,11 @@ class Engine:
                 "random_ltd is not supported with offload_optimizer (the "
                 "host-optimizer grad step is not rebuilt on schedule "
                 "changes); disable one of the two")
+        if self.offload and self._comp:
+            raise ValueError(
+                "compression is not supported with offload_optimizer (the "
+                "host-optimizer grad step does not carry the static "
+                "active-technique argument); disable one of the two")
         if self.grad_comp and self.offload:
             raise ValueError(
                 "gradient_compression / zero_quantized_gradients is not "
@@ -211,7 +228,7 @@ class Engine:
         self._train_step = jax.jit(
             self._train_step_impl,
             donate_argnums=(0,),
-            static_argnums=(2,),
+            static_argnums=(2, 3),
             in_shardings=(self.state_shardings, self._batch_sharding()),
             out_shardings=(self.state_shardings, None),
         )
@@ -525,11 +542,13 @@ class Engine:
         return fn(compute_params, batch, comm_err)
 
     def _train_step_impl(self, state: TrainState, batch: dict,
-                         ltd_tokens: int = 0):
+                         ltd_tokens: int = 0, comp_active: tuple = ()):
         cfg = self.config
         if self._ltd is not None:
             # static per-trace constant; set before the loss is traced
             self.model.set_ltd_tokens(ltd_tokens)
+        if self._comp:
+            self.model.set_compression_active(comp_active)
         scale = state.loss_scale.scale
 
         compute_params = self._cast_compute(state.master_params)
@@ -599,6 +618,10 @@ class Engine:
             # eval ALWAYS runs the full sequence — token dropping is a
             # training-cost technique, not an eval semantic
             self.model.set_ltd_tokens(0)
+        if self._comp:
+            # eval sees the fully-compressed network (what would be exported)
+            self.model.set_compression_active(
+                tuple(sorted(n for n, _ in self._comp)))
         return self.model.loss(cp, batch)
 
     # ------------------------------------------------------------ public API
@@ -670,9 +693,11 @@ class Engine:
             batch = self._apply_data_efficiency(batch)
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
+        comp_active = tuple(sorted(
+            n for n, off in self._comp if self.global_steps >= off))
         with self.mesh:
             self.state, metrics = self._train_step(
-                self.state, batch, max(0, self._ltd_tokens))
+                self.state, batch, max(0, self._ltd_tokens), comp_active)
         self.global_steps += 1
         if self.config.wall_clock_breakdown or \
                 self.global_steps % self.config.steps_per_print == 0:

@@ -1,0 +1,127 @@
+"""Compression suite: QAT fake-quant w/ STE, pruning masks, layer reduction,
+config-driven engine integration (reference ``compression/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import (clean_params, convert_to_compressed,
+                                       fake_quant, head_mask, magnitude_mask,
+                                       reduce_layers, row_masks)
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+# ---------------------------------------------------------------- fake quant
+def test_fake_quant_reduces_levels():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    q = fake_quant(w, bits=4)
+    # 4-bit symmetric: <= 16 distinct levels per row
+    for row in np.asarray(q):
+        assert len(np.unique(np.round(row, 6))) <= 16
+    # error bounded by the quantization step
+    assert float(jnp.max(jnp.abs(q - w))) <= float(jnp.max(jnp.abs(w))) / 7 + 1e-6
+
+
+def test_fake_quant_ste_gradient():
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((8, 8)), jnp.float32)
+    g = jax.grad(lambda w: jnp.sum(fake_quant(w, bits=8) * 2.0))(w)
+    # straight-through: gradient of round() == identity, so dL/dw ~ 2.0
+    np.testing.assert_allclose(np.asarray(g), 2.0, atol=0.2)
+
+
+def test_fake_quant_asymmetric_and_groups():
+    w = jnp.asarray(np.random.default_rng(2).uniform(0, 5, (2, 4, 32)),
+                    jnp.float32)
+    q = fake_quant(w, bits=8, group_size=16, symmetric=False)
+    np.testing.assert_allclose(np.asarray(q), np.asarray(w), atol=0.05)
+
+
+# ------------------------------------------------------------------ pruning
+def test_magnitude_mask_density():
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((3, 16, 16)),
+                    jnp.float32)
+    m = magnitude_mask(w, density=0.25)
+    frac = np.asarray(m).reshape(3, -1).mean(axis=1)
+    np.testing.assert_allclose(frac, 0.25, atol=0.05)
+    # kept entries are the largest-magnitude ones (threshold is per layer)
+    for l in range(3):
+        wl, ml = np.abs(np.asarray(w)[l]), np.asarray(m)[l]
+        assert wl[ml > 0].min() >= wl[ml == 0].max() - 1e-6
+
+
+def test_row_masks_consistent():
+    rng = np.random.default_rng(1)
+    w_in = jnp.asarray(rng.standard_normal((2, 8, 32)), jnp.float32)
+    w_out = jnp.asarray(rng.standard_normal((2, 32, 8)), jnp.float32)
+    m_in, m_out = row_masks(w_in, w_out, density=0.5)
+    # the same channels are dropped on both sides
+    np.testing.assert_array_equal(np.asarray(m_in)[:, 0, :],
+                                  np.asarray(m_out)[:, :, 0])
+    assert np.asarray(m_in).mean() == pytest.approx(0.5, abs=0.1)
+
+
+def test_head_mask_keeps_whole_heads():
+    w = jnp.asarray(np.random.default_rng(2).standard_normal((2, 4 * 8, 16)),
+                    jnp.float32)
+    m = np.asarray(head_mask(w, n_head=4, density=0.5))       # (2, 32, 1)
+    per_head = m.reshape(2, 4, 8)
+    for l in range(2):
+        for h in range(4):
+            assert per_head[l, h].min() == per_head[l, h].max()  # whole head
+        assert per_head[l].mean() == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------ layer reduction
+def test_reduce_layers():
+    cfg = tiny_test(n_layer=4)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    s_cfg, s_params = reduce_layers(cfg, params, [0, 3])
+    assert s_cfg.n_layer == 2
+    np.testing.assert_array_equal(np.asarray(s_params["layers"]["wq"][1]),
+                                  np.asarray(params["layers"]["wq"][3]))
+    # student is runnable
+    student = build_model(s_cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    assert student.apply(s_params, ids).shape == (1, 8, cfg.vocab_size)
+    with pytest.raises(ValueError):
+        reduce_layers(cfg, params, [0, 9])
+
+
+# ------------------------------------------------------------------- engine
+def test_engine_compression_convergence_and_masks():
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "compression": {
+            "weight_quantization": {"enabled": True, "bits": 8},
+            "sparse_pruning": {"enabled": True, "density": 0.8,
+                               "schedule_offset": 2},
+        },
+    }, build_model(tiny_test()))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"]) for _ in range(5)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    ev = engine.eval_batch(dict(batch))
+    assert np.isfinite(ev)
+
+
+def test_clean_params_export():
+    cfg = tiny_test(dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    from deepspeed_tpu.config.config import CompressionConfig
+
+    ccfg = CompressionConfig(**{"sparse_pruning": {"enabled": True,
+                                                   "density": 0.5}})
+    cleaned = clean_params(params, ccfg, n_head=cfg.n_head)
+    w = np.asarray(cleaned["layers"]["wq"])
+    assert (w == 0).mean() == pytest.approx(0.5, abs=0.05)
+    # exported net still runs
+    out = model.apply(cleaned, jnp.zeros((1, 8), jnp.int32))
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))

@@ -175,3 +175,29 @@ def test_indexed_dataset_merge_dtype_mismatch(tmp_path):
     ba = MMapIndexedDatasetBuilder(a, dtype=np.int32)
     with pytest.raises(ValueError, match="dtype"):
         ba.merge_file_(b)
+
+
+def test_indexed_get_bounds_checked(tmp_path):
+    prefix = str(tmp_path / "t")
+    b = MMapIndexedDatasetBuilder(prefix)
+    b.add_item([1, 2, 3])
+    b.add_item([9, 9])
+    b.finalize()
+    d = MMapIndexedDataset(prefix)
+    with pytest.raises(IndexError):
+        d.get(0, offset=0, length=4)       # would leak into sequence 1
+    with pytest.raises(IndexError):
+        d.get(0, offset=5)
+    np.testing.assert_array_equal(d.get(-1), [9, 9])
+
+
+def test_sampler_accepts_precomputed_metrics():
+    data = [{"x": 0}] * 10                  # metric cannot be derived
+    sched = CurriculumScheduler(min_difficulty=1, max_difficulty=5,
+                                total_curriculum_step=5, difficulty_step=1)
+    s = CurriculumSampler(data, sched, metrics=np.arange(10),
+                          batch_size=2, shard_by_process=False)
+    idx, diff = next(iter(s))
+    assert all(i <= diff for i in idx)
+    with pytest.raises(ValueError):
+        CurriculumSampler(data, sched, metrics=[1], batch_size=2)
