@@ -35,6 +35,7 @@ from ..utils.timer import ThroughputTimer, WallClockTimers, peak_flops_for
 from .loss_scaler import (LossScaleState, grads_finite, init_loss_scale,
                           update_loss_scale)
 from .lr_schedules import build_schedule
+from .onebit import in_warmup
 from .optimizers import OptState, Optimizer, build_optimizer
 from .zero.partitioning import ZeroPartitioner, shardings_from_specs
 
@@ -126,8 +127,44 @@ class Engine:
                 "gradient compression (qgZ / 1-bit) under ZeRO-3 requires "
                 "zero_hpz_partition_size > 1 or mics_shard_size > 0: compute "
                 "params must not be sharded over the compressed 'data' axis")
-        self.optimizer: Optimizer = build_optimizer(self.config.optimizer.type,
-                                                    self.config.optimizer.params)
+        from .onebit import ONEBIT_TYPES, OnebitConfig
+
+        opt_type = self.config.optimizer.type.lower().replace("-", "_")
+        opt_type = {"onebitadam": "onebit_adam", "onebitlamb": "onebit_lamb",
+                    "zerooneadam": "zero_one_adam"}.get(opt_type, opt_type)
+        self.onebit: Optional[OnebitConfig] = None
+        if opt_type in ONEBIT_TYPES:
+            self.onebit = OnebitConfig.from_params(opt_type,
+                                                   self.config.optimizer.params)
+            if zcfg.stage != 0:
+                raise ValueError(
+                    f"{opt_type} requires ZeRO stage 0 (replicated masters): "
+                    "the compressed momentum collective assumes every rank "
+                    "holds the full momentum (reference 1-bit optimizers "
+                    "have the same restriction)")
+            if self.grad_comp:
+                raise ValueError(
+                    f"{opt_type} already compresses its own communication; "
+                    "disable gradient_compression")
+            if self.config.fp16.enabled:
+                raise ValueError(
+                    f"{opt_type} does not support fp16 dynamic loss scaling "
+                    "(no overflow-skip on the compressed-momentum path; one "
+                    "bad step would poison the error-feedback residuals) — "
+                    "use bf16, the TPU default")
+            if self.config.gradient_clipping:
+                raise ValueError(
+                    f"{opt_type} does not support gradient_clipping: in the "
+                    "compressed phase the global gradient is never "
+                    "materialized, so a global-norm clip cannot be computed "
+                    "(same restriction as the reference 1-bit optimizers)")
+            # moments init/shape come from the plain Adam state tree
+            base = {k: v for k, v in self.config.optimizer.params.items()
+                    if k in ("lr", "betas", "eps", "weight_decay")}
+            self.optimizer = build_optimizer("adamw", base)
+        else:
+            self.optimizer = build_optimizer(opt_type,
+                                             self.config.optimizer.params)
         base_lr = float(self.config.optimizer.params.get("lr", 1e-3))
         sched_cfg = self.config.scheduler
         self.lr_schedule = build_schedule(sched_cfg.type if sched_cfg else None,
@@ -181,6 +218,9 @@ class Engine:
                 "supported with offload_optimizer (the host-optimizer path "
                 "syncs gradients outside the compressed collective); disable "
                 "one of the two")
+        if self.offload and self.onebit is not None:
+            raise ValueError("1-bit optimizers are device-side algorithms; "
+                             "offload_optimizer is not supported with them")
         if self.offload:
             self._init_offload(rng, zoff)
             self._post_init()
@@ -189,12 +229,11 @@ class Engine:
         # ---------------- init state (sharded at construction: the zero.Init
         # analog — params are born partitioned, never materialized replicated)
         self._comm_err_shapes = {}
-        if self.grad_comp == "onebit":
-            from ..comm.compressed import chunk_elems
+        if self.grad_comp == "onebit" or self.onebit is not None:
+            from .onebit import comm_err_shapes
 
-            D = int(self.mesh.shape["data"])
-            per = chunk_elems(self.param_count, D)
-            self._comm_err_shapes = {"worker": (D, per * D), "server": (D, per)}
+            self._comm_err_shapes = comm_err_shapes(
+                self.param_count, int(self.mesh.shape["data"]))
         comm_err_shardings = {k: NamedSharding(self.mesh, P("data"))
                               for k in self._comm_err_shapes}
         self.state_shardings = TrainState(
@@ -228,7 +267,7 @@ class Engine:
         self._train_step = jax.jit(
             self._train_step_impl,
             donate_argnums=(0,),
-            static_argnums=(2, 3),
+            static_argnums=(2, 3, 4),
             in_shardings=(self.state_shardings, self._batch_sharding()),
             out_shardings=(self.state_shardings, None),
         )
@@ -542,13 +581,26 @@ class Engine:
         return fn(compute_params, batch, comm_err)
 
     def _train_step_impl(self, state: TrainState, batch: dict,
-                         ltd_tokens: int = 0, comp_active: tuple = ()):
+                         ltd_tokens: int = 0, comp_active: tuple = (),
+                         onebit_warmup: bool = False):
         cfg = self.config
         if self._ltd is not None:
             # static per-trace constant; set before the loss is traced
             self.model.set_ltd_tokens(ltd_tokens)
         if self._comp:
             self.model.set_compression_active(comp_active)
+        if self.onebit is not None:
+            from .onebit import onebit_train_step
+
+            new_master, new_opt, new_ce, loss, gnorm, lr = onebit_train_step(
+                self, state, batch, jnp.float32(1.0), onebit_warmup)
+            new_state = TrainState(
+                step=state.step + 1, master_params=new_master,
+                opt_state=new_opt, loss_scale=state.loss_scale,
+                skipped_steps=state.skipped_steps, comm_err=new_ce)
+            return new_state, {"loss": loss, "grad_norm": gnorm, "lr": lr,
+                               "loss_scale": jnp.float32(1.0),
+                               "skipped": jnp.int32(0)}
         scale = state.loss_scale.scale
 
         compute_params = self._cast_compute(state.master_params)
@@ -695,9 +747,11 @@ class Engine:
             batch = self._make_global(batch)
         comp_active = tuple(sorted(
             n for n, off in self._comp if self.global_steps >= off))
+        warm = (in_warmup(self.onebit, self.global_steps)
+                if self.onebit is not None else False)
         with self.mesh:
             self.state, metrics = self._train_step(
-                self.state, batch, max(0, self._ltd_tokens), comp_active)
+                self.state, batch, max(0, self._ltd_tokens), comp_active, warm)
         self.global_steps += 1
         if self.config.wall_clock_breakdown or \
                 self.global_steps % self.config.steps_per_print == 0:

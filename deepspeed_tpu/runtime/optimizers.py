@@ -224,12 +224,9 @@ def build_optimizer(opt_type: str, params: dict) -> Optimizer:
         return adam(betas=betas, eps=eps, weight_decay=wd, adamw=False)
     if t in ("adamw", "fusedadam", "cpuadam"):
         return adam(betas=betas, eps=eps, weight_decay=wd, adamw=True)
-    if t in ("onebitadam", "zerooneadam"):
-        # Compressed-communication variant: the compression lives in the
-        # gradient-reduction path (config.gradient_compression), the update
-        # rule is Adam.
-        return adam(betas=betas, eps=eps, weight_decay=wd, adamw=True)
-    if t in ("lamb", "fusedlamb", "onebitlamb"):
+    # (1-bit optimizer names never reach here: the engine intercepts
+    # ONEBIT_TYPES and drives runtime/onebit.py's momentum-compressed step.)
+    if t in ("lamb", "fusedlamb"):
         return lamb(betas=(betas[0], betas[1]), eps=eps, weight_decay=wd)
     if t in ("lion", "fusedlion", "cpulion"):
         return lion(betas=(betas[0], betas[1]) if betas else (0.9, 0.99), weight_decay=wd)

@@ -1,0 +1,95 @@
+"""1-bit optimizers: OnebitAdam / OnebitLamb / ZeroOneAdam
+(reference ``runtime/fp16/onebit/``).
+
+Oracles follow the reference's onebit tests (``tests/onebit/``): the
+compressed run must track an uncompressed Adam run within tolerance, the
+phase switch must happen at freeze_step, and the error-feedback residuals
+must be live state (nonzero after compression starts)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def _engine(opt_type, opt_params, **cfg_extra):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": opt_type, "params": opt_params},
+        **cfg_extra,
+    }
+    return ds.initialize(cfg, build_model(tiny_test()))
+
+
+def _batch():
+    data = random_token_dataset(16, 32, 256, learnable=True, seed=3)
+    return DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data[:8])
+
+
+def _run(engine, batch, steps):
+    return [float(engine.train_batch(dict(batch))["loss"]) for _ in range(steps)]
+
+
+def test_onebit_adam_tracks_adam():
+    batch = _batch()
+    base = _run(_engine("adamw", {"lr": 2e-3}), batch, 8)
+    onebit = _run(_engine("onebit_adam", {"lr": 2e-3, "freeze_step": 3}),
+                  batch, 8)
+    assert all(np.isfinite(onebit)), onebit
+    # warmup phase is EXACT Adam
+    np.testing.assert_allclose(onebit[:3], base[:3], rtol=1e-4)
+    # compressed phase keeps converging and stays close
+    assert onebit[-1] < onebit[2]
+    assert abs(onebit[-1] - base[-1]) < 0.35, (onebit, base)
+
+
+def test_onebit_error_feedback_state_live():
+    engine = _engine("onebit_adam", {"lr": 1e-3, "freeze_step": 2})
+    batch = _batch()
+    _run(engine, batch, 2)      # warmup: residuals untouched
+    werr = np.asarray(engine.state.comm_err["worker"])
+    assert np.all(werr == 0)
+    _run(engine, batch, 2)      # compressed: residuals populate
+    werr = np.asarray(engine.state.comm_err["worker"])
+    assert np.abs(werr).sum() > 0
+
+
+def test_onebit_lamb_converges():
+    losses = _run(_engine("onebit_lamb",
+                          {"lr": 2e-3, "freeze_step": 2, "max_coeff": 10.0}),
+                  _batch(), 6)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_zero_one_adam_compresses_from_step0():
+    engine = _engine("zero_one_adam", {"lr": 2e-3, "var_update_interval": 2})
+    batch = _batch()
+    losses = _run(engine, batch, 6)
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    # compressed from the first step: residuals already nonzero
+    assert np.abs(np.asarray(engine.state.comm_err["worker"])).sum() > 0
+
+
+def test_onebit_requires_stage0():
+    with pytest.raises(ValueError, match="stage 0"):
+        _engine("onebit_adam", {"lr": 1e-3},
+                zero_optimization={"stage": 1})
+
+
+def test_onebit_rejects_grad_compression():
+    with pytest.raises(ValueError, match="compress"):
+        _engine("onebit_adam", {"lr": 1e-3},
+                gradient_compression={"enabled": True, "type": "int8"})
+
+
+def test_onebit_rejects_fp16_and_clipping():
+    with pytest.raises(ValueError, match="fp16"):
+        _engine("onebit_adam", {"lr": 1e-3},
+                fp16={"enabled": True})
+    with pytest.raises(ValueError, match="clipping"):
+        _engine("onebit_adam", {"lr": 1e-3}, gradient_clipping=1.0)
