@@ -1,0 +1,429 @@
+"""Block-sparse attention: sparsity configs + Pallas kernels.
+
+Analog of the reference's sparse-attention subsystem
+(``ops/sparse_attention/sparsity_config.py:10-546`` layout generators and the
+Triton block-sparse matmul/softmax kernels, ~2.3 kLoC): attention cost drops
+from O(S²) to O(S·w) by computing only the (q-block, k-block) pairs named in
+a block *layout*.
+
+TPU shape of the idea:
+- the layout is a host-side numpy boolean (nq_blocks, nk_blocks) computed
+  once per (config, seqlen) — a trace-time constant, like the reference's
+  per-head layout tensors;
+- the layout is compiled into CSR-style index lists (active k-blocks per
+  q-block, and the transpose for the dk/dv pass) that ride the kernel as
+  scalar-prefetch operands, so each Pallas program loops over exactly its
+  active blocks — no dense iteration, no dynamic shapes;
+- fwd/bwd are the flash-attention kernels (online softmax, saved logsumexp,
+  recomputed probabilities) restricted to active blocks; the diagonal blocks
+  still apply the elementwise causal triangle.
+
+Configs mirror the reference family: Fixed, Variable, BigBird, BSLongformer,
+Dense (names and knobs from ``sparsity_config.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .flash_attention import BIG_NEG, SUBLANES
+
+
+def _delta_operand(do, o):
+    """Per-row rowsum(dO * O), sublane-replicated for the bwd kernels
+    (shared with flash_attention's backward)."""
+    B, H, S, _ = do.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return jnp.broadcast_to(delta[:, :, None, :], (B, H, SUBLANES, S))
+
+
+# ------------------------------------------------------------------ configs
+@dataclasses.dataclass(frozen=True)
+class SparsityConfig:
+    """Base: dense layout (reference ``DenseSparsityConfig``)."""
+
+    block: int = 64
+
+    def make_layout(self, n_blocks: int) -> np.ndarray:
+        return np.ones((n_blocks, n_blocks), bool)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + periodic global blocks (reference
+    ``FixedSparsityConfig``): each block attends its window of
+    ``num_local_blocks``; the last ``num_global_blocks`` of each window are
+    global (attended by and attending everyone)."""
+
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+
+    def make_layout(self, n: int) -> np.ndarray:
+        lay = np.zeros((n, n), bool)
+        w = self.num_local_blocks
+        for i in range(n):
+            start = (i // w) * w
+            lay[i, start:min(start + w, n)] = True
+        for wstart in range(0, n, w):
+            gstart = min(wstart + w, n) - self.num_global_blocks
+            g = slice(max(wstart, gstart), min(wstart + w, n))
+            lay[:, g] = True
+            lay[g, :] = True
+        return lay
+
+
+@dataclasses.dataclass(frozen=True)
+class VariableSparsityConfig(SparsityConfig):
+    """Custom local window sizes + explicit global block indices
+    (reference ``VariableSparsityConfig``)."""
+
+    local_window_blocks: Sequence[int] = (4,)
+    global_block_indices: Sequence[int] = (0,)
+
+    def make_layout(self, n: int) -> np.ndarray:
+        lay = np.zeros((n, n), bool)
+        start = 0
+        windows = list(self.local_window_blocks)
+        wi = 0
+        while start < n:
+            w = windows[min(wi, len(windows) - 1)]
+            end = min(start + w, n)
+            lay[start:end, start:end] = True
+            start = end
+            wi += 1
+        for g in self.global_block_indices:
+            if g < n:
+                lay[:, g] = True
+                lay[g, :] = True
+        return lay
+
+
+@dataclasses.dataclass(frozen=True)
+class BigBirdSparsityConfig(SparsityConfig):
+    """Random + sliding window + global (reference ``BigBirdSparsityConfig``)."""
+
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    num_global_blocks: int = 1
+    seed: int = 0
+
+    def make_layout(self, n: int) -> np.ndarray:
+        lay = np.zeros((n, n), bool)
+        half = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            lay[i, max(0, i - half):min(n, i + half + 1)] = True
+        g = min(self.num_global_blocks, n)
+        lay[:, :g] = True
+        lay[:g, :] = True
+        rng = np.random.default_rng(self.seed)
+        for i in range(n):
+            picks = rng.choice(n, size=min(self.num_random_blocks, n),
+                               replace=False)
+            lay[i, picks] = True
+        return lay
+
+
+@dataclasses.dataclass(frozen=True)
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Sliding window + explicit global indices (reference
+    ``BSLongformerSparsityConfig``)."""
+
+    num_sliding_window_blocks: int = 3
+    global_block_indices: Sequence[int] = (0,)
+
+    def make_layout(self, n: int) -> np.ndarray:
+        lay = np.zeros((n, n), bool)
+        half = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            lay[i, max(0, i - half):min(n, i + half + 1)] = True
+        for g in self.global_block_indices:
+            if g < n:
+                lay[:, g] = True
+                lay[g, :] = True
+        return lay
+
+
+# ----------------------------------------------------------- layout → lists
+def _layout_lists(layout: np.ndarray, causal: bool):
+    """Boolean layout → CSR-ish index lists for the kernels.
+
+    Returns (k_idx (nq, A), k_n (nq,), q_idx (nk, B), q_n (nk,)) padded
+    int32 arrays: active k-blocks per q-block and the transpose."""
+    n = layout.shape[0]
+    lay = layout.copy()
+    if causal:
+        lay &= np.tril(np.ones((n, n), bool))
+    if not lay.any(axis=1).all():
+        bad = np.where(~lay.any(axis=1))[0]
+        raise ValueError(f"layout leaves q-blocks {bad.tolist()} with no "
+                         "active k-blocks (causal masking removed them all?)")
+
+    def lists(m):
+        counts = m.sum(axis=1)
+        width = int(counts.max())
+        idx = np.zeros((m.shape[0], width), np.int32)
+        for i in range(m.shape[0]):
+            act = np.nonzero(m[i])[0]
+            idx[i, :len(act)] = act
+        return idx, counts.astype(np.int32)
+
+    k_idx, k_n = lists(lay)
+    q_idx, q_n = lists(lay.T)
+    return k_idx, k_n, q_idx, q_n
+
+
+# ------------------------------------------------------------------ kernels
+def _fwd_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                block: int, scale: float, causal: bool):
+    iq = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale
+    q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+
+    def body(jj, carry):
+        m, l, acc = carry
+        jk = kidx_ref[iq, jj]
+        k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            kpos = jk * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            keep = q_pos >= kpos
+            s = jnp.where(keep, s, BIG_NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((block, 1), BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((block, 1), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, kn_ref[iq], body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, jnp.float32(1e-30))
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to((m[:, 0] + jnp.log(l_safe[:, 0]))[None, :],
+                                    (SUBLANES, block))
+
+
+def _dq_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, *, block: int, scale: float, causal: bool):
+    iq = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+
+    def body(jj, dq):
+        jk = kidx_ref[iq, jj]
+        k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            kpos = jk * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            s = jnp.where(q_pos >= kpos, s, BIG_NEG)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, kn_ref[iq], body,
+                           jnp.zeros(q.shape, jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(qidx_ref, qn_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, *, block: int, scale: float,
+                causal: bool):
+    jk = pl.program_id(2)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    k_pos = jk * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+
+    def body(ii, carry):
+        dk, dv = carry
+        iq = qidx_ref[jk, ii]
+        q = q_ref[pl.ds(iq * block, block), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(iq * block, block), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(iq * block, block)]
+        delta = delta_ref[0, pl.ds(iq * block, block)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = iq * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros(k.shape, jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, qn_ref[jk], body, (z, z))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------- plumbing
+def _block_specs(S, hd, block):
+    """The four BlockSpec shapes shared by all three kernels."""
+    blk = pl.BlockSpec((None, None, block, hd),
+                       lambda b, h, i, *_: (b, h, i, 0))
+    full = pl.BlockSpec((None, None, S, hd), lambda b, h, i, *_: (b, h, 0, 0))
+    row_blk = pl.BlockSpec((None, None, SUBLANES, block),
+                           lambda b, h, i, *_: (b, h, 0, i))
+    row_full = pl.BlockSpec((None, None, SUBLANES, S),
+                            lambda b, h, i, *_: (b, h, 0, 0))
+    return blk, full, row_blk, row_full
+
+
+def _fwd_call(q, k, v, k_idx, k_n, *, block, causal, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    blk, full, row_blk, row_full = _block_specs(S, hd, block)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B, H, S // block),
+        in_specs=[blk, full, full], out_specs=[blk, row_blk])
+    return pl.pallas_call(
+        partial(_fwd_kernel, block=block, scale=scale, causal=causal),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((B, H, SUBLANES, S), jnp.float32)],
+        interpret=interpret,
+    )(np.asarray(k_idx), np.asarray(k_n), q, k, v)
+
+
+def _bwd_call(q, k, v, o, lse, do, lists, *, block, causal, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    k_idx, k_n, q_idx, q_n = lists
+    B, H, S, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    delta = _delta_operand(do, o)
+    blk, full, row_blk, row_full = _block_specs(S, hd, block)
+
+    dq = pl.pallas_call(
+        partial(_dq_kernel, block=block, scale=scale, causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=(B, H, S // block),
+            in_specs=[blk, full, full, blk, row_blk, row_blk],
+            out_specs=[blk]),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        interpret=interpret,
+    )(np.asarray(k_idx), np.asarray(k_n), q, k, v, do, lse, delta)[0]
+
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, block=block, scale=scale, causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=(B, H, S // block),
+            in_specs=[full, blk, blk, full, row_full, row_full],
+            out_specs=[blk, blk]),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(np.asarray(q_idx), np.asarray(q_n), q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _sparse(block, causal, interpret, lists, q, k, v):
+    o, _ = _fwd_call(q, k, v, lists[0], lists[1], block=block, causal=causal,
+                     interpret=interpret)
+    return o
+
+
+def _sparse_fwd(block, causal, interpret, lists, q, k, v):
+    o, lse = _fwd_call(q, k, v, lists[0], lists[1], block=block,
+                       causal=causal, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _sparse_bwd(block, causal, interpret, lists, res, g):
+    q, k, v, o, lse = res
+    return _bwd_call(q, k, v, o, lse, g, lists, block=block, causal=causal,
+                     interpret=interpret)
+
+
+_sparse.defvjp(_sparse_fwd, _sparse_bwd)
+
+
+# ------------------------------------------------------------- public API
+def sparse_attention(q, k, v, config: SparsityConfig, *, causal: bool = True,
+                     interpret: Optional[bool] = None):
+    """Block-sparse attention. q: (B, S, H, hd); k/v: (B, S, KV, hd)."""
+    B, S, H, hd = q.shape
+    block = config.block
+    if S % block != 0:
+        raise ValueError(f"seq {S} not divisible by sparsity block {block}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    KV = k.shape[2]
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    layout = config.make_layout(S // block)
+    # hashable static lists for the custom_vjp nondiff argument
+    lists = tuple(_HashableArray(a) for a in _layout_lists(layout, causal))
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    o = _sparse(block, causal, interpret, lists, qt, kt, vt)
+    return o.swapaxes(1, 2)
+
+
+class _HashableArray:
+    """numpy array wrapper usable as a static (nondiff) jit argument."""
+
+    __slots__ = ("arr", "_hash")
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = np.ascontiguousarray(arr)
+        self._hash = hash((self.arr.shape, self.arr.dtype.str,
+                           self.arr.tobytes()))
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return (isinstance(other, _HashableArray)
+                and np.array_equal(self.arr, other.arr))
+
+    # numpy protocol: lets the wrapper pass straight into pallas_call
+    def __array__(self, dtype=None):
+        return self.arr if dtype is None else self.arr.astype(dtype)
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+
+def make_sparse_attention_fn(config: SparsityConfig,
+                             interpret: Optional[bool] = None):
+    """attention_fn factory for :class:`TransformerLM` (mask unsupported —
+    combine padding with the layout instead)."""
+
+    def attn(q, k, v, *, mask=None):
+        if mask is not None:
+            raise ValueError("sparse_attention does not take a padding mask; "
+                             "fold padding into the sparsity layout")
+        return sparse_attention(q, k, v, config, interpret=interpret)
+
+    return attn
