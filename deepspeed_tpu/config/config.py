@@ -243,6 +243,19 @@ class HeadPruningConfig(ConfigModel):
     schedule_offset: int = 0
 
 
+class ElasticityConfig(ConfigModel):
+    """Elastic batch schema (reference ``elasticity/config.py`` v0.1/0.2)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2048
+    micro_batch_sizes: list[int] = Field(default_factory=lambda: [2, 4, 8])
+    min_devices: int = 1
+    max_devices: int = 1024
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.1
+
+
 class CompressionConfig(ConfigModel):
     """Compression suite (reference ``compression/compress.py:100``)."""
 
@@ -301,6 +314,7 @@ class Config(ConfigModel):
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     compression: CompressionConfig = Field(default_factory=CompressionConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
 
     DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {"zero": "zero_optimization"}
 

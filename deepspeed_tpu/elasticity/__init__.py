@@ -1,0 +1,5 @@
+from .elasticity import (ElasticityError, assert_elastic_config_consistent,
+                         compute_elastic_config, elastic_batch_for)
+
+__all__ = ["compute_elastic_config", "elastic_batch_for",
+           "assert_elastic_config_consistent", "ElasticityError"]

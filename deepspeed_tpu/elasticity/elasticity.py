@@ -1,0 +1,160 @@
+"""Elastic training: batch-compatible world sizes + restart invariants.
+
+Analog of the reference's elasticity subsystem
+(``elasticity/elasticity.py:233`` ``compute_elastic_config``, config schema
+``elasticity/config.py``, and the torch-elastic agent): given a target max
+batch and the acceptable micro-batch sizes, precompute the set of device
+counts at which the SAME global batch is reachable (micro × GAS × world), so
+a job can restart at a different world size without hyperparameter drift.
+
+TPU differences: the rendezvous/agent half of the reference
+(``DSElasticAgent``) is JAX's builtin coordination service — a restarted pod
+just calls ``jax.distributed.initialize`` with the new process set and the
+launcher re-execs the script; what the framework must provide is (a) this
+batch arithmetic, (b) checkpoint resharding on load (native to the orbax
+store), and (c) the reference's enforced *immutability* of the elastic
+config across restarts (``elasticity.py:208``), kept here as a fingerprint
+file next to the checkpoints.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional, Sequence
+
+
+class ElasticityError(ValueError):
+    """Invalid or incompatible elastic configuration (reference
+    ``ElasticityConfigError`` / ``ElasticityIncompatibleWorldSize``)."""
+
+
+def _valid_worlds(batch: int, micro_batches: Sequence[int],
+                  min_devices: int, max_devices: int) -> list[int]:
+    """Device counts w in [min, max] at which ``batch`` decomposes as
+    micro * gas * w for some allowed micro batch and integer gas."""
+    out = []
+    for w in range(max(1, min_devices), max_devices + 1):
+        if any(batch % (m * w) == 0 for m in micro_batches if m * w <= batch):
+            out.append(w)
+    return out
+
+
+def compute_elastic_config(*, max_train_batch_size: int,
+                           micro_batch_sizes: Sequence[int],
+                           min_devices: int = 1, max_devices: int = 1024,
+                           prefer_larger_batch: bool = True,
+                           target_devices: Optional[int] = None):
+    """Pick the global batch ≤ max that is reachable from the MOST device
+    counts (reference v0.1 algorithm), and its valid world-size set.
+
+    Returns ``(final_batch_size, valid_devices, micro_batch_per_device)``
+    where ``micro_batch_per_device`` is resolved for ``target_devices`` (None
+    → largest valid micro batch at the smallest valid world)."""
+    micro_batches = sorted(set(int(m) for m in micro_batch_sizes))
+    if not micro_batches or min(micro_batches) < 1:
+        raise ElasticityError(f"bad micro_batch_sizes {micro_batch_sizes}")
+    if max_train_batch_size < min(micro_batches) * max(1, min_devices):
+        raise ElasticityError(
+            f"max_train_batch_size={max_train_batch_size} cannot fit even "
+            f"micro={min(micro_batches)} on {min_devices} device(s)")
+
+    # candidate batches: lcm(micro_batches) × powers of two (the reference
+    # v0.1 candidate set — it biases selection toward batches whose
+    # compatible worlds are the power-of-two counts real pods have)
+    import math
+
+    base = math.lcm(*micro_batches)
+    candidates = []
+    b = base
+    while b <= max_train_batch_size:
+        candidates.append(b)
+        b *= 2
+    if not candidates:
+        raise ElasticityError(
+            f"lcm(micro_batch_sizes)={base} already exceeds "
+            f"max_train_batch_size={max_train_batch_size}")
+    best, best_valid = None, []
+    for b in candidates:
+        valid = _valid_worlds(b, micro_batches, min_devices, max_devices)
+        if not valid:
+            continue
+        better = (len(valid), b if prefer_larger_batch else -b)
+        incumbent = (len(best_valid), best if prefer_larger_batch else -(best or 0))
+        if best is None or better > incumbent:
+            best, best_valid = b, valid
+    if best is None:
+        raise ElasticityError(
+            f"no batch ≤ {max_train_batch_size} is reachable for any world "
+            f"size in [{min_devices}, {max_devices}] with micro batches "
+            f"{micro_batches}")
+
+    if target_devices is not None:
+        micro = micro_for_world(best, micro_batches, target_devices)
+    else:
+        micro = micro_for_world(best, micro_batches, best_valid[0])
+    return best, best_valid, micro
+
+
+def micro_for_world(batch: int, micro_batches: Sequence[int],
+                    world: int) -> int:
+    """Largest allowed micro batch that divides ``batch`` at ``world``
+    (largest micro = fewest GAS steps = best utilization)."""
+    fits = [m for m in sorted(set(micro_batches), reverse=True)
+            if m * world <= batch and batch % (m * world) == 0]
+    if not fits:
+        raise ElasticityError(
+            f"world size {world} is not compatible with elastic batch "
+            f"{batch} (micro candidates {sorted(set(micro_batches))}) — "
+            "restart at a compatible device count")
+    return fits[0]
+
+
+def elastic_batch_for(elastic_cfg, world: int) -> tuple[int, int, int]:
+    """(train_batch, micro_per_device, gas) for the CURRENT world size.
+    ``elastic_cfg`` is the config node (config.elasticity)."""
+    batch, valid, _ = compute_elastic_config(
+        max_train_batch_size=elastic_cfg.max_train_batch_size,
+        micro_batch_sizes=elastic_cfg.micro_batch_sizes,
+        min_devices=elastic_cfg.min_devices,
+        max_devices=elastic_cfg.max_devices,
+        prefer_larger_batch=elastic_cfg.prefer_larger_batch)
+    if world not in valid:
+        raise ElasticityError(
+            f"world size {world} not in the elastic-compatible set {valid} "
+            f"for batch {batch}")
+    micro = micro_for_world(batch, elastic_cfg.micro_batch_sizes, world)
+    return batch, micro, batch // (micro * world)
+
+
+# ------------------------------------------------------ restart immutability
+def _fingerprint(elastic_cfg) -> str:
+    payload = json.dumps({
+        "max_train_batch_size": elastic_cfg.max_train_batch_size,
+        "micro_batch_sizes": sorted(elastic_cfg.micro_batch_sizes),
+        "min_devices": elastic_cfg.min_devices,
+        "max_devices": elastic_cfg.max_devices,
+        "prefer_larger_batch": elastic_cfg.prefer_larger_batch,
+    }, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def assert_elastic_config_consistent(elastic_cfg, ckpt_dir: str) -> None:
+    """Enforce the reference's cross-restart immutability
+    (``elasticity.py:208``): the elastic schema may not change mid-job, or
+    the batch arithmetic silently drifts between restarts."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    fp_file = os.path.join(ckpt_dir, "elastic_config.sha")
+    fp = _fingerprint(elastic_cfg)
+    if os.path.exists(fp_file):
+        with open(fp_file) as f:
+            stored = f.read().strip()
+        if stored != fp:
+            raise ElasticityError(
+                "elastic config changed across restarts (stored fingerprint "
+                f"{stored[:12]}…, current {fp[:12]}…); the reference forbids "
+                "this because the global batch would change mid-training")
+    else:
+        with open(fp_file, "w") as f:
+            f.write(fp)

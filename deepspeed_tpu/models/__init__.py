@@ -1,9 +1,10 @@
 from .importer import config_from_hf, import_state_dict, load_hf_checkpoint
 from .pipeline import PipelinedTransformerLM, build_pipeline_model
-from .presets import build_model, gpt2, llama2, mixtral, tiny_test
+from .presets import (bert, bloom, build_model, gpt2, llama2, mixtral, opt,
+                      tiny_test)
 from .transformer import TransformerConfig, TransformerLM
 
 __all__ = ["TransformerConfig", "TransformerLM", "PipelinedTransformerLM",
            "build_model", "build_pipeline_model", "gpt2", "llama2", "mixtral",
-           "tiny_test", "load_hf_checkpoint", "import_state_dict",
-           "config_from_hf"]
+           "bert", "opt", "bloom", "tiny_test", "load_hf_checkpoint",
+           "import_state_dict", "config_from_hf"]

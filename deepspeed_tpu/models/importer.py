@@ -225,12 +225,66 @@ def _llama_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     return params
 
 
+# -------------------------------------------------------------- family: opt
+def _opt_config(hf: dict) -> TransformerConfig:
+    if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+        raise ValueError("OPT variants with word_embed_proj_dim != "
+                         "hidden_size (350m) are not supported")
+    if not hf.get("do_layer_norm_before", True):
+        raise ValueError("OPT-350m's post-norm layout is not supported")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        d_model=hf["hidden_size"],
+        d_ff=hf["ffn_dim"],
+        max_seq=hf.get("max_position_embeddings", 2048),
+        pos_embedding="learned", norm="layernorm",
+        activation=hf.get("activation_function", "relu"),
+        use_bias=True, tie_embeddings=True,
+    )
+
+
+def _opt_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """OPT: torch Linear (out, in) → transpose; embed_positions rows are
+    offset by 2 (HF quirk: positions 0.. use rows 2..)."""
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"layers.{i}."
+        per_layer.append({
+            "ln1_scale": sd.take(h + "self_attn_layer_norm.weight"),
+            "ln1_bias": sd.take(h + "self_attn_layer_norm.bias"),
+            "wq": sd.take(h + "self_attn.q_proj.weight").T,
+            "wk": sd.take(h + "self_attn.k_proj.weight").T,
+            "wv": sd.take(h + "self_attn.v_proj.weight").T,
+            "bq": sd.take(h + "self_attn.q_proj.bias"),
+            "bk": sd.take(h + "self_attn.k_proj.bias"),
+            "bv": sd.take(h + "self_attn.v_proj.bias"),
+            "wo": sd.take(h + "self_attn.out_proj.weight").T,
+            "bo": sd.take(h + "self_attn.out_proj.bias"),
+            "ln2_scale": sd.take(h + "final_layer_norm.weight"),
+            "ln2_bias": sd.take(h + "final_layer_norm.bias"),
+            "w_in": sd.take(h + "fc1.weight").T,
+            "b_in": sd.take(h + "fc1.bias"),
+            "w_out": sd.take(h + "fc2.weight").T,
+            "b_out": sd.take(h + "fc2.bias"),
+        })
+    return {
+        "tok_embed": sd.take("embed_tokens.weight"),
+        "pos_embed": sd.take("embed_positions.weight")[2:],   # offset-2 rows
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("final_layer_norm.weight"),
+        "lnf_bias": sd.take("final_layer_norm.bias"),
+    }
+
+
 _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
     "llama": (_llama_config, _llama_convert, ("model.",)),
     "mistral": (_llama_config, _llama_convert, ("model.",)),
     "mixtral": (_llama_config, _llama_convert, ("model.",)),
+    "opt": (_opt_config, _opt_convert, ("model.decoder.", "decoder.")),
 }
 
 
@@ -240,6 +294,8 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "gpt2"
     if any("block_sparse_moe" in k for k in keys):
         return "mixtral"
+    if any("decoder.layers" in k and "fc1" in k for k in keys):
+        return "opt"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
     raise ValueError("cannot detect model family from checkpoint keys; "

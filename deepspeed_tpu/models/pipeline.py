@@ -56,6 +56,11 @@ class PipelinedTransformerLM(TransformerLM):
     def __init__(self, config: TransformerConfig, n_stages: int,
                  num_micro: int | None = None, attention_fn=None,
                  tick_remat: bool = False):
+        if config.objective != "clm":
+            raise ValueError(
+                "the pipelined loss computes shifted next-token CE; "
+                f"objective={config.objective!r} (MLM/encoder) is not "
+                "supported under pipeline parallelism")
         super().__init__(config, attention_fn)
         assert config.n_layer % n_stages == 0, (
             f"n_layer {config.n_layer} not divisible by {n_stages} stages")

@@ -55,6 +55,60 @@ def mixtral(size: str = "8x7b", **overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def bert(size: str = "base", **overrides) -> TransformerConfig:
+    """Encoder (bidirectional) trunk + MLM objective — the BERT family the
+    reference's flagship pretraining baseline uses
+    (``docs/_tutorials/bert-pretraining.md``)."""
+    table = {
+        "tiny": dict(n_layer=2, n_head=4, d_model=128, d_ff=512, max_seq=128),
+        "base": dict(n_layer=12, n_head=12, d_model=768, max_seq=512),
+        "large": dict(n_layer=24, n_head=16, d_model=1024, max_seq=512),
+    }
+    base = dict(vocab_size=30522, pos_embedding="learned", norm="layernorm",
+                activation="gelu", use_bias=True, tie_embeddings=True,
+                causal=False, objective="mlm")
+    base.update(table[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def opt(size: str = "125m", **overrides) -> TransformerConfig:
+    """OPT family (reference inference container ``containers/opt.py``):
+    decoder with learned positions and ReLU FFN."""
+    table = {
+        "tiny": dict(n_layer=2, n_head=4, d_model=64, d_ff=256, max_seq=64),
+        "125m": dict(n_layer=12, n_head=12, d_model=768),
+        "1.3b": dict(n_layer=24, n_head=32, d_model=2048),
+        "6.7b": dict(n_layer=32, n_head=32, d_model=4096),
+        "13b": dict(n_layer=40, n_head=40, d_model=5120),
+    }
+    base = dict(vocab_size=50272, max_seq=2048, pos_embedding="learned",
+                norm="layernorm", activation="relu", use_bias=True,
+                tie_embeddings=True)
+    base.update(table[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bloom(size: str = "560m", **overrides) -> TransformerConfig:
+    """Bloom family (reference container ``containers/bloom.py``): ALiBi
+    position bias, no positional table. Native trunk only — the importer
+    does not map Bloom checkpoints (fused per-head qkv + embedding
+    layernorm differ structurally)."""
+    table = {
+        "tiny": dict(n_layer=2, n_head=4, d_model=64, d_ff=256, max_seq=64),
+        "560m": dict(n_layer=24, n_head=16, d_model=1024),
+        "7b": dict(n_layer=30, n_head=32, d_model=4096),
+        "176b": dict(n_layer=70, n_head=112, d_model=14336),
+    }
+    base = dict(vocab_size=250880, max_seq=2048, pos_embedding="alibi",
+                norm="layernorm", activation="gelu", use_bias=True,
+                tie_embeddings=True)
+    base.update(table[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 def tiny_test(**overrides) -> TransformerConfig:
     """Unit-test sized config (analog of the reference tests' SimpleModel)."""
     base = dict(vocab_size=256, n_layer=2, n_head=4, d_model=64, d_ff=128,

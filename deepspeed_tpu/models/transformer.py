@@ -46,12 +46,15 @@ class TransformerConfig:
     d_ff: Optional[int] = None            # default 4*d_model (gpt2) / from preset
     max_seq: int = 1024
     # family switches
-    pos_embedding: str = "learned"        # "learned" (gpt2) | "rope" (llama)
+    pos_embedding: str = "learned"        # "learned" (gpt2/opt) | "rope"
+                                          # (llama) | "alibi" (bloom)
     norm: str = "layernorm"               # "layernorm" | "rmsnorm"
     norm_eps: float = 1e-5                # HF llama checkpoints vary (1e-5/1e-6)
-    activation: str = "gelu"              # "gelu" | "silu_glu" (llama)
+    activation: str = "gelu"              # "gelu" | "silu_glu" (llama) | "relu" (opt)
     use_bias: bool = True                 # gpt2 yes, llama no
     tie_embeddings: bool = True
+    causal: bool = True                   # False => encoder (BERT family)
+    objective: str = "clm"                # "clm" next-token | "mlm" (BERT)
     rope_theta: float = 10000.0
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16             # compute dtype
@@ -139,11 +142,30 @@ def _rope(q, k, positions, theta: float):
     return rot(q.astype(jnp.float32)).astype(q.dtype), rot(k.astype(jnp.float32)).astype(k.dtype)
 
 
-def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None):
-    """Plain causal attention, fp32 softmax. q:(B,S,H,hd) k/v:(B,S,KV,hd).
+def alibi_slopes(n_head: int) -> jnp.ndarray:
+    """Standard ALiBi per-head slopes (Bloom; geometric in 2^(-8/n))."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
 
-    Heads are grouped for GQA by repeating kv. The Pallas flash kernel
-    (ops/flash_attention.py) replaces this on TPU for long sequences.
+    if math.log2(n_head).is_integer():
+        slopes = pow2_slopes(n_head)
+    else:
+        closest = 2 ** math.floor(math.log2(n_head))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)[0::2][:n_head - closest]
+        slopes += extra
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None,
+                     causal: bool = True, bias: jnp.ndarray | None = None):
+    """Plain attention, fp32 softmax. q:(B,S,H,hd) k/v:(B,S,KV,hd).
+
+    ``causal=False`` = bidirectional (encoder); ``bias`` is an additive
+    (H, S, S) score bias (ALiBi). Heads are grouped for GQA by repeating kv.
+    The Pallas flash kernel (ops/flash_attention.py) replaces this on TPU
+    for long sequences.
     """
     B, S, H, hd = q.shape
     KV = k.shape[2]
@@ -151,9 +173,12 @@ def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None):
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)
     scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / math.sqrt(hd)
-    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    if bias is not None:
+        scores = scores + bias[None].astype(jnp.float32)
     big_neg = jnp.finfo(jnp.float32).min
-    scores = jnp.where(causal[None, None, :, :], scores, big_neg)
+    if causal:
+        tri = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(tri[None, None, :, :], scores, big_neg)
     if mask is not None:  # (B, S) padding mask on keys
         scores = jnp.where(mask[:, None, None, :].astype(bool), scores, big_neg)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -166,7 +191,18 @@ class TransformerLM:
 
     def __init__(self, config: TransformerConfig, attention_fn=None):
         self.cfg = config
-        self.attention_fn = attention_fn or causal_attention
+        if attention_fn is not None and not config.causal:
+            raise ValueError(
+                "encoder (causal=False) configs require the default "
+                "attention: the flash/sparse/Ulysses attention_fns apply a "
+                "causal mask and would silently break bidirectionality")
+        if attention_fn is not None and config.pos_embedding == "alibi":
+            raise ValueError(
+                "alibi needs an additive score bias, which custom "
+                "attention_fns (flash/sparse/Ulysses) do not accept; use "
+                "the default attention")
+        self.attention_fn = attention_fn or partial(causal_attention,
+                                                    causal=config.causal)
 
     # ----------------------------------------------------------------- init
     def init(self, rng) -> dict:
@@ -284,6 +320,14 @@ class TransformerLM:
         vv = self._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, S, kv, hd)
         if cfg.pos_embedding == "rope":
             q, kk = _rope(q, kk, positions, cfg.rope_theta)
+        attn_kw = {}
+        if cfg.pos_embedding == "alibi":
+            # ALiBi (Bloom): linear distance bias on the scores instead of
+            # any positional embedding (custom attention_fns are rejected at
+            # construction — they can't take a score bias).
+            rel = (jnp.arange(S)[None, :] - jnp.arange(S)[:, None])
+            attn_kw["bias"] = (alibi_slopes(h)[:, None, None]
+                               * rel[None].astype(jnp.float32))
         if getattr(self.attention_fn, "handles_sharding", False):
             # Explicit-collective attention (sequence/layer.py Ulysses or
             # ring): the wrapper does its own shard_map resharding.
@@ -296,7 +340,7 @@ class TransformerLM:
                 if kv < h else constrain(kk, P(B_AXES, None, ("model", "seq"), None))
             vs = constrain(vv, P(B_AXES, None, None, None)) \
                 if kv < h else constrain(vv, P(B_AXES, None, ("model", "seq"), None))
-            o = self.attention_fn(qs, ks, vs, mask=attn_mask)
+            o = self.attention_fn(qs, ks, vs, mask=attn_mask, **attn_kw)
             o = constrain(o, P(B_AXES, "seq", "model", None))
         o = self._maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), p, "bo")
         return x + o
@@ -309,6 +353,8 @@ class TransformerLM:
             u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
         elif cfg.activation == "gelu":
             u = jax.nn.gelu(u)
+        elif cfg.activation == "relu":
+            u = jax.nn.relu(u)
         else:
             u = jax.nn.silu(u)
         u = constrain(u, P(B_AXES, "seq", "model"))
@@ -397,11 +443,24 @@ class TransformerLM:
 
     # ----------------------------------------------------------------- loss
     def loss(self, params, batch, *, remat_policy=None):
-        """Next-token cross-entropy, fp32, mean over non-pad target tokens,
-        plus the MoE load-balancing aux loss when the trunk routes."""
+        """Objective-dependent cross-entropy, fp32, mean over counted tokens,
+        plus the MoE load-balancing aux loss when the trunk routes.
+
+        ``clm``: next-token over (possibly loss-masked) positions.
+        ``mlm`` (encoder / BERT): predict ``batch['labels']`` at the
+        positions marked by ``batch['loss_mask']`` — no shift."""
         ids = batch["input_ids"]
         logits, aux = self.apply(params, ids, attn_mask=batch.get("attention_mask"),
                                  remat_policy=remat_policy, return_aux=True)
+        if self.cfg.objective == "mlm":
+            labels = batch["labels"]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+            mask = batch["loss_mask"].astype(jnp.float32)
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            if self.cfg.num_experts > 1:
+                ce = ce + self.cfg.moe_aux_loss_weight * aux
+            return ce
         targets = ids[:, 1:]
         logits = logits[:, :-1].astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)

@@ -329,15 +329,9 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     B, S, H, hd = q.shape
     blk = min(block, S)
     if S % blk != 0:
-        if not causal:
-            # causal_attention() always applies the causal mask; a silent
-            # fallback would return wrong (triangular) outputs here
-            raise ValueError(
-                f"flash_attention(causal=False) needs S ({S}) divisible by "
-                f"the block size ({blk}); pad the sequence or pick a block")
         from ..models.transformer import causal_attention
 
-        return causal_attention(q, k, v, mask=mask)
+        return causal_attention(q, k, v, mask=mask, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     KV = k.shape[2]

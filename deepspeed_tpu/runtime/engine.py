@@ -111,6 +111,28 @@ class Engine:
                 "first/last-layer-full rule would apply per stage, not "
                 "globally; disable one of the two")
         self.dp_world = dp_world_size(self.mesh)
+        el = self.config.elasticity
+        if el.enabled:
+            from ..elasticity import ElasticityError, elastic_batch_for
+
+            explicit = [f for f in ("train_batch_size",
+                                    "train_micro_batch_size_per_gpu",
+                                    "gradient_accumulation_steps")
+                        if isinstance(getattr(self.config, f), int)]
+            if explicit and not el.ignore_non_elastic_batch_info:
+                raise ElasticityError(
+                    f"elasticity.enabled with explicit {explicit}: the "
+                    "elastic schema owns the batch arithmetic (set "
+                    "ignore_non_elastic_batch_info to drop the explicit "
+                    "values, reference behavior)")
+            batch, micro, gas = elastic_batch_for(el, self.dp_world)
+            self.config = self.config.model_copy(update={
+                "train_batch_size": batch,
+                "train_micro_batch_size_per_gpu": micro,
+                "gradient_accumulation_steps": gas,
+            })
+            log_dist(f"elasticity: world={self.dp_world} → global={batch} "
+                     f"micro={micro} gas={gas}", ranks=[0])
         self.config = self.config.resolve_batch_sizes(self.dp_world)
         self.seed = self.config.seed if seed is None else seed
 
@@ -803,11 +825,21 @@ class Engine:
     def save_checkpoint(self, save_dir: str, tag: str | None = None) -> str:
         from .checkpoint.engine import save_checkpoint as _save
 
+        if self.config.elasticity.enabled:
+            # cross-restart immutability of the elastic schema (reference
+            # elasticity.py:208): fingerprint lives next to the checkpoints
+            from ..elasticity import assert_elastic_config_consistent
+
+            assert_elastic_config_consistent(self.config.elasticity, save_dir)
         return _save(self, save_dir, tag)
 
     def load_checkpoint(self, load_dir: str, tag: str | None = None) -> str:
         from .checkpoint.engine import load_checkpoint as _load
 
+        if self.config.elasticity.enabled:
+            from ..elasticity import assert_elastic_config_consistent
+
+            assert_elastic_config_consistent(self.config.elasticity, load_dir)
         return _load(self, load_dir, tag)
 
 

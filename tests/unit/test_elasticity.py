@@ -1,0 +1,119 @@
+"""Elasticity: batch-compatible world sizes, restart immutability, engine
+integration (reference ``elasticity/elasticity.py``)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.config.config import ElasticityConfig
+from deepspeed_tpu.elasticity import (ElasticityError,
+                                      assert_elastic_config_consistent,
+                                      compute_elastic_config,
+                                      elastic_batch_for)
+from deepspeed_tpu.elasticity.elasticity import micro_for_world
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def test_compute_elastic_config_basic():
+    batch, valid, micro = compute_elastic_config(
+        max_train_batch_size=64, micro_batch_sizes=[2, 4],
+        min_devices=1, max_devices=16)
+    assert batch <= 64
+    # every valid world decomposes the batch exactly
+    for w in valid:
+        m = micro_for_world(batch, [2, 4], w)
+        assert batch % (m * w) == 0
+    # candidate set is lcm × 2^k (reference v0.1): power-of-two worlds covered
+    assert batch == 64 and 8 in valid and 1 in valid
+
+
+def test_prefer_larger_batch_tiebreak():
+    big, _, _ = compute_elastic_config(
+        max_train_batch_size=64, micro_batch_sizes=[1],
+        min_devices=1, max_devices=4, prefer_larger_batch=True)
+    small, _, _ = compute_elastic_config(
+        max_train_batch_size=64, micro_batch_sizes=[1],
+        min_devices=1, max_devices=4, prefer_larger_batch=False)
+    assert big >= small
+
+
+def test_incompatible_world_raises():
+    cfg = ElasticityConfig(enabled=True, max_train_batch_size=16,
+                           micro_batch_sizes=[16], min_devices=1,
+                           max_devices=1)
+    with pytest.raises(ElasticityError):
+        elastic_batch_for(cfg, world=7)
+
+
+def test_bad_config_raises():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(max_train_batch_size=1,
+                               micro_batch_sizes=[8], min_devices=4,
+                               max_devices=8)
+
+
+def test_restart_immutability(tmp_path):
+    cfg = ElasticityConfig(enabled=True, max_train_batch_size=128,
+                           micro_batch_sizes=[2, 4])
+    assert_elastic_config_consistent(cfg, str(tmp_path))
+    assert_elastic_config_consistent(cfg, str(tmp_path))   # same → ok
+    changed = ElasticityConfig(enabled=True, max_train_batch_size=256,
+                               micro_batch_sizes=[2, 4])
+    with pytest.raises(ElasticityError, match="changed across restarts"):
+        assert_elastic_config_consistent(changed, str(tmp_path))
+
+
+def test_engine_resolves_elastic_batch():
+    """8-device mesh: the engine derives (batch, micro, gas) from the elastic
+    schema, trains, and the same config would also fit other world sizes."""
+    engine = ds.initialize({
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [1, 2, 4], "max_devices": 16},
+    }, build_model(tiny_test()))
+    assert engine.train_batch_size <= 64
+    assert engine.train_batch_size % 8 == 0
+    data = random_token_dataset(engine.train_batch_size, 32, 256,
+                                learnable=True)
+    batch = DataLoader(data, local_batch_size=engine.train_batch_size,
+                       shuffle=False).collate_fn(data)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_engine_rejects_conflicting_batch_info():
+    with pytest.raises(ElasticityError, match="train_batch_size"):
+        ds.initialize({
+            "train_batch_size": 32,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                           "micro_batch_sizes": [2]},
+        }, build_model(tiny_test()))
+
+
+def test_elastic_fingerprint_enforced_on_checkpoint(tmp_path):
+    def make(maxb):
+        return ds.initialize({
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "elasticity": {"enabled": True, "max_train_batch_size": maxb,
+                           "micro_batch_sizes": [1, 2], "max_devices": 16},
+        }, build_model(tiny_test()))
+
+    e1 = make(32)
+    e1.save_checkpoint(str(tmp_path))
+    e2 = make(64)           # changed elastic schema
+    with pytest.raises(ElasticityError, match="changed across restarts"):
+        e2.save_checkpoint(str(tmp_path))
+    with pytest.raises(ElasticityError, match="changed across restarts"):
+        e2.load_checkpoint(str(tmp_path))
+
+
+def test_engine_rejects_explicit_micro_batch():
+    with pytest.raises(ElasticityError, match="micro_batch"):
+        ds.initialize({
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                           "micro_batch_sizes": [2]},
+        }, build_model(tiny_test()))

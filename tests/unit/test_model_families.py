@@ -1,0 +1,125 @@
+"""Model families: BERT encoder (MLM), OPT (relu + learned pos, HF logits
+equivalence), Bloom (ALiBi) — reference model_implementations /
+module_inject containers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import bert, bloom, build_model, opt
+from deepspeed_tpu.runtime.dataloader import DataLoader
+
+
+# ------------------------------------------------------------------- BERT
+def _mlm_batch(rng, B, S, vocab, mask_frac=0.15):
+    labels = rng.integers(0, vocab, (B, S), dtype=np.int32)
+    mask = rng.random((B, S)) < mask_frac
+    ids = labels.copy()
+    ids[mask] = vocab - 1                      # [MASK] token
+    return {"input_ids": ids, "labels": labels,
+            "loss_mask": mask.astype(np.float32)}
+
+
+def test_bert_encoder_is_bidirectional():
+    cfg = bert("tiny", dtype=jnp.float32)
+    assert not cfg.causal and cfg.objective == "mlm"
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 16),
+                                            dtype=np.int32)
+    base = np.asarray(model.apply(params, jnp.asarray(ids)))
+    # changing a LATER token must change EARLIER positions' outputs
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+    pert = np.asarray(model.apply(params, jnp.asarray(ids2)))
+    assert np.abs(base[0, 0] - pert[0, 0]).max() > 1e-6
+
+
+def test_bert_mlm_trains():
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+    }, build_model(bert("tiny", vocab_size=256, max_seq=32)))
+    rng = np.random.default_rng(0)
+    batch = _mlm_batch(rng, 8, 32, 256)
+    losses = [float(engine.train_batch(dict(batch))["loss"]) for _ in range(5)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+# -------------------------------------------------------------------- OPT
+def test_opt_logits_match_hf():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, ffn_dim=144, max_position_embeddings=64,
+        activation_function="relu")
+    hf = transformers.OPTForCausalLM(hf_cfg).eval()
+    from deepspeed_tpu.models import TransformerConfig, import_state_dict
+
+    cfg, params = import_state_dict(hf.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    assert cfg.activation == "relu"
+    cfg = TransformerConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+    model = build_model(cfg)
+    ids = np.random.default_rng(1).integers(0, 128, (2, 12), dtype=np.int64)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.float().numpy()
+    got = np.asarray(model.apply(jax.tree.map(jnp.asarray, params),
+                                 jnp.asarray(ids.astype(np.int32))))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+# ------------------------------------------------------------------ Bloom
+def test_bloom_alibi_trains_and_extrapolates():
+    cfg = bloom("tiny", vocab_size=256, max_seq=64, dtype=jnp.float32)
+    assert cfg.pos_embedding == "alibi"
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    assert "pos_embed" not in params          # no positional table
+    ids = np.random.default_rng(0).integers(0, 256, (1, 32), dtype=np.int32)
+    out = np.asarray(model.apply(params, jnp.asarray(ids)))
+    assert np.all(np.isfinite(out))
+    # causal: changing the last token must NOT change earlier outputs
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % 256
+    pert = np.asarray(model.apply(params, jnp.asarray(ids2)))
+    np.testing.assert_allclose(out[0, :-1], pert[0, :-1], atol=1e-5)
+
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+    }, build_model(bloom("tiny", vocab_size=256, max_seq=64)))
+    from deepspeed_tpu.runtime.dataloader import random_token_dataset
+
+    data = random_token_dataset(8, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_alibi_slopes_standard_values():
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    s8 = np.asarray(alibi_slopes(8))
+    np.testing.assert_allclose(s8[0], 2 ** -1.0)
+    np.testing.assert_allclose(s8[-1], 2 ** -8.0)
+    s12 = np.asarray(alibi_slopes(12))      # non-power-of-two head count
+    assert len(s12) == 12 and np.all(s12 > 0)
+
+
+def test_encoder_rejects_custom_attention_and_pipeline():
+    from deepspeed_tpu.models import PipelinedTransformerLM, TransformerLM
+    from deepspeed_tpu.ops.flash_attention import make_flash_attention
+
+    with pytest.raises(ValueError, match="bidirectional"):
+        TransformerLM(bert("tiny"), attention_fn=make_flash_attention())
+    with pytest.raises(ValueError, match="alibi"):
+        TransformerLM(bloom("tiny"), attention_fn=make_flash_attention())
+    with pytest.raises(ValueError, match="pipeline|MLM"):
+        PipelinedTransformerLM(bert("tiny", n_layer=4), n_stages=2)
