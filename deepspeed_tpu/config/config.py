@@ -243,6 +243,15 @@ class HeadPruningConfig(ConfigModel):
     schedule_offset: int = 0
 
 
+class ProgressiveLayerDropConfig(ConfigModel):
+    """Scheduled stochastic depth (reference
+    ``runtime/progressive_layer_drop.py:40``)."""
+
+    enabled: bool = False
+    theta: float = 0.5          # terminal keep probability
+    gamma: float = 0.001        # decay rate of theta(t)
+
+
 class ElasticityConfig(ConfigModel):
     """Elastic batch schema (reference ``elasticity/config.py`` v0.1/0.2)."""
 
@@ -315,6 +324,8 @@ class Config(ConfigModel):
         default_factory=DataEfficiencyConfig)
     compression: CompressionConfig = Field(default_factory=CompressionConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = Field(
+        default_factory=ProgressiveLayerDropConfig)
 
     DEPRECATED_ALIASES: ClassVar[dict[str, str]] = {"zero": "zero_optimization"}
 

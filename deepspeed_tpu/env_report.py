@@ -1,0 +1,75 @@
+"""Environment report CLI (``dstpu_report``).
+
+Analog of the reference's ``ds_report`` (``env_report.py``): versions,
+platform/device inventory, memory kinds, and the op compatibility matrix —
+which ops have a native/Pallas implementation available right now and which
+fall back.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def collect_report() -> dict:
+    import jax
+    import jaxlib
+    import numpy as np
+
+    import deepspeed_tpu
+
+    from .ops.builder import op_report
+    from .ops.registry import available_ops
+    from .platform.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    versions = {
+        "deepspeed_tpu": deepspeed_tpu.__version__,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "numpy": np.__version__,
+        "python": sys.version.split()[0],
+    }
+    try:
+        import orbax.checkpoint as ocp
+
+        versions["orbax-checkpoint"] = getattr(ocp, "__version__", "?")
+    except Exception:
+        versions["orbax-checkpoint"] = "MISSING"
+    return {
+        "versions": versions,
+        "platform": acc.platform,
+        "devices": acc.device_count(),
+        "local_devices": acc.local_device_count(),
+        "processes": acc.process_count(),
+        "memory_kinds": list(acc.memory_kinds()),
+        "host_offload": acc.supports_host_offload(),
+        "native_ops": op_report(),
+        "registered_ops": available_ops(),
+    }
+
+
+def main() -> None:
+    rep = collect_report()
+    line = "-" * 60
+    print(line)
+    print("deepspeed_tpu environment report (ds_report analog)")
+    print(line)
+    for k, v in rep["versions"].items():
+        print(f"{k:<20} {v}")
+    print(line)
+    print(f"{'platform':<20} {rep['platform']}")
+    print(f"{'devices':<20} {rep['devices']} "
+          f"(local {rep['local_devices']}, processes {rep['processes']})")
+    print(f"{'memory kinds':<20} {', '.join(rep['memory_kinds'])}")
+    print(f"{'host offload':<20} {rep['host_offload']}")
+    print(line)
+    print("op compatibility (native build status):")
+    for name, ok in sorted(rep["native_ops"].items()):
+        print(f"  {name:<26} {'OKAY' if ok else 'python-fallback'}")
+    print("registered ops: " + ", ".join(rep["registered_ops"]))
+    print(line)
+
+
+if __name__ == "__main__":
+    main()

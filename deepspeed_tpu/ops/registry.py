@@ -26,6 +26,7 @@ def register_op(name: str, platform: str = "default"):
 
 
 def get_op_builder(name: str, platform: str = "tpu") -> Callable:
+    _ensure_builtin_ops()
     impls = _REGISTRY.get(name)
     if not impls:
         raise KeyError(f"unknown op '{name}'; registered: {sorted(_REGISTRY)}")
@@ -37,4 +38,50 @@ def get_op_builder(name: str, platform: str = "tpu") -> Callable:
 
 
 def available_ops() -> list[str]:
+    _ensure_builtin_ops()
     return sorted(_REGISTRY)
+
+
+_BUILTIN_REGISTERED = False
+
+
+def _ensure_builtin_ops() -> None:
+    """Register the framework's real ops (lazily — the heavy modules only
+    import when an op is actually requested).
+
+    Builders mirror the reference's ``create_op_builder(name)`` contract:
+    each returns the op's callable entry point for the platform."""
+    global _BUILTIN_REGISTERED
+    if _BUILTIN_REGISTERED:
+        return
+    _BUILTIN_REGISTERED = True
+
+    @register_op("flash_attention")
+    def _flash():
+        from .flash_attention import flash_attention
+        return flash_attention
+
+    @register_op("decode_attention")
+    def _decode():
+        from .decode_attention import decode_attention
+        return decode_attention
+
+    @register_op("sparse_attention")
+    def _sparse():
+        from .sparse_attention import sparse_attention
+        return sparse_attention
+
+    @register_op("quantizer")
+    def _quant():
+        from . import quant
+        return quant
+
+    @register_op("cpu_optimizer")
+    def _cpu_opt():
+        from . import cpu_optimizer
+        return cpu_optimizer
+
+    @register_op("async_io")
+    def _aio():
+        from .aio import AsyncIOHandle
+        return AsyncIOHandle

@@ -1,0 +1,32 @@
+"""Tiled matmul: bound the gathered-weight working set of giant linears.
+
+Analog of the reference's ``TiledLinear`` (``runtime/zero/tiling.py:32``),
+which splits a huge linear into sub-linears so ZeRO-3 only materializes one
+tile's worth of gathered parameters at a time. The JAX shape of the same
+idea: scan over column tiles of the weight; inside the scan each tile is the
+unit XLA gathers/keeps live, so peak memory holds ~one tile of W instead of
+all of it (plus remat-friendliness for the giant vocab head)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def tiled_matmul(x: jnp.ndarray, w: jnp.ndarray, n_tiles: int) -> jnp.ndarray:
+    """x @ w computed as a scan over ``n_tiles`` column tiles of ``w``.
+
+    x: (..., K); w: (K, N) with N divisible by n_tiles → (..., N)."""
+    K, N = w.shape
+    if N % n_tiles != 0:
+        raise ValueError(f"output dim {N} not divisible by n_tiles={n_tiles}")
+    if n_tiles == 1:
+        return x @ w
+    tiles = w.reshape(K, n_tiles, N // n_tiles).swapaxes(0, 1)  # (T, K, N/T)
+
+    def body(_, wt):
+        return None, x @ wt
+
+    _, out = lax.scan(body, None, tiles)                # (T, ..., N/T)
+    return jnp.moveaxis(out, 0, -2).reshape(x.shape[:-1] + (N,))

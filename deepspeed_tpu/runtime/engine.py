@@ -96,6 +96,13 @@ class Engine:
 
             self.model = model = convert_to_compressed(
                 model, self.config.compression)
+        self._pld = self.config.progressive_layer_drop.enabled
+        if self._pld:
+            from .progressive_layer_drop import convert_to_progressive_layer_drop
+
+            pld = self.config.progressive_layer_drop
+            self.model = model = convert_to_progressive_layer_drop(
+                model, theta=pld.theta, gamma=pld.gamma)
         if self._ltd is not None:
             from ..data_pipeline.random_ltd import convert_to_random_ltd
 
@@ -110,6 +117,12 @@ class Engine:
                 "pipe shard_map scans stage-local layer slices, so the "
                 "first/last-layer-full rule would apply per stage, not "
                 "globally; disable one of the two")
+        if self._pld and int(self.mesh.shape.get("pipe", 1)) > 1:
+            raise ValueError(
+                "progressive_layer_drop is not supported with pipeline "
+                "parallelism: the depth-scaled keep probability would be "
+                "computed per stage-local slice, not over the global depth; "
+                "disable one of the two")
         self.dp_world = dp_world_size(self.mesh)
         el = self.config.elasticity
         if el.enabled:
@@ -229,6 +242,11 @@ class Engine:
                 "random_ltd is not supported with offload_optimizer (the "
                 "host-optimizer grad step is not rebuilt on schedule "
                 "changes); disable one of the two")
+        if self.offload and self._pld:
+            raise ValueError(
+                "progressive_layer_drop is not supported with "
+                "offload_optimizer (the host-optimizer grad step never sets "
+                "the schedule step); disable one of the two")
         if self.offload and self._comp:
             raise ValueError(
                 "compression is not supported with offload_optimizer (the "
@@ -611,11 +629,16 @@ class Engine:
             self.model.set_ltd_tokens(ltd_tokens)
         if self._comp:
             self.model.set_compression_active(comp_active)
+        if self._pld:
+            # traced scalar: the keep-prob schedule is continuous, no retrace
+            self.model.set_pld_step(state.step)
         if self.onebit is not None:
             from .onebit import onebit_train_step
 
             new_master, new_opt, new_ce, loss, gnorm, lr = onebit_train_step(
                 self, state, batch, jnp.float32(1.0), onebit_warmup)
+            if self._pld:
+                self.model.set_pld_step(None)   # don't leak the tracer
             new_state = TrainState(
                 step=state.step + 1, master_params=new_master,
                 opt_state=new_opt, loss_scale=state.loss_scale,
@@ -674,6 +697,8 @@ class Engine:
         new_master, new_opt, skipped = lax.cond(finite, do_update, skip_update, None)
         new_ls = update_loss_scale(state.loss_scale, finite, cfg.fp16)
 
+        if self._pld:
+            self.model.set_pld_step(None)   # the traced step must not leak
         new_state = TrainState(
             step=state.step + 1,
             master_params=new_master,
@@ -696,6 +721,8 @@ class Engine:
             # eval sees the fully-compressed network (what would be exported)
             self.model.set_compression_active(
                 tuple(sorted(n for n, _ in self._comp)))
+        if self._pld:
+            self.model.set_pld_step(None)   # eval runs every layer
         return self.model.loss(cp, batch)
 
     # ------------------------------------------------------------ public API

@@ -1,0 +1,82 @@
+"""Progressive layer drop (PLD): scheduled stochastic depth.
+
+Analog of the reference's ``runtime/progressive_layer_drop.py:40`` + its
+engine hook (``engine.py:1786``): the keep probability
+``theta(t) = (1 - theta_min)·exp(-gamma·t) + theta_min`` decays from 1
+toward ``theta_min`` over training, and deeper layers drop more aggressively
+(``p_l = 1 - (l/L)·(1 - theta)``, the PLD paper's depth scaling).  Dropped
+layers are skipped with ``lax.cond`` — the compute is actually saved at run
+time, not masked out.
+
+The step enters as a TRACED scalar (``pld_step`` attr set by the engine from
+``state.step`` inside the jitted step), so the schedule is continuous — no
+retrace per step. Eval leaves ``pld_step`` None → all layers run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class PLDMixin:
+    pld_theta_min: float = 0.5
+    pld_gamma: float = 0.001
+    pld_seed: int = 23
+    pld_step = None            # traced scalar during the train trace
+
+    def set_pld_step(self, step) -> None:
+        self.pld_step = step
+
+    def _scan_layers(self, x, layers, positions, attn_mask, remat_policy):
+        if self.pld_step is None:
+            return super()._scan_layers(x, layers, positions, attn_mask,
+                                        remat_policy)
+        L = jax.tree.leaves(layers)[0].shape[0]
+        t = self.pld_step.astype(jnp.float32)
+        theta = ((1.0 - self.pld_theta_min) * jnp.exp(-self.pld_gamma * t)
+                 + self.pld_theta_min)
+        # key entropy: the STEP drives per-step variation (activations alone
+        # are constant for, e.g., fixed-BOS data — the drop pattern would
+        # freeze and starve the same deep layers all run)
+        bits = lax.bitcast_convert_type(x[0, 0].astype(jnp.float32), jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.pld_seed),
+                                 self.pld_step.astype(jnp.int32))
+        key = jax.random.fold_in(key, jnp.sum(bits, dtype=jnp.int32)
+                                 & 0x7fffffff)
+
+        body = self._layer
+        if remat_policy is not None:
+            body = jax.checkpoint(self._layer, policy=remat_policy,
+                                  prevent_cse=False)
+
+        def scan_fn(carry, layer_params):
+            x, key, li = carry
+            key, sub = jax.random.split(key)
+            depth_frac = (li + 1).astype(jnp.float32) / L
+            keep_p = 1.0 - depth_frac * (1.0 - theta)
+            keep = jax.random.bernoulli(sub, keep_p)
+            x_new, aux = lax.cond(
+                keep,
+                lambda x: body(x, layer_params, positions, attn_mask),
+                lambda x: (x, jnp.float32(0.0)),
+                x)
+            return (x_new, key, li + 1), aux
+
+        (x, _, _), auxs = lax.scan(scan_fn, (x, key, jnp.int32(0)), layers)
+        return x, jnp.sum(auxs)
+
+
+def convert_to_progressive_layer_drop(model, *, theta: float = 0.5,
+                                      gamma: float = 0.001, seed: int = 23):
+    """Wrap a built model with PLD (same params/specs pytree)."""
+    cls = type(model)
+    new_cls = type(f"PLD{cls.__name__}", (PLDMixin, cls), {})
+    new = object.__new__(new_cls)
+    new.__dict__.update(model.__dict__)
+    new.pld_theta_min = theta
+    new.pld_gamma = gamma
+    new.pld_seed = seed
+    new.pld_step = None
+    return new

@@ -1,0 +1,59 @@
+"""Sparse embedding-gradient compression for host transfers.
+
+Analog of the reference's ``SparseTensor`` + sparse allreduce for embedding
+gradients (``runtime/sparse_tensor.py``, ``engine.py:2412-2480``): a batch
+touches only a small subset of a large vocabulary, so the embedding gradient
+is row-sparse. Under pure XLA data-parallel training the gradient reduction
+is compiler-managed and dense, so these helpers are a host-side utility for
+custom training loops and grad transports (the engine's offload path
+currently moves dense gradients; compressing there requires a device-side
+row-select before the transfer, which is future work) — the same role the
+reference's SparseTensor plays for its sparse-gradient embedding modules."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class SparseRows(NamedTuple):
+    """Row-sparse matrix: ``values[i]`` is the gradient row for
+    ``indices[i]``; shape is the dense (V, d)."""
+
+    indices: np.ndarray        # (nnz,) int32 unique row ids
+    values: np.ndarray         # (nnz, d)
+    shape: tuple
+
+    @property
+    def density(self) -> float:
+        return len(self.indices) / max(1, self.shape[0])
+
+
+def compress_rows(dense: np.ndarray, threshold: float = 0.0) -> SparseRows:
+    """Dense (V, d) grad → row-sparse form (rows with any |entry| >
+    threshold kept)."""
+    keep = np.where(np.abs(dense).max(axis=1) > threshold)[0]
+    return SparseRows(indices=keep.astype(np.int32),
+                      values=np.ascontiguousarray(dense[keep]),
+                      shape=tuple(dense.shape))
+
+
+def decompress_rows(sp: SparseRows) -> np.ndarray:
+    out = np.zeros(sp.shape, sp.values.dtype)
+    out[sp.indices] = sp.values
+    return out
+
+
+def add_into(dense: np.ndarray, sp: SparseRows) -> np.ndarray:
+    """Accumulate a sparse grad into a dense buffer (the host-optimizer
+    consumption path)."""
+    np.add.at(dense, sp.indices, sp.values)
+    return dense
+
+
+def maybe_compress(dense: np.ndarray, max_density: float = 0.5):
+    """Compress when it pays (reference keeps dense beyond ~half density):
+    returns SparseRows or the dense array unchanged."""
+    sp = compress_rows(dense)
+    return sp if sp.density <= max_density else dense

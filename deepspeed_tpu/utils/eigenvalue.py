@@ -1,0 +1,74 @@
+"""Hessian eigenvalue estimation by power iteration.
+
+Analog of the reference's ``runtime/eigenvalue.py:149`` (power iteration on
+the loss curvature, used to rank layers for MoQ precision switching —
+``engine.py:2116-2127``). The torch version differentiates twice through
+retained graphs; in JAX the Hessian-vector product is one
+``jvp``-of-``grad`` composition, jittable end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_dot(a, b):
+    return sum(jnp.vdot(x, y) for x, y in
+               zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _tree_norm(a):
+    return jnp.sqrt(jnp.real(_tree_dot(a, a)))
+
+
+def max_eigenvalue(loss_fn: Callable, params, *, iters: int = 10,
+                   seed: int = 0, tol: float = 0.0):
+    """Dominant Hessian eigenvalue of ``loss_fn(params)`` via power
+    iteration. Returns (eigenvalue, eigenvector pytree)."""
+    grad_fn = jax.grad(loss_fn)
+
+    def hvp(v):
+        return jax.jvp(grad_fn, (params,), (v,))[1]
+
+    keys = jax.random.split(jax.random.PRNGKey(seed),
+                            len(jax.tree.leaves(params)))
+    flat, treedef = jax.tree.flatten(params)
+    v = treedef.unflatten([jax.random.normal(k, p.shape, jnp.float32)
+                           for k, p in zip(keys, flat)])
+    n0 = _tree_norm(v)
+    v = jax.tree.map(lambda x: x / n0, v)
+
+    eig = jnp.float32(0.0)
+    for _ in range(iters):
+        hv = hvp(v)
+        new_eig = jnp.real(_tree_dot(v, hv))
+        norm = _tree_norm(hv)
+        v = jax.tree.map(lambda x: x / jnp.maximum(norm, 1e-12), hv)
+        if tol and abs(float(new_eig) - float(eig)) < tol:
+            eig = new_eig
+            break
+        eig = new_eig
+    return eig, v
+
+
+def layer_eigenvalues(loss_fn: Callable, params, layer_key: str = "layers",
+                      **kw) -> jnp.ndarray:
+    """Per-layer dominant eigenvalues over the stacked (L, ...) layer pytree
+    (the reference ranks modules this way for MoQ). Restricts the power
+    iteration to one layer's slice at a time, other params frozen."""
+    L = jax.tree.leaves(params[layer_key])[0].shape[0]
+    eigs = []
+    for i in range(L):
+        def layer_loss(layer_i, i=i):
+            stitched = {**params, layer_key: jax.tree.map(
+                lambda full, one: full.at[i].set(one),
+                params[layer_key], layer_i)}
+            return loss_fn(stitched)
+
+        layer_params = jax.tree.map(lambda a: a[i], params[layer_key])
+        eig, _ = max_eigenvalue(layer_loss, layer_params, **kw)
+        eigs.append(eig)
+    return jnp.stack(eigs)
