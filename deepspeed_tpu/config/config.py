@@ -166,7 +166,10 @@ class CheckpointConfig(ConfigModel):
     use_node_local_storage: bool = False
     tag_validation: Literal["ignore", "warn", "fail"] = "warn"
     load_universal: bool = False
-    async_save: bool = True
+    # async saves overlap the tensorstore commit with training; the 'latest'
+    # pointer only flips once the commit is durable (wait_for_checkpoint /
+    # the next save/load). Opt-in, like the reference's Nebula engine.
+    async_save: bool = False
 
 
 class DataTypesConfig(ConfigModel):

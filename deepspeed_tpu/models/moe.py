@@ -36,10 +36,16 @@ B_AXES = BATCH_AXES
 
 
 def _capacity(tokens_per_group: int, num_experts: int, capacity_factor: float,
-              top_k: int) -> int:
-    """Static per-expert capacity (reference ``sharded_moe.py`` capacity calc)."""
+              top_k: int, min_capacity: int = 4,
+              drop_tokens: bool = True) -> int:
+    """Static per-expert capacity (reference ``sharded_moe.py`` capacity calc).
+
+    ``drop_tokens=False`` sizes the capacity to hold EVERY routed token
+    (reference no-drop mode) — O(S) memory per expert, never drops."""
+    if not drop_tokens:
+        return tokens_per_group
     cap = int(math.ceil(tokens_per_group * top_k * capacity_factor / num_experts))
-    return max(cap, 4)
+    return max(cap, min_capacity)
 
 
 def topk_gating(logits: jnp.ndarray, top_k: int, capacity: int):
@@ -112,7 +118,15 @@ class MoETransformerLM(TransformerLM):
         cfg = self.cfg
         B, S, d = y.shape
         E = cfg.num_experts
-        C = _capacity(S, E, cfg.moe_capacity_factor, cfg.moe_top_k)
+        # Eval uses the (larger) eval capacity factor so fewer tokens drop
+        # (reference ``eval_capacity_factor``); the flag is a trace-time
+        # constant set by the engine's eval step.
+        factor = cfg.moe_capacity_factor
+        if getattr(self, "moe_eval_mode", False):
+            factor = cfg.moe_eval_capacity_factor or 2.0 * factor
+        C = _capacity(S, E, factor, cfg.moe_top_k,
+                      min_capacity=cfg.moe_min_capacity,
+                      drop_tokens=cfg.moe_drop_tokens)
 
         logits = y.astype(jnp.float32) @ p["router"].astype(jnp.float32)  # (B,S,E)
         gate = jax.vmap(lambda lg: topk_gating(lg, cfg.moe_top_k, C))

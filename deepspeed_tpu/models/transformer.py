@@ -62,6 +62,9 @@ class TransformerConfig:
     num_experts: int = 1
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: Optional[float] = None  # eval default: 2x train
+    moe_min_capacity: int = 4
+    moe_drop_tokens: bool = True          # False: capacity covers ALL tokens
     moe_aux_loss_weight: float = 0.01
 
     @property

@@ -28,15 +28,68 @@ import orbax.checkpoint as ocp
 from ...utils.logging import log_dist
 
 
-def _checkpointer():
-    return ocp.PyTreeCheckpointer()
+def _checkpointer(engine=None):
+    """Sync or async checkpointer per ``config.checkpoint.async_save``
+    (reference pluggable CheckpointEngine / Nebula async service): the async
+    path initiates the tensorstore writes and returns — training resumes
+    while the commit happens in background threads. One AsyncCheckpointer is
+    cached per engine so in-flight saves can be awaited."""
+    async_save = (engine is not None
+                  and getattr(engine.config.checkpoint, "async_save", False))
+    if not async_save:
+        return ocp.PyTreeCheckpointer(), False
+    ck = getattr(engine, "_async_ckptr", None)
+    if ck is None:
+        ck = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        engine._async_ckptr = ck
+    return ck, True
+
+
+def _validate_tag(engine, tag: str) -> None:
+    """Cross-process tag consistency (reference ``engine.py:2965``
+    ``checkpoint_tag_validation``). Uses an allgather so EVERY rank sees the
+    mismatch and fails/warns uniformly — a one-sided check would leave rank 0
+    entering the collective save alone and hanging."""
+    mode = engine.config.checkpoint.tag_validation
+    if mode == "ignore" or jax.process_count() == 1:
+        return
+    import hashlib
+
+    from jax.experimental import multihost_utils
+
+    mine = int.from_bytes(hashlib.sha256(tag.encode()).digest()[:4], "big")
+    all_hashes = np.asarray(multihost_utils.process_allgather(np.int64(mine)))
+    if not np.all(all_hashes == all_hashes[0]):
+        msg = (f"checkpoint tag {tag!r} differs across processes "
+               "(hash mismatch) — ranks would write inconsistent checkpoints")
+        if mode == "fail":
+            raise ValueError(msg)
+        log_dist(f"WARNING: {msg}")
+
+
+def wait_for_checkpoint(engine) -> None:
+    """Block until any in-flight async save has committed, then flip the
+    'latest' pointer — so a crash mid-commit leaves 'latest' at the previous
+    DURABLE checkpoint, never at a half-written one."""
+    ck = getattr(engine, "_async_ckptr", None)
+    if ck is not None:
+        ck.wait_until_finished()
+    pending = getattr(engine, "_pending_latest", None)
+    if pending is not None:
+        base, tag = pending
+        if jax.process_index() == 0:
+            (Path(base) / "latest").write_text(tag)
+        engine._pending_latest = None
 
 
 def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
     tag = tag or f"global_step{engine.global_steps}"
+    _validate_tag(engine, tag)
     base = Path(save_dir).absolute()
     path = base / tag
-    ckptr = _checkpointer()
+    ckptr, is_async = _checkpointer(engine)
+    if is_async:
+        wait_for_checkpoint(engine)   # one in-flight save at a time
     if getattr(engine, "offload", False):
         # host-resident state (ZeRO-Offload/Infinity): numpy trees
         m, v = engine.host_opt.moment_trees()
@@ -56,20 +109,33 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
             "mesh": dict(engine.mesh.shape),
         }
         (path / "meta.json").write_text(json.dumps(meta, indent=2))
-        (base / "latest").write_text(tag)
-    log_dist(f"saved checkpoint {path}", ranks=[0])
+        if not is_async:
+            (base / "latest").write_text(tag)
+    if is_async:
+        # 'latest' flips only after the background commit is durable
+        engine._pending_latest = (str(base), tag)
+    log_dist(f"saved checkpoint {path}"
+             + (" (async, committing in background)" if is_async else ""),
+             ranks=[0])
     return str(path)
 
 
 def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
+    wait_for_checkpoint(engine)   # an in-flight save must commit first
     base = Path(load_dir).absolute()
     if tag is None:
         latest = base / "latest"
         if not latest.exists():
             raise FileNotFoundError(f"no 'latest' tag file in {base}")
         tag = latest.read_text().strip()
+    _validate_tag(engine, tag)
+    if engine.config.checkpoint.load_universal:
+        # universal-by-construction: every checkpoint already restores onto
+        # any topology (abstract-target reshard); the flag is satisfied
+        log_dist("load_universal: checkpoints reshard natively; no offline "
+                 "conversion needed", ranks=[0])
     path = base / tag
-    ckptr = _checkpointer()
+    ckptr = ocp.PyTreeCheckpointer()
     if getattr(engine, "offload", False):
         restored = ckptr.restore(path / "state")
         engine.host_opt.load_state(restored["master_params"],

@@ -103,6 +103,39 @@ class Engine:
             pld = self.config.progressive_layer_drop
             self.model = model = convert_to_progressive_layer_drop(
                 model, theta=pld.theta, gamma=pld.gamma)
+        if self.config.checkpoint.use_node_local_storage:
+            raise ValueError(
+                "checkpoint.use_node_local_storage is not supported: the "
+                "orbax store is one logical checkpoint written collectively "
+                "(per-host shard files are an artifact of the reference's "
+                "torch.save layout); point save_dir at local storage instead")
+        if self.config.prescale_gradients:
+            raise ValueError(
+                "prescale_gradients has no effect under XLA: the gradient "
+                "reduction order is compiler-managed (no pre-allreduce "
+                "division point exists), and fp16 overflow is handled by "
+                "dynamic loss scaling — remove the flag")
+        mcfg = self.config.moe
+        if mcfg.enabled:
+            # ds_config moe section overrides the model's MoE knobs
+            # (reference wires these through the engine into MOELayer)
+            if getattr(model.cfg, "num_experts", 1) != mcfg.num_experts:
+                raise ValueError(
+                    f"config.moe.num_experts={mcfg.num_experts} but the model "
+                    f"was built with {getattr(model.cfg, 'num_experts', 1)}")
+            model.cfg = dataclasses.replace(
+                model.cfg, moe_top_k=mcfg.top_k,
+                moe_capacity_factor=mcfg.capacity_factor,
+                moe_eval_capacity_factor=mcfg.eval_capacity_factor,
+                moe_min_capacity=mcfg.min_capacity,
+                moe_drop_tokens=mcfg.drop_tokens,
+                moe_aux_loss_weight=mcfg.aux_loss_weight)
+        if self.config.comms_logger.enabled:
+            from ..comm.comm import comms_logger as _cl
+
+            _cl.enabled = True
+            _cl.verbose = self.config.comms_logger.verbose
+        self._comms_logged = not self.config.comms_logger.enabled
         if self._ltd is not None:
             from ..data_pipeline.random_ltd import convert_to_random_ltd
 
@@ -723,6 +756,15 @@ class Engine:
                 tuple(sorted(n for n, _ in self._comp)))
         if self._pld:
             self.model.set_pld_step(None)   # eval runs every layer
+        if getattr(self.model.cfg, "num_experts", 1) > 1:
+            # trace-time flag: eval capacity factor (reference
+            # eval_capacity_factor) applies in this trace only — finally
+            # guarantees a failed trace can't leak it into a later train trace
+            self.model.moe_eval_mode = True
+            try:
+                return self.model.loss(cp, batch)
+            finally:
+                self.model.moe_eval_mode = False
         return self.model.loss(cp, batch)
 
     # ------------------------------------------------------------ public API
@@ -824,6 +866,28 @@ class Engine:
         # + one-time AOT compile must not pollute samples/s accounting).
         if self.flops_profiler and self.flops_profiler.should_fire():
             self.flops_profiler.profile(batch)
+        if not self._comms_logged:
+            # comms_logger: count the GSPMD-inserted collectives from the
+            # compiled HLO once (the Python ledger only sees explicit comm.*
+            # wrappers), plus the ledger summary. NOTE: the AOT
+            # lower().compile() duplicates the step compile once — an
+            # accepted, opt-in diagnostics cost (post-optimization HLO is
+            # the only place the inserted collectives exist).
+            self._comms_logged = True
+            try:
+                from ..comm.comm import comms_logger as _cl
+                from ..comm.hlo_analysis import collective_summary
+
+                with self.mesh:
+                    compiled = self._train_step.lower(
+                        self.state, batch, max(0, self._ltd_tokens),
+                        comp_active, warm).compile()
+                for key, d in sorted(collective_summary(compiled).items()):
+                    log_dist(f"comms | HLO {key}: n={int(d['count'])} "
+                             f"vol={d['mbytes']:.1f} MB", ranks=[0])
+                _cl.log_summary()
+            except Exception as e:   # best-effort per backend
+                log_dist(f"comms_logger: HLO summary unavailable ({e})")
         return metrics
 
     def eval_batch(self, batch: dict) -> float:
@@ -868,6 +932,12 @@ class Engine:
 
             assert_elastic_config_consistent(self.config.elasticity, load_dir)
         return _load(self, load_dir, tag)
+
+    def wait_for_checkpoint(self) -> None:
+        """Block until an async checkpoint save has committed to disk."""
+        from .checkpoint.engine import wait_for_checkpoint as _wait
+
+        _wait(self)
 
 
 def initialize(config: Config | dict | str | None = None, model=None,

@@ -1,0 +1,129 @@
+"""Every accepted config knob is wired or rejected (VERDICT #7: no
+accepted-but-ignored fields)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, mixtral, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def _batch(bs=8, seq=32):
+    data = random_token_dataset(bs, seq, 256, learnable=True)
+    return DataLoader(data, local_batch_size=bs, shuffle=False).collate_fn(data)
+
+
+def test_prescale_gradients_rejected():
+    with pytest.raises(ValueError, match="prescale_gradients"):
+        ds.initialize({"train_batch_size": 8, "prescale_gradients": True,
+                       "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+                      build_model(tiny_test()))
+
+
+def test_node_local_storage_rejected():
+    with pytest.raises(ValueError, match="node_local_storage"):
+        ds.initialize({"train_batch_size": 8,
+                       "checkpoint": {"use_node_local_storage": True},
+                       "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+                      build_model(tiny_test()))
+
+
+def test_moe_config_overrides_model():
+    cfg = mixtral("tiny", vocab_size=256, max_seq=64)
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "moe": {"enabled": True, "num_experts": 4, "top_k": 1,
+                "capacity_factor": 2.0, "eval_capacity_factor": 3.0,
+                "min_capacity": 2, "drop_tokens": False},
+    }, build_model(cfg))
+    m = engine.model.cfg
+    assert m.moe_top_k == 1 and m.moe_capacity_factor == 2.0
+    assert m.moe_eval_capacity_factor == 3.0 and not m.moe_drop_tokens
+    losses = [float(engine.train_batch(_batch())["loss"]) for _ in range(2)]
+    assert all(np.isfinite(losses))
+    assert np.isfinite(engine.eval_batch(_batch()))
+
+
+def test_moe_config_mismatch_rejected():
+    with pytest.raises(ValueError, match="num_experts"):
+        ds.initialize({
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "moe": {"enabled": True, "num_experts": 8},
+        }, build_model(mixtral("tiny", vocab_size=256, max_seq=64)))
+
+
+def test_moe_no_drop_capacity():
+    from deepspeed_tpu.models.moe import _capacity
+
+    assert _capacity(64, 4, 1.25, 2, drop_tokens=False) == 64
+    assert _capacity(64, 4, 1.25, 2, min_capacity=50) == 50
+
+
+def test_comms_logger_logs_hlo_collectives():
+    import io
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    ds_logger.addHandler(handler)
+    old_level = ds_logger.level
+    ds_logger.setLevel(logging.INFO)    # conftest defaults to WARNING
+    try:
+        engine = ds.initialize({
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "comms_logger": {"enabled": True},
+        }, build_model(tiny_test()))
+        engine.train_batch(_batch())
+    finally:
+        ds_logger.removeHandler(handler)
+        ds_logger.setLevel(old_level)
+    text = buf.getvalue()
+    # ZeRO-2 grad path must show GSPMD collectives in the compiled HLO
+    assert "HLO" in text and ("reduce-scatter" in text or "all-reduce" in text
+                              or "all-gather" in text), text[-800:]
+
+
+def test_async_save_roundtrip(tmp_path):
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "checkpoint": {"async_save": True},
+    }, build_model(tiny_test()))
+    b = _batch()
+    engine.train_batch(b)
+    engine.save_checkpoint(str(tmp_path))
+    engine.wait_for_checkpoint()
+    before = float(engine.eval_batch(b))
+    engine.train_batch(b)
+    engine.load_checkpoint(str(tmp_path))
+    after = float(engine.eval_batch(b))
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_unknown_config_key_rejected():
+    with pytest.raises(Exception):
+        ds.initialize({"train_batch_size": 8, "not_a_real_knob": 1,
+                       "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+                      build_model(tiny_test()))
+
+
+def test_async_save_latest_flips_only_after_commit(tmp_path):
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "checkpoint": {"async_save": True},
+    }, build_model(tiny_test()))
+    engine.train_batch(_batch())
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    # pointer deferred until the commit is confirmed durable
+    engine.wait_for_checkpoint()
+    assert (tmp_path / "latest").read_text() == "t1"
