@@ -1,0 +1,54 @@
+"""Evoformer (DS4Science) attention: pair-biased, gated attention.
+
+Analog of the reference's evoformer attention kernels
+(``csrc/deepspeed4science/evoformer_attn/``, ~15 kLoC of CUTLASS): the
+AlphaFold-style attention variant — scores take an additive pair-represent-
+ation bias, the output is gated by a sigmoid projection of the input, and
+the memory-efficient streaming the CUTLASS kernels hand-build is what the
+flash kernel already does on TPU.
+
+Two paths:
+- ``evoformer_attention``: XLA implementation with bias + gating (fp32
+  softmax) — the general case, including the (B, H, S, S) bias tensors
+  AlphaFold's triangle attention produces;
+- when the bias is None the call routes through the Pallas flash kernel
+  (ops/flash_attention.py), which is the memory-efficient case that
+  matters for long sequences.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def evoformer_attention(q, k, v, *, bias: Optional[jnp.ndarray] = None,
+                        gate: Optional[jnp.ndarray] = None,
+                        causal: bool = False,
+                        interpret: Optional[bool] = None):
+    """q/k/v: (B, S, H, hd); bias: broadcastable to (B, H, S, S);
+    gate: (B, S, H, hd) pre-sigmoid gating values. Returns (B, S, H, hd).
+
+    Mirrors the reference kernel contract (``EvoformerAttnBuilder``):
+    ``softmax(q·kᵀ/√d + bias) · v``, then ``sigmoid(gate) ⊙ out``."""
+    B, S, H, hd = q.shape
+    if bias is None:
+        from .flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=causal, interpret=interpret)
+    else:
+        scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+        scores = scores / math.sqrt(hd)
+        scores = scores + jnp.broadcast_to(bias, (B, H, S, S)).astype(jnp.float32)
+        if causal:
+            tri = jnp.tril(jnp.ones((S, S), bool))
+            scores = jnp.where(tri[None, None], scores,
+                               jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    if gate is not None:
+        out = out * jax.nn.sigmoid(gate.astype(out.dtype))
+    return out
