@@ -157,7 +157,24 @@ assert len(batches) == 1, len(batches)
 got = batches[0]["i"][:, 0].tolist()
 want = [0, 1, 2, 3] if jax.process_index() == 0 else [4, 5, 6, 7]
 assert got == want, (got, want)
-print(f"DIST_OK rank={jax.process_index()} total={float(total)}", flush=True)
+
+# Full engine train step across 2 processes: each host feeds its PER-HOST
+# slice, _make_global assembles the global sharded batch, and both hosts
+# must observe the identical (replicated) loss.
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import random_token_dataset
+engine = ds.initialize({"train_batch_size": 8,
+                        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+                        "zero_optimization": {"stage": 2}},
+                       build_model(tiny_test()))
+all_data = random_token_dataset(8, 16, 256, learnable=True)
+host_dl = DataLoader(all_data, local_batch_size=4, shuffle=False)
+host_batch = next(iter(host_dl))          # this host's 4 samples
+losses = [float(engine.train_batch(dict(host_batch))["loss"])
+          for _ in range(2)]
+assert all(np.isfinite(losses)) and losses[1] < losses[0], losses
+print(f"DIST_OK rank={jax.process_index()} total={float(total)} "
+      f"loss={losses[-1]:.4f}", flush=True)
 """
 
 
@@ -180,6 +197,11 @@ def test_two_process_launch(tmp_path):
         env=env, capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, (p.stdout, p.stderr)
     assert p.stdout.count("DIST_OK") == 2, (p.stdout, p.stderr)
+    # the loss is a REPLICATED output: both hosts must report the identical
+    # value (catches per-host batch assembly bugs the local asserts can't)
+    losses = sorted(line.split("loss=")[1].split()[0]
+                    for line in p.stdout.splitlines() if "DIST_OK" in line)
+    assert len(losses) == 2 and losses[0] == losses[1], p.stdout
 
 
 @pytest.mark.slow
