@@ -176,3 +176,14 @@ def test_pld_no_tracer_leak():
                      engine.state.master_params),
         {"input_ids": jnp.asarray(batch["input_ids"])}))
     assert np.isfinite(loss)
+
+
+def test_comm_bench_cli(capsys):
+    """dstpu_bench sweep runs on the virtual mesh (ds_bench analog)."""
+    from deepspeed_tpu.comm.bench import main as bench_main
+
+    bench_main(["--min_elems", "4096", "--max_elems", "4096", "--iters", "2",
+                "--ops", "all_reduce,all_to_all"])
+    out = capsys.readouterr().out
+    assert "all_reduce" in out and "all_to_all" in out and "GB/s" in out
+    assert "done" in out
