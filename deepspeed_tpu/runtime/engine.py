@@ -420,8 +420,14 @@ class Engine:
         zoff_param = self.config.zero_optimization.offload_param
         self.param_offload = zoff_param.enabled
         if self.param_offload:
-            self.model.params_on_host = True
-            on_tpu = self.acc.current_device().platform == "tpu"
+            # Gate on the backend actually exposing pinned_host, not the
+            # platform name: remote-tunnel TPUs may lack it and the compiled
+            # step would die with an opaque backend error (round-2 finding).
+            # On CPU the streaming path stays live-but-inert (CI coverage).
+            tpu_plat = self.acc.current_device().platform == "tpu"
+            has_pinned = self.acc.supports_host_offload()
+            on_tpu = tpu_plat and has_pinned
+            self.model.params_on_host = (not tpu_plat) or has_pinned
             if on_tpu:
                 stacked = (self.model.stacked_fn()
                            if hasattr(self.model, "stacked_fn")
@@ -434,6 +440,10 @@ class Engine:
                         if stacked(shp) and int(np.prod(shp)) >= thresh
                         else sh),
                     self.compute_shardings, self._shapes)
+            elif tpu_plat:
+                log_dist("offload_param: this TPU backend exposes no "
+                         "pinned_host memory kind — param streaming is "
+                         "inert (params stay in HBM)", ranks=[0])
             else:
                 log_dist("offload_param: non-TPU platform — params stay in "
                          "(host-backed) device memory; streaming is inert",
