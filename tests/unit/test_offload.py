@@ -205,3 +205,22 @@ def test_nvme_master_checkpoint_roundtrip(tmp_path):
     l_resume = float(eng2.train_batch(batch)["loss"])
     l_cont = float(eng.train_batch(batch)["loss"])
     np.testing.assert_allclose(l_resume, l_cont, rtol=1e-4)
+
+
+def test_nvme_param_offload_master_on_disk(tmp_path):
+    """offload_param + nvme optimizer: the fp32 master/moments page to disk
+    (offload.py master_path tier) while compute params stream from host —
+    the params-beyond-DRAM story of ZeRO-Infinity."""
+    import os
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    cfg = _cfg("nvme", str(tmp_path / "swap"))
+    cfg["zero_optimization"]["stage"] = 3
+    cfg["zero_optimization"]["offload_param"] = {
+        "device": "nvme", "nvme_path": str(tmp_path / "swap")}
+    eng, batch, losses = _train_losses(cfg, steps=3)
+    assert losses[-1] < losses[0]
+    swap_files = os.listdir(str(tmp_path / "swap"))
+    assert any("master" in f for f in swap_files), swap_files
+    assert any("moment" in f for f in swap_files), swap_files
