@@ -23,8 +23,9 @@ class InferenceConfig:
     dtype: str = "bfloat16"            # compute dtype for decode
     tensor_parallel: int = 1           # reference tensor_parallel.tp_size
     max_out_tokens: int = 256          # reference max_out_tokens
-    quantize: bool = False             # int8 weight-only quant (WOQ)
+    quantize: bool = False             # weight-only quant (WOQ)
     quant_group_size: int = 128
+    quant_bits: int = 8                # 8 or 4 (nibble-packed)
     eos_token_id: Optional[int] = None
     seed: int = 0
     # Pallas streaming cache-attention for the 1-token decode step

@@ -59,10 +59,12 @@ class InferenceEngine:
             if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         if cfg.quantize:
             assert cfg.tensor_parallel == 1, \
-                "int8 WOQ + TP: not yet supported together"
+                "WOQ + TP: not yet supported together"
             self.params = jax.jit(partial(quantize_params,
-                                          group_size=cfg.quant_group_size))(cast)
-            log_dist(f"inference: int8 WOQ, {quantized_bytes(self.params)/2**20:.0f}"
+                                          group_size=cfg.quant_group_size,
+                                          bits=cfg.quant_bits))(cast)
+            log_dist(f"inference: int{cfg.quant_bits} WOQ, "
+                     f"{quantized_bytes(self.params)/2**20:.0f}"
                      " MiB weights", ranks=[0])
         else:
             specs = self.model.param_specs()

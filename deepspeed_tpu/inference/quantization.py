@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for inference.
+"""Weight-only int8/int4 quantization for inference.
 
 Reference analog: ``deepspeed/inference/quantization/`` (int4/int8 WOQ) and
 the ``GroupQuantizer`` used by kernel injection
@@ -20,43 +20,74 @@ import jax.numpy as jnp
 
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
-    """int8 weight + per-group fp32 scales. ``group_size`` is pytree aux
-    data (static under jit, so reshapes stay static-shaped)."""
+    """int8 (or nibble-packed int4) weight + per-group fp32 scales.
+    ``group_size`` and ``bits`` are pytree aux data (static under jit, so
+    reshapes stay static-shaped). int4 packs two signed nibbles per int8
+    byte along the last dim (reference ``csrc/quantization/quantize_intX``)."""
 
-    def __init__(self, q, scale, group_size: int):
-        self.q = q            # int8, original shape
+    def __init__(self, q, scale, group_size: int, bits: int = 8):
+        self.q = q            # int8; original shape, or (..., last/2) packed
         self.scale = scale    # fp32, (..., n_groups, 1)
         self.group_size = group_size
+        self.bits = bits
 
     def tree_flatten(self):
-        return (self.q, self.scale), self.group_size
+        return (self.q, self.scale), (self.group_size, self.bits)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux)
+        gs, bits = aux if isinstance(aux, tuple) else (aux, 8)
+        return cls(children[0], children[1], gs, bits)
 
     @property
     def shape(self):
+        if self.bits == 4:
+            return self.q.shape[:-1] + (self.q.shape[-1] * 2,)
         return self.q.shape
 
 
-def quantize(w, group_size: int = 128) -> QuantizedTensor:
-    """Symmetric per-group int8 quantization along the last dim."""
+def _pack_int4(q):
+    """(..., last) signed int4 values in int8 → (..., last/2) packed bytes."""
+    lo = q[..., 0::2] & 0x0F
+    hi = (q[..., 1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def _unpack_int4(packed):
+    """(..., last/2) packed bytes → (..., last) signed int4 values (fp32)."""
+    lo = (packed << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
+    hi = packed >> 4                                  # arithmetic shift: high
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(packed.shape[:-1] + (packed.shape[-1] * 2,))
+
+
+def quantize(w, group_size: int = 128, bits: int = 8) -> QuantizedTensor:
+    """Symmetric per-group int8/int4 quantization along the last dim."""
+    assert bits in (4, 8), bits
     shape = w.shape
     last = shape[-1]
     gs = group_size if last % group_size == 0 else last
+    if bits == 4 and gs % 2 != 0:
+        raise ValueError(f"int4 needs an even group size, got {gs}")
     wf = w.astype(jnp.float32).reshape(shape[:-1] + (last // gs, gs))
+    qmax = 7.0 if bits == 4 else 127.0
     amax = jnp.max(jnp.abs(wf), axis=-1, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
-    return QuantizedTensor(q=q.reshape(shape), scale=scale, group_size=gs)
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    q = jnp.clip(jnp.round(wf / scale), -qmax, qmax).astype(jnp.int8)
+    q = q.reshape(shape)
+    if bits == 4:
+        q = _pack_int4(q)
+    return QuantizedTensor(q=q, scale=scale, group_size=gs, bits=bits)
 
 
 def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
-    shape = qt.q.shape
+    if qt.bits == 4:
+        qv = _unpack_int4(qt.q).astype(jnp.float32)
+    else:
+        qv = qt.q.astype(jnp.float32)
+    shape = qv.shape
     last = shape[-1]
-    qf = qt.q.astype(jnp.float32).reshape(
-        shape[:-1] + (last // qt.group_size, qt.group_size))
+    qf = qv.reshape(shape[:-1] + (last // qt.group_size, qt.group_size))
     return (qf * qt.scale).reshape(shape).astype(dtype)
 
 
@@ -70,10 +101,10 @@ def _should_quantize(path, leaf, min_size: int) -> bool:
 
 
 def quantize_params(params: Any, group_size: int = 128,
-                    min_size: int = 4096) -> Any:
-    """Quantize every large matmul weight in a param pytree to int8."""
+                    min_size: int = 4096, bits: int = 8) -> Any:
+    """Quantize every large matmul weight in a param pytree to int8/int4."""
     return jax.tree_util.tree_map_with_path(
-        lambda p, leaf: quantize(leaf, group_size)
+        lambda p, leaf: quantize(leaf, group_size, bits=bits)
         if _should_quantize(p, leaf, min_size) else leaf, params)
 
 
@@ -91,7 +122,7 @@ def quantized_bytes(params: Any) -> int:
     for leaf in jax.tree.leaves(
             params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
         if isinstance(leaf, QuantizedTensor):
-            total += leaf.q.size + leaf.scale.size * 4
+            total += leaf.q.size + leaf.scale.size * 4   # packed size for int4
         else:
             total += leaf.size * leaf.dtype.itemsize
     return int(total)

@@ -208,12 +208,11 @@ def test_nvme_master_checkpoint_roundtrip(tmp_path):
 
 
 def test_nvme_param_offload_master_on_disk(tmp_path):
-    """offload_param + nvme optimizer: the fp32 master/moments page to disk
-    (offload.py master_path tier) while compute params stream from host —
-    the params-beyond-DRAM story of ZeRO-Infinity."""
+    """stage-3 + offload_param + nvme optimizer initializes and trains with
+    master/moments paged to disk. (On the CPU CI backend the param-stream
+    itself is inert — runtime/engine gates it on pinned_host — so the NEW
+    coverage here is the stage-3 + offload_param config combination.)"""
     import os
-    import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build_model, tiny_test
 
     cfg = _cfg("nvme", str(tmp_path / "swap"))
     cfg["zero_optimization"]["stage"] = 3
