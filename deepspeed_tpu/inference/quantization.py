@@ -36,7 +36,7 @@ class QuantizedTensor:
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        gs, bits = aux if isinstance(aux, tuple) else (aux, 8)
+        gs, bits = aux
         return cls(children[0], children[1], gs, bits)
 
     @property
@@ -54,7 +54,7 @@ def _pack_int4(q):
 
 
 def _unpack_int4(packed):
-    """(..., last/2) packed bytes → (..., last) signed int4 values (fp32)."""
+    """(..., last/2) packed bytes → (..., last) signed int4 values (int8)."""
     lo = (packed << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
     hi = packed >> 4                                  # arithmetic shift: high
     out = jnp.stack([lo, hi], axis=-1)
@@ -62,13 +62,17 @@ def _unpack_int4(packed):
 
 
 def quantize(w, group_size: int = 128, bits: int = 8) -> QuantizedTensor:
-    """Symmetric per-group int8/int4 quantization along the last dim."""
+    """Symmetric per-group int8/int4 quantization along the last dim.
+
+    A leaf whose effective group size is odd cannot nibble-pack — it
+    degrades to int8 instead of failing the whole model (e.g. GPT-2's odd
+    50257-vocab head when the last dim isn't group-divisible)."""
     assert bits in (4, 8), bits
     shape = w.shape
     last = shape[-1]
     gs = group_size if last % group_size == 0 else last
     if bits == 4 and gs % 2 != 0:
-        raise ValueError(f"int4 needs an even group size, got {gs}")
+        bits = 8
     wf = w.astype(jnp.float32).reshape(shape[:-1] + (last // gs, gs))
     qmax = 7.0 if bits == 4 else 127.0
     amax = jnp.max(jnp.abs(wf), axis=-1, keepdims=True)

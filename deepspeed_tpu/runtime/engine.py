@@ -621,7 +621,13 @@ class Engine:
                                   compute_params)
         carry = (zero_grads, jnp.float32(0.0))
         if vary_axes:
-            carry = jax.tree.map(lambda t: lax.pvary(t, vary_axes), carry)
+            # mark the carry device-varying over the manual axes (pvary is
+            # deprecated in favor of pcast; keep a fallback for older jax)
+            if hasattr(lax, "pcast"):
+                carry = jax.tree.map(
+                    lambda t: lax.pcast(t, vary_axes, to="varying"), carry)
+            else:  # pragma: no cover - older jax
+                carry = jax.tree.map(lambda t: lax.pvary(t, vary_axes), carry)
         (grads, loss), _ = lax.scan(gas_body, carry, batch)
         return grads, loss
 

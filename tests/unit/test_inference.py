@@ -200,3 +200,18 @@ def test_int4_woq_quantization():
     ids = jnp.asarray(rng.integers(0, 256, (1, 8)), jnp.int32)
     out = np.asarray(eng.generate(ids, 4, greedy=True))
     assert out.shape == (1, 4) and np.all((out >= 0) & (out < 256))
+
+
+def test_int4_odd_dim_degrades_to_int8():
+    """A weight whose last dim can't nibble-pack must degrade per-leaf to
+    int8, not abort engine init (GPT-2's odd vocab head)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.inference.quantization import dequantize, quantize
+
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 50257)),
+                    jnp.float32)
+    q = quantize(w, group_size=128, bits=4)   # 50257 % 128 != 0, odd last
+    assert q.bits == 8 and q.q.shape == w.shape
+    err = float(jnp.max(jnp.abs(dequantize(q, jnp.float32) - w)))
+    assert err < float(jnp.max(jnp.abs(w))) / 64
