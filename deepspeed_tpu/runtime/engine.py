@@ -955,6 +955,22 @@ class Engine:
 
         _wait(self)
 
+    # ------------------------------------------------------------- profiling
+    def start_profile_trace(self, logdir: str) -> None:
+        """Begin an XLA profiler trace (the NVTX/nsys analog —
+        SURVEY §5 tracing: xplane → tensorboard/perfetto). Wrap some
+        train_batch calls and view with `tensorboard --logdir`."""
+        jax.profiler.start_trace(logdir)
+        log_dist(f"profiler trace started → {logdir}", ranks=[0])
+
+    def stop_profile_trace(self) -> None:
+        # drain outstanding async-dispatched steps first, or the trace
+        # closes mid-step and drops the device activity being profiled
+        jax.block_until_ready(self.compute_params if self.offload
+                              else self.state)
+        jax.profiler.stop_trace()
+        log_dist("profiler trace stopped", ranks=[0])
+
 
 def initialize(config: Config | dict | str | None = None, model=None,
                mesh: Optional[Mesh] = None, seed: Optional[int] = None,

@@ -187,3 +187,23 @@ def test_comm_bench_cli(capsys):
     out = capsys.readouterr().out
     assert "all_reduce" in out and "all_to_all" in out and "GB/s" in out
     assert "done" in out
+
+
+def test_profiler_trace_capture(tmp_path):
+    """engine.start/stop_profile_trace writes an xplane trace (the
+    nsys/NVTX-analog observability path, SURVEY §5)."""
+    import os
+
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    }, build_model(tiny_test()))
+    data = random_token_dataset(8, 32, 256)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data)
+    engine.train_batch(batch)          # compile outside the trace
+    engine.start_profile_trace(str(tmp_path))
+    engine.train_batch(batch)
+    engine.stop_profile_trace()
+    found = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path) for f in fs]
+    assert any("xplane" in f or f.endswith(".pb") or "trace" in f
+               for f in found), found
