@@ -85,3 +85,18 @@ def _ensure_builtin_ops() -> None:
     def _aio():
         from .aio import AsyncIOHandle
         return AsyncIOHandle
+
+    @register_op("spatial_inference")
+    def _spatial():
+        from . import spatial
+        return spatial
+
+    @register_op("evoformer_attn")
+    def _evo():
+        from .evoformer import evoformer_attention
+        return evoformer_attention
+
+    @register_op("tiled_linear")
+    def _tiled():
+        from .tiled import tiled_matmul
+        return tiled_matmul

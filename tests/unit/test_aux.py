@@ -207,3 +207,22 @@ def test_profiler_trace_capture(tmp_path):
     found = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path) for f in fs]
     assert any("xplane" in f or f.endswith(".pb") or "trace" in f
                for f in found), found
+
+
+def test_spatial_ops():
+    """Spatial inference ops (reference csrc/spatial fused bias-add family)."""
+    from deepspeed_tpu.ops.spatial import (bias_add, bias_add_add, bias_geglu,
+                                           group_norm)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 4, 4, 8)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(bias_add(x, b)), np.asarray(x) + np.asarray(b))
+    np.testing.assert_allclose(np.asarray(bias_add_add(x, b, x)),
+                               np.asarray(x) * 2 + np.asarray(b), rtol=1e-6)
+    g = bias_geglu(jnp.concatenate([x, x], -1), jnp.concatenate([b, b]))
+    assert g.shape == x.shape
+    gn = group_norm(x, jnp.ones((8,)), jnp.zeros((8,)), num_groups=2)
+    assert gn.shape == x.shape
+    flat = np.asarray(gn).reshape(2, -1, 2, 4).transpose(0, 2, 1, 3).reshape(2, 2, -1)
+    np.testing.assert_allclose(flat.mean(-1), 0.0, atol=1e-5)
