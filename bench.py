@@ -24,9 +24,9 @@ import sys
 import time
 
 _CHILD_MARK = "_DSTPU_BENCH_CHILD"
-_PROBE_TIMEOUT_S = 150
+_PROBE_TIMEOUT_S = 120
 _CHILD_TIMEOUT_S = 1200
-_MAX_ATTEMPTS = 4
+_MAX_ATTEMPTS = 3    # worst case probe cycle ~7.5 min before CPU fallback
 
 
 def _run_workload():
