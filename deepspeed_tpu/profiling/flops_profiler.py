@@ -176,9 +176,12 @@ class FlopsProfiler:
         lines.append(f"step latency: {dt * 1e3:.1f} ms")
         if total_flops:
             achieved = total_flops / dt
-            peak = peak_flops_for(jax.devices()[0]) * len(jax.devices())
-            lines.append(f"achieved: {achieved / 1e12:.2f} TFLOPS "
-                         f"({100.0 * achieved / peak:.1f}% of peak)")
+            lines.append(f"achieved: {achieved / 1e12:.2f} TFLOPS")
+            try:
+                peak = peak_flops_for(jax.devices()[0]) * len(jax.devices())
+                lines[-1] += f" ({100.0 * achieved / peak:.1f}% of peak)"
+            except ValueError:
+                pass  # unknown hardware: report TFLOPS without a peak ratio
         lines.append("-" * 58)
         report = "\n".join(lines)
         log_dist(report, ranks=[0])

@@ -8,6 +8,7 @@ boundaries when ``wall_clock_breakdown`` is on.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Optional
 
@@ -149,9 +150,27 @@ PEAK_FLOPS_BY_PLATFORM = {
 
 
 def peak_flops_for(device) -> float:
-    table = PEAK_FLOPS_BY_PLATFORM.get(device.platform, {"default": 1e12})
+    """Per-chip peak bf16 FLOP/s for MFU accounting.
+
+    MFU is the product's headline number, so an unknown TPU generation must
+    fail loudly rather than silently divide by a guessed peak (which would
+    report a wrong MFU as fact).  Override with ``DSTPU_PEAK_FLOPS`` when
+    running on hardware this table predates.
+    """
+    override = os.environ.get("DSTPU_PEAK_FLOPS")
+    if override:
+        return float(override)
+    table = PEAK_FLOPS_BY_PLATFORM.get(device.platform)
+    if table is None:
+        raise ValueError(
+            f"no peak-FLOPs entry for platform {device.platform!r}; set "
+            "DSTPU_PEAK_FLOPS=<per-chip peak FLOP/s> to report MFU honestly")
     kind = getattr(device, "device_kind", "").lower()
     for key, val in table.items():
         if key != "default" and key in kind:
             return val
+    if device.platform == "tpu":
+        raise ValueError(
+            f"unknown TPU generation {kind!r} — refusing to guess a peak for "
+            "MFU; set DSTPU_PEAK_FLOPS=<per-chip peak FLOP/s>")
     return table["default"]

@@ -5,20 +5,74 @@ where the reference spawns N OS processes with real NCCL over loopback, the
 JAX-native trick is a *virtual 8-device CPU mesh* in one process
 (``--xla_force_host_platform_device_count``) — every collective, sharding, and
 partitioning path compiles and executes exactly as it would across 8 chips.
-Must be set before JAX initializes, hence here at collection time.
+
+Environment armor (round-2 postmortem): the ambient image sets
+``JAX_PLATFORMS=axon`` + ``PALLAS_AXON_POOL_IPS`` and a sitecustomize that
+registers the axon TPU-relay PJRT plugin at *interpreter start*.  Two failure
+modes follow:
+
+1. jax backend init dials the tunnel; a wedged tunnel hangs the suite
+   (reproduced round 2: 9m20s wall / 3s CPU).  The previous
+   ``os.environ.setdefault("JAX_PLATFORMS", "cpu")`` was a no-op against the
+   ambient ``axon`` value.
+2. the registration breaks pytest's fd-level output capture outright —
+   ``pytest --version`` prints NOTHING (rc=0) in the ambient env, works with
+   ``--capture=no`` or a scrubbed env.
+
+Both are interpreter-start damage, so an in-process scrub is too late: the
+only reliable fix is to re-exec pytest in a scrubbed environment whenever we
+detect the sitecustomize ran (``PALLAS_AXON_POOL_IPS`` non-empty).  After the
+re-exec the sitecustomize skips registration, capture is sane, and the
+virtual 8-device CPU mesh is pinned.  Subprocesses spawned by tests
+(launcher tests, dryruns) inherit the scrubbed env too.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+# Snapshot BEFORE scrubbing: pytest_configure runs after this module's
+# top-level scrub, and must decide on re-exec from the *ambient* value.
+_AMBIENT_AXON = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+
+
+def _scrub_env() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize skips registration
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if not f.startswith("--xla_force_host_platform_device_count"))
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("DSTPU_LOG_LEVEL", "WARNING")
+    os.environ.setdefault("DSTPU_LOG_LEVEL", "WARNING")
+
+
+def pytest_configure(config):
+    if not _AMBIENT_AXON:
+        return
+    _scrub_env()
+    # Stop global capture first: fd 1/2 currently point at pytest's capture
+    # temp files, and the re-exec'd child would inherit them (its output
+    # would vanish into a deleted tmpfile).  stop_global_capturing()
+    # restores the real terminal fds.
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:],
+              os.environ.copy())
+
+
+_scrub_env()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platform_name", "cpu")
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: engine tests recompile near-identical train
+# steps; cache hits cut the suite from ~40 min toward ~10.  Keyed by HLO, so
+# correctness is XLA's problem, not ours.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
 

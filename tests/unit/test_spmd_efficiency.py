@@ -1,0 +1,106 @@
+"""SPMD efficiency tripwires.
+
+Round-2 verdict, Weak #2: the composed ``{data,seq,model}`` mesh compiled but
+XLA emitted "Involuntary full rematerialization" on the embedding gather —
+the vocab-sharded table was silently replicated to every device before the
+lookup (``spmd_partitioner.cc:652``). Correctness held; efficiency didn't.
+
+The fix is a Megatron-style vocab-parallel lookup
+(``models/transformer.py:_tok_lookup``: local masked gather + one psum over
+``model``). These tests pin it down two ways:
+
+1. equivalence: vocab-parallel lookup == plain gather, fwd and grads;
+2. tripwire: compiling + running the composed-mesh train step emits no
+   full-remat warning (XLA logs it on fd 2, which ``capfd`` captures).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+REMAT_PATTERN = "Involuntary full rematerialization"
+
+
+def _engine_and_batch(mesh_cfg, stage=3, seq_len=32):
+    config = {
+        "train_batch_size": 2 * mesh_cfg.get("data", 1),
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage, "param_persistence_threshold": 0},
+        "mesh": mesh_cfg,
+    }
+    model = build_model(tiny_test())
+    engine = ds.initialize(config, model)
+    data = random_token_dataset(engine.train_batch_size, seq_len=seq_len,
+                                vocab_size=256)
+    batch = DataLoader(data, local_batch_size=engine.train_batch_size,
+                       shuffle=False).collate_fn(data)
+    return engine, batch
+
+
+def test_vocab_parallel_lookup_matches_gather():
+    """The sharded lookup must be numerically identical to a plain gather."""
+    cfg = tiny_test()
+    model = build_model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    table = np.asarray(params["tok_embed"], dtype=np.float32)
+    ids = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size),
+        dtype=np.int32)
+
+    mesh = build_mesh(MeshSpec(data=2, seq=2, model=2))
+    with jax.set_mesh(mesh):
+        sharded = jax.device_put(
+            jnp.asarray(table), NamedSharding(mesh, P("model", None)))
+        out = jax.jit(model._tok_lookup)(sharded, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=0, atol=0)
+
+
+def test_vocab_parallel_lookup_grads_match():
+    """d(loss)/d(table) through the shard_map must equal the plain-gather
+    gradient (a scatter-add of the upstream cotangent)."""
+    cfg = tiny_test()
+    model = build_model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    table = jnp.asarray(np.asarray(params["tok_embed"], dtype=np.float32))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (4, 16), dtype=np.int32))
+
+    def loss_plain(t):
+        return jnp.sum(jnp.sin(t[ids]))
+
+    mesh = build_mesh(MeshSpec(data=2, seq=2, model=2))
+
+    def loss_sharded(t):
+        return jnp.sum(jnp.sin(model._tok_lookup(t, ids)))
+
+    g_plain = jax.grad(loss_plain)(table)
+    with jax.set_mesh(mesh):
+        sharded = jax.device_put(table, NamedSharding(mesh, P("model", None)))
+        g_sharded = jax.jit(jax.grad(loss_sharded))(sharded)
+    np.testing.assert_allclose(np.asarray(g_sharded), np.asarray(g_plain),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    {"data": 2, "seq": 2, "model": 2},
+    {"data": 4, "model": 2},
+])
+def test_no_involuntary_full_remat(mesh_cfg, capfd):
+    """Compile + run the full ZeRO-3 train step on composed meshes and assert
+    XLA never replicated a sharded tensor to lower an op."""
+    engine, batch = _engine_and_batch(mesh_cfg)
+    metrics = engine.train_batch(batch)
+    assert np.isfinite(float(metrics["loss"]))
+    captured = capfd.readouterr()
+    assert REMAT_PATTERN not in captured.err, (
+        "SPMD partitioner fell back to full replication:\n" +
+        "\n".join(l for l in captured.err.splitlines() if REMAT_PATTERN in l))
