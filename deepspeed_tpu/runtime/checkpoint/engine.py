@@ -107,6 +107,10 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
             "config": engine.config.to_dict(),
             "param_count": engine.param_count,
             "mesh": dict(engine.mesh.shape),
+            # state layout on disk: "host" = offload engine's numpy trees,
+            # "device" = TrainState. load_checkpoint converts across layouts
+            # so offload <-> device restores work in both directions.
+            "layout": "host" if getattr(engine, "offload", False) else "device",
         }
         (path / "meta.json").write_text(json.dumps(meta, indent=2))
         if not is_async:
@@ -136,29 +140,76 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
                  "conversion needed", ranks=[0])
     path = base / tag
     ckptr = ocp.PyTreeCheckpointer()
-    if getattr(engine, "offload", False):
-        restored = ckptr.restore(path / "state")
-        engine.host_opt.load_state(restored["master_params"],
-                                   restored.get("mu"), restored.get("nu"),
-                                   count=int(restored["count"]))
+    meta_file = path / "meta.json"
+    meta_pre = json.loads(meta_file.read_text()) if meta_file.exists() else {}
+    layout = meta_pre.get("layout")
+    to_host = getattr(engine, "offload", False)
+    raw = None
+    if layout is None:
+        # pre-"layout" checkpoints: the store is OCDBT (no per-leaf dirs on
+        # disk), so sniff the restored tree — the host layout alone has a
+        # top-level optimizer step "count".
+        raw = ckptr.restore(path / "state")
+        layout = "host" if "count" in raw else "device"
+
+    def _host_trees():
+        """(master, mu, nu, count) from either on-disk layout. The count is
+        the *applied-update* count (fp16 overflow skips excluded) — Adam
+        bias correction depends on it, so it must never be seeded from the
+        every-batch ``step`` counter."""
+        r = raw if raw is not None else ckptr.restore(path / "state")
+        src = r if layout == "host" else r["opt_state"]
+        return (r["master_params"], src.get("mu"), src.get("nu"),
+                int(np.asarray(src["count"])))
+
+    if to_host:
+        # restore into the host optimizer (offload engine), whichever engine
+        # kind wrote the checkpoint
+        master, mu, nu, count = _host_trees()
+        engine.host_opt.load_state(master, mu, nu, count=count)
         with engine.mesh:
             engine.compute_params = engine.host_opt.device_compute_params()
-        engine.global_steps = int(restored["count"])
-        step_guess = engine.global_steps
+        step_guess = count
+    elif layout == "host":
+        # host optimizer trees -> device TrainState: rebuild the state pytree
+        # around the stored master/moments, then shard onto this engine's
+        # mesh (fresh loss-scale/residual slots — the host engine has none).
+        master, mu, nu, count = _host_trees()
+        state = engine.state
+        opt_state = state.opt_state._replace(
+            mu=jax.tree.map(lambda cur, new: np.asarray(new, cur.dtype),
+                            state.opt_state.mu, mu),
+            nu=(jax.tree.map(lambda cur, new: np.asarray(new, cur.dtype),
+                             state.opt_state.nu, nu)
+                if nu is not None else state.opt_state.nu),
+            count=np.asarray(count, dtype=np.int32),
+        )
+        new_state = state._replace(
+            step=np.asarray(count, dtype=np.int32),
+            master_params=jax.tree.map(
+                lambda cur, new: np.asarray(new, cur.dtype),
+                state.master_params, master),
+            opt_state=opt_state,
+        )
+        engine.state = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), new_state, engine.state_shardings)
+        step_guess = count
     else:
-        # Abstract target carries this engine's shardings: restoring onto a
-        # different mesh/topology reshards transparently (elastic resume).
+        # Abstract target + explicit per-leaf restore_args carry this
+        # engine's shardings: restoring onto a different mesh/topology
+        # reshards transparently (elastic resume). restore_args is required —
+        # without it orbax re-applies the *saved* topology's shardings from
+        # the sharding file, and the train step then rejects the arrays.
         abstract = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             engine.state, engine.state_shardings)
-        restored = ckptr.restore(path / "state", item=abstract)
+        restore_args = jax.tree.map(
+            lambda x, s: ocp.ArrayRestoreArgs(sharding=s, dtype=x.dtype),
+            engine.state, engine.state_shardings)
+        restored = ckptr.restore(path / "state", item=abstract,
+                                 restore_args=restore_args)
         engine.state = restored
         step_guess = int(restored.step)
-    meta_file = path / "meta.json"
-    if meta_file.exists():
-        meta = json.loads(meta_file.read_text())
-        engine.global_steps = int(meta.get("global_steps", step_guess))
-    else:
-        engine.global_steps = step_guess
+    engine.global_steps = int(meta_pre.get("global_steps", step_guess))
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})", ranks=[0])
     return str(path)
