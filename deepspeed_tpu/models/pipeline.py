@@ -42,20 +42,22 @@ from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..platform.mesh import current_mesh
+from .moe import MoETransformerLM
 from .transformer import TransformerConfig, TransformerLM
 
 
-class PipelinedTransformerLM(TransformerLM):
-    """TransformerLM whose layer stack executes as a ``pipe``-axis pipeline.
+class _PipelinedLMBase:
+    """Pipeline-schedule mixin; must precede a :class:`TransformerLM`
+    subclass in the MRO (``super()`` provides the trunk: dense or MoE).
 
-    Same param pytree/init as :class:`TransformerLM` — only ``param_specs``
+    Same param pytree/init as the base trunk — only ``param_specs``
     (dim 0 of layers → ``pipe``) and ``loss`` (pipelined schedule) differ, so
     checkpoints are interchangeable with the dense model.
     """
 
     def __init__(self, config: TransformerConfig, n_stages: int,
                  num_micro: int | None = None, attention_fn=None,
-                 tick_remat: bool = False):
+                 tick_remat: bool = False, schedule: str = "gpipe"):
         if config.objective != "clm":
             raise ValueError(
                 "the pipelined loss computes shifted next-token CE; "
@@ -64,7 +66,8 @@ class PipelinedTransformerLM(TransformerLM):
         super().__init__(config, attention_fn)
         assert config.n_layer % n_stages == 0, (
             f"n_layer {config.n_layer} not divisible by {n_stages} stages")
-        assert config.num_experts == 1, "MoE + pipeline: not yet supported"
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.n_stages = n_stages
         # Default 2 microbatches per stage: bubble fraction (P-1)/(M+P-1).
         self.num_micro = num_micro or 2 * n_stages
@@ -73,6 +76,14 @@ class PipelinedTransformerLM(TransformerLM):
         # O(in-flight microbatch inputs) like the reference's 1F1B window
         # (pipe/schedule.py:189) instead of O(M) full per-tick residuals.
         self.tick_remat = tick_remat
+        # schedule="1f1b": memory-bounded execution. The tick scan runs in
+        # windows of P ticks, each window wrapped in jax.checkpoint (and each
+        # tick inside too), so the backward holds only window-boundary
+        # carries + one recomputed tick — the O(P) in-flight activation
+        # profile of the reference's 1F1B TrainSchedule
+        # (pipe/schedule.py:189) instead of GPipe's O(M) stashes. Embeddings
+        # are re-gathered per tick (cheap) rather than stashed (M,Bm,S,d).
+        self.schedule = schedule
 
     def param_specs(self) -> dict:
         specs = super().param_specs()
@@ -96,8 +107,10 @@ class PipelinedTransformerLM(TransformerLM):
           scan carry, so no (M, Bm, S, d) activation stash survives the
           scan — live memory is the carry plus per-tick residuals
           (O(P)-class with ``tick_remat``).
-        - **embeddings precomputed once** for all M microbatches instead of
-          re-gathered on every one of the T ticks by every stage.
+        - **embeddings precomputed once** (gpipe schedule) for all M
+          microbatches instead of re-gathered on every one of the T ticks by
+          every stage; the 1f1b schedule deliberately inverts this trade —
+          per-tick gathers are cheap, an (M, Bm, S, d) stash is not.
         """
         cfg = self.cfg
         Pn, M = self.n_stages, self.num_micro
@@ -108,11 +121,17 @@ class PipelinedTransformerLM(TransformerLM):
         _, Bm, S = ids_mb.shape
         T = M + Pn - 1
         perm = [(i, i + 1) for i in range(Pn - 1)]    # non-cyclic shift fwd
+        memory_bound = self.schedule == "1f1b"
 
-        # ---- embeddings once, not per tick
-        emb_all, positions_all = self._embed(prm, ids_mb.reshape(M * Bm, S))
-        emb_all = emb_all.reshape(M, Bm, S, cfg.d_model)
-        positions = positions_all[:Bm]
+        if memory_bound:
+            # per-tick embedding gather: nothing (M, Bm, S, d)-sized survives
+            positions = self._positions(Bm, S)
+            emb_all = None
+        else:
+            # ---- embeddings once, not per tick
+            emb_all, positions_all = self._embed(prm, ids_mb.reshape(M * Bm, S))
+            emb_all = emb_all.reshape(M, Bm, S, cfg.d_model)
+            positions = positions_all[:Bm]
 
         # ---- vocab-sharded unembedding slice for this stage
         V = cfg.vocab_size
@@ -163,31 +182,68 @@ class PipelinedTransformerLM(TransformerLM):
             return part, tok_part
 
         def tick(carry, t):
-            x_recv, loss_acc, tok_acc = carry
+            x_recv, loss_acc, tok_acc, aux_acc = carry
             mb_i = jnp.clip(t, 0, M - 1)
-            emb = lax.dynamic_index_in_dim(emb_all, mb_i, 0, keepdims=False)
+            if memory_bound:
+                ids_d = lax.dynamic_index_in_dim(ids_mb, mb_i, 0,
+                                                 keepdims=False)
+                emb, _ = self._embed(prm, ids_d)
+            else:
+                emb = lax.dynamic_index_in_dim(emb_all, mb_i, 0,
+                                               keepdims=False)
             mb_am = (lax.dynamic_index_in_dim(am_mb, mb_i, 0, keepdims=False)
                      if am_mb is not None else None)
             x_in = jnp.where(is_first, emb, x_recv)
-            y, _aux = self._scan_layers(x_in, layers_local, positions, mb_am,
-                                        remat_policy)
+            y, aux = self._scan_layers(x_in, layers_local, positions, mb_am,
+                                       remat_policy)
             d_i = jnp.clip(t - (Pn - 1), 0, M - 1)    # drained micro index
-            valid = (t >= Pn - 1).astype(jnp.float32)
+            # t >= T guards the 1f1b window padding: without it the last
+            # drained microbatch would be double-counted on no-op ticks.
+            valid = ((t >= Pn - 1) & (t < T)).astype(jnp.float32)
+            # This stage holds real data (micro t - p) only for p <= t < p+M;
+            # outside that window the trunk chews warmup/drain garbage and
+            # its MoE aux contribution must not count.
+            aux_valid = ((t >= p) & (t < p + M)).astype(jnp.float32)
             m_loss, m_tok = micro_loss(y, d_i)
             x_send = lax.ppermute(y, "pipe", perm)
             return (x_send, loss_acc + valid * m_loss,
-                    tok_acc + valid * m_tok), None
+                    tok_acc + valid * m_tok,
+                    aux_acc + aux_valid * aux.astype(jnp.float32)), None
 
-        if self.tick_remat:
+        if self.tick_remat or memory_bound:
             tick = jax.checkpoint(tick, prevent_cse=False)
         x0 = lax.pcast(jnp.zeros((Bm, S, cfg.d_model), cfg.dtype),
                        ("pipe",), to="varying")
         zero = lax.pcast(jnp.float32(0.0), ("pipe",), to="varying")
-        (_, loss_part, tok_part), _ = lax.scan(tick, (x0, zero, zero),
-                                               jnp.arange(T))
+        carry0 = (x0, zero, zero, zero)
+        if memory_bound:
+            # Windowed scan: inner P ticks under one jax.checkpoint — the
+            # backward stashes ceil(T/P) window-boundary carries and
+            # recomputes one window (itself tick-checkpointed) at a time.
+            Wn = Pn
+            n_win = -(-T // Wn)
+            ticks = jnp.arange(n_win * Wn).reshape(n_win, Wn)
+
+            def window(carry, ts):
+                carry, _ = lax.scan(tick, carry, ts)
+                return carry, None
+
+            window = jax.checkpoint(window, prevent_cse=False)
+            (_, loss_part, tok_part, aux_part), _ = lax.scan(
+                window, carry0, ticks)
+        else:
+            (_, loss_part, tok_part, aux_part), _ = lax.scan(
+                tick, carry0, jnp.arange(T))
         loss_sum = lax.psum(loss_part, "pipe")
         tok_sum = lax.psum(tok_part, "pipe")
-        return loss_sum / jnp.maximum(tok_sum, 1.0)
+        loss = loss_sum / jnp.maximum(tok_sum, 1.0)
+        if cfg.num_experts > 1:
+            # Per-stage aux summed its local L/P layers over M real micros;
+            # psum assembles the full depth, /M matches the dense trunk's
+            # whole-batch mean (equal-sized micros: mean of means is exact).
+            aux_total = lax.psum(aux_part, "pipe") / M
+            loss = loss + cfg.moe_aux_loss_weight * aux_total
+        return loss
 
     # ----------------------------------------------------------------- loss
     def loss(self, params, batch, *, remat_policy=None):
@@ -244,7 +300,23 @@ class PipelinedTransformerLM(TransformerLM):
         return f(params, ids_mb, lm_mb)
 
 
+class PipelinedTransformerLM(_PipelinedLMBase, TransformerLM):
+    """Dense trunk under the ``pipe``-axis schedule."""
+
+
+class PipelinedMoETransformerLM(_PipelinedLMBase, MoETransformerLM):
+    """MoE trunk under the ``pipe``-axis schedule: the expert banks keep
+    their ``expert``/``model`` sharding (GSPMD-managed inside the manual-pipe
+    shard_map) and the GShard aux loss is accumulated per real microbatch,
+    psum'd across stages — lifting the reference's MoE-on-pipe layer-list
+    machinery (``pipe/module.py`` + ``moe/layer.py``) into one program."""
+
+
 def build_pipeline_model(cfg: TransformerConfig, n_stages: int,
-                         num_micro: int | None = None,
-                         attention_fn=None) -> PipelinedTransformerLM:
-    return PipelinedTransformerLM(cfg, n_stages, num_micro, attention_fn)
+                         num_micro: int | None = None, attention_fn=None,
+                         tick_remat: bool = False,
+                         schedule: str = "gpipe"):
+    cls = (PipelinedMoETransformerLM if cfg.num_experts > 1
+           else PipelinedTransformerLM)
+    return cls(cfg, n_stages, num_micro, attention_fn,
+               tick_remat=tick_remat, schedule=schedule)

@@ -411,12 +411,16 @@ class TransformerLM:
                            out_specs=P(B_AXES, "seq", None))
         return fn(table, ids)
 
+    @staticmethod
+    def _positions(B: int, S: int):
+        return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+
     def _embed(self, params, input_ids):
         """(B, S) int32 → ((B, S, D) embeddings, (B, S) positions)."""
         cfg = self.cfg
         B, S = input_ids.shape
         x = self._tok_lookup(params["tok_embed"].astype(cfg.dtype), input_ids)
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        positions = self._positions(B, S)
         if cfg.pos_embedding == "learned":
             x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
         return constrain(x, P(B_AXES, "seq", None)), positions

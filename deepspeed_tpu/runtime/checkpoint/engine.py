@@ -147,10 +147,15 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
     raw = None
     if layout is None:
         # pre-"layout" checkpoints: the store is OCDBT (no per-leaf dirs on
-        # disk), so sniff the restored tree — the host layout alone has a
-        # top-level optimizer step "count".
-        raw = ckptr.restore(path / "state")
-        layout = "host" if "count" in raw else "device"
+        # disk), so sniff the tree structure — the host layout alone has a
+        # top-level optimizer step "count". Metadata reads no array data;
+        # fall back to a full (unsharded) restore only if it's unavailable.
+        try:
+            keys = set(ckptr.metadata(path / "state").keys())
+        except Exception:
+            raw = ckptr.restore(path / "state")
+            keys = set(raw)
+        layout = "host" if "count" in keys else "device"
 
     def _host_trees():
         """(master, mu, nu, count) from either on-disk layout. The count is

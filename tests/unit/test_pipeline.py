@@ -105,3 +105,103 @@ def test_attention_mask_respected(devices):
     with mesh:
         got = float(jax.jit(lambda p, b: piped.loss(p, b))(params, batch))
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# --------------------------------------------------- 1F1B memory-bounded
+def test_1f1b_loss_and_grads_match_dense(devices):
+    """The windowed-remat schedule must be numerically identical to dense
+    (it reorders recompute, not math)."""
+    cfg = tiny_test(n_layer=4, max_seq=32)
+    dense = TransformerLM(cfg)
+    piped = PipelinedTransformerLM(cfg, n_stages=4, num_micro=4,
+                                   schedule="1f1b")
+    params = dense.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32)}
+    gpipe = PipelinedTransformerLM(cfg, n_stages=4, num_micro=4)
+    want = float(dense.loss(params, batch))
+    mesh = build_mesh(MeshSpec(data=2, pipe=4))
+    with mesh:
+        got = float(jax.jit(lambda p, b: piped.loss(p, b))(params, batch))
+        gp = jax.jit(jax.grad(lambda p: piped.loss(p, batch)))(params)
+        gg = jax.jit(jax.grad(lambda p: gpipe.loss(p, batch)))(params)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # grads vs the GPipe schedule (identical decomposition — any drift vs
+    # dense is shared accumulation-order numerics, asserted by
+    # test_grads_match_dense): must agree tightly.
+    flat_w, _ = jax.tree_util.tree_flatten_with_path(gg)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(gp)
+    for (kw, w), (_, g) in zip(flat_w, flat_p):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-6,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(kw)}")
+
+
+def test_1f1b_peak_memory_below_gpipe(devices):
+    """The point of the schedule: backward-pass live activations are
+    O(P window) not O(M). Compare XLA's own accounting (temp buffer size of
+    the compiled grad program) at M >> P."""
+    cfg = tiny_test(n_layer=4, max_seq=64, d_model=128)
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (32, 64)), jnp.int32)}
+    mesh = build_mesh(MeshSpec(data=2, pipe=4))
+
+    def temp_bytes(schedule):
+        model = PipelinedTransformerLM(cfg, n_stages=4, num_micro=16,
+                                       schedule=schedule)
+        with mesh:
+            compiled = (jax.jit(jax.grad(lambda p: model.loss(p, batch)))
+                        .lower(params).compile())
+        mem = compiled.memory_analysis()
+        return int(getattr(mem, "temp_size_in_bytes", 0))
+
+    gpipe, mem_1f1b = temp_bytes("gpipe"), temp_bytes("1f1b")
+    assert 0 < mem_1f1b < 0.6 * gpipe, (
+        f"1f1b temp {mem_1f1b} not clearly below gpipe temp {gpipe}")
+
+
+# ------------------------------------------------------------- MoE + pipe
+def test_moe_pipeline_matches_dense_moe(devices):
+    """MoE trunk under the pipe schedule == dense MoE trunk (incl. the
+    GShard aux loss), lifting the round-2 MoE+pipe exclusion."""
+    from deepspeed_tpu.models.moe import MoETransformerLM
+    from deepspeed_tpu.models.pipeline import PipelinedMoETransformerLM
+
+    cfg = tiny_test(n_layer=4, max_seq=32, num_experts=4)
+    dense = MoETransformerLM(cfg)
+    piped = PipelinedMoETransformerLM(cfg, n_stages=4, num_micro=2)
+    params = dense.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32)
+    batch = {"input_ids": ids}
+    # oracle computed per-microbatch (routing capacity is per-group): the
+    # pipelined schedule sees Bm=4-row groups, so feed dense the same groups
+    want = float(np.mean([float(dense.loss(params, {"input_ids": ids[i:i + 4]}))
+                          for i in range(0, 8, 4)]))
+    mesh = build_mesh(MeshSpec(data=2, pipe=4))
+    with mesh:
+        got = float(jax.jit(lambda p, b: piped.loss(p, b))(params, batch))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_moe_pipeline_trains(devices):
+    """Engine e2e: MoE + pipeline + ZeRO-1 on a data x pipe mesh."""
+    from deepspeed_tpu.models.pipeline import PipelinedMoETransformerLM
+
+    cfg = tiny_test(n_layer=4, max_seq=32, num_experts=2)
+    model = PipelinedMoETransformerLM(cfg, n_stages=4, num_micro=4,
+                                      schedule="1f1b")
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": 2, "pipe": 4},
+    }, model)
+    data = random_token_dataset(16, seq_len=32, vocab_size=256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
