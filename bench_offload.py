@@ -1,0 +1,151 @@
+"""Offload benchmark: ZeRO-Offload training throughput + host-step overlap.
+
+Writes ``OFFLOAD_BENCH.json`` and prints it: tokens/s, params-per-chip
+ratio (model params vs HBM-resident bytes), and the bwd-vs-host-step time
+split — the round-2 verdict's "host-step time < backward time" target for
+the pipelined host update (reference ``stage_1_and_2.py:1096`` overlap).
+
+Same tunnel armor as bench.py: probe in a throwaway subprocess, run the
+workload in a fresh child, fall back to the virtual CPU mesh (marked) if
+the TPU never comes up. Model size via DSTPU_OFFLOAD_BENCH_SIZE (default
+125m — the axon relay moves host<->device at ~1 GB/min, so multi-GB masters
+are impractical over the tunnel; on real metal set 1.5b/7b).
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+_CHILD_MARK = "_DSTPU_OFFBENCH_CHILD"
+_PROBE_TIMEOUT_S = 120
+_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 15 * 60))
+_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "OFFLOAD_BENCH.json")
+
+
+def _run_workload():
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, gpt2
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    size = os.environ.get("DSTPU_OFFLOAD_BENCH_SIZE", "125m")
+    if on_tpu:
+        seq, micro, n_steps = 512, 8, 5
+    else:
+        seq, micro, n_steps, size = 128, 2, 3, "125m"
+
+    cfg = {
+        "train_batch_size": micro * len(devices),
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+        "remat": {"enabled": True, "policy": "dots_saveable"},
+    }
+    model_cfg = gpt2(size, max_seq=seq)
+    engine = ds.initialize(cfg, build_model(model_cfg))
+    data = random_token_dataset(engine.train_batch_size, seq_len=seq,
+                                vocab_size=model_cfg.vocab_size)
+    batch = DataLoader(data, local_batch_size=engine.train_batch_size,
+                       shuffle=False).collate_fn(data)
+
+    m = engine.train_batch(batch)          # warmup/compile
+    assert math.isfinite(m["loss"]), m
+    bwd, host, t0 = [], [], time.perf_counter()
+    for _ in range(n_steps):
+        m = engine.train_batch(batch)
+        bwd.append(m["bwd_s"])
+        host.append(m["host_step_s"])
+    assert math.isfinite(m["loss"]), m
+    dt = (time.perf_counter() - t0) / n_steps
+
+    n_params = engine.param_count
+    tokens_per_sec = engine.train_batch_size * seq / dt
+    result = {
+        "metric": "gpt2_offload_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": (f"tokens/s ({size}, {n_params / 1e6:.0f}M params, "
+                 f"platform={devices[0].platform}"
+                 + ("" if on_tpu else ", CPU-FALLBACK") + ")"),
+        "params": n_params,
+        "step_s": round(dt, 4),
+        "bwd_s": round(float(np.mean(bwd)), 4),
+        "host_step_s": round(float(np.mean(host)), 4),
+        "host_lt_bwd": bool(np.mean(host) < np.mean(bwd)),
+        "hbm_resident_bytes": int(n_params * 2),   # bf16 compute copy only
+        "host_state_bytes": int(n_params * 4 * 3),  # fp32 master + 2 moments
+    }
+    print(json.dumps(result), flush=True)
+
+
+def _probe(timeout=_PROBE_TIMEOUT_S) -> bool:
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False
+    return p.returncode == 0
+
+
+def _child(env, timeout=1500):
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, timeout=timeout, capture_output=True,
+                           text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    sys.stderr.write(p.stderr or "")
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        if line.strip().startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    if os.environ.get(_CHILD_MARK) == "1":
+        _run_workload()
+        return
+    env = dict(os.environ)
+    env[_CHILD_MARK] = "1"
+    result = None
+    deadline = time.monotonic() + _WINDOW_S
+    attempt = 0
+    while time.monotonic() < deadline:
+        if attempt:
+            time.sleep(min(30 * attempt, 180))
+        attempt += 1
+        if not _probe():
+            continue
+        result = _child(env)
+        if result is not None:
+            break
+    if result is None:
+        cpu_env = dict(env)
+        cpu_env["PALLAS_AXON_POOL_IPS"] = ""
+        cpu_env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(f for f in cpu_env.get("XLA_FLAGS", "").split()
+                         if not f.startswith("--xla_force_host_platform_device_count"))
+        cpu_env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        result = _child(cpu_env, timeout=900)
+    if result is None:
+        raise SystemExit("offload bench failed on TPU and CPU fallback")
+    with open(_OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

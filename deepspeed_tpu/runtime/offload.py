@@ -135,12 +135,15 @@ class HostOffloadOptimizer:
                                   **kw)
 
     # ----------------------------------------------------------------- step
-    def step(self, grads_tree, lr: float, clip_coef: float = 1.0):
-        """Host update over all leaves; returns the new device compute tree."""
+    def step(self, grads_tree, lr: float):
+        """Host update over all leaves; returns the new device compute tree.
+        Grads arrive clipped (the engine clips on-device in the grad step);
+        with pinned-host grad outputs the D2H already happened inside the
+        compiled step, overlapped with backward."""
         self.count += 1
         g_arrays = jax.tree_util.tree_leaves(grads_tree)
         # start all device→host DMAs before the first blocking device_get
-        # (overlaps transfers with the per-leaf native updates below)
+        # (no-op for grads already in pinned host memory)
         for g in g_arrays:
             try:
                 g.copy_to_host_async()
@@ -149,9 +152,6 @@ class HostOffloadOptimizer:
         g_leaves = [np.ascontiguousarray(
             np.asarray(jax.device_get(g), np.float32).reshape(-1))
             for g in g_arrays]
-        if clip_coef != 1.0:
-            # device_get views can be read-only; clipping allocates
-            g_leaves = [g * np.float32(clip_coef) for g in g_leaves]
         n = len(self.shapes)
         new_device = []
 
