@@ -25,12 +25,12 @@ been recorded.
 import json
 import math
 import os
-import subprocess
 import sys
 import time
 
+import bench_common as bc
+
 _CHILD_MARK = "_DSTPU_BENCH_CHILD"
-_PROBE_TIMEOUT_S = 120
 _CHILD_TIMEOUT_S = 1200
 _TPU_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 40 * 60))
 _CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -142,93 +142,20 @@ def _run_workload():
     print(json.dumps(result), flush=True)
 
 
-def _log(msg: str) -> None:
-    print(f"[bench] {msg}", file=sys.stderr, flush=True)
-
-
-def _probe_backend(timeout: float = _PROBE_TIMEOUT_S) -> bool:
-    """Can a fresh interpreter claim the ambient backend right now?"""
-    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
-    try:
-        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        _log(f"backend probe timed out after {timeout}s (tunnel wedged?)")
-        return False
-    if p.returncode != 0:
-        tail = (p.stderr or "").strip().splitlines()[-1:]
-        _log(f"backend probe failed rc={p.returncode}: {tail}")
-        return False
-    _log(f"backend probe ok: {p.stdout.strip()}")
-    return True
-
-
-def _warn_strays() -> None:
-    """The tunnel admits one process; list other pythons that may hold it."""
-    try:
-        out = subprocess.run(["ps", "-eo", "pid,etime,cmd"], capture_output=True,
-                             text=True, timeout=10).stdout
-    except Exception:
-        return
-    me = str(os.getpid())
-    for line in out.splitlines():
-        if "python" in line and "bench.py" not in line and me not in line.split()[:1]:
-            if any(k in line for k in ("jax", "pytest", "graft_entry", "deepspeed")):
-                _log(f"possible TPU-holding stray: {line.strip()}")
-
-
-def _run_child(env: dict, timeout: float = _CHILD_TIMEOUT_S):
-    """Run the workload in a fresh interpreter; return parsed JSON or None."""
-    try:
-        p = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
-                           timeout=timeout, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        _log(f"workload child timed out after {timeout}s")
-        return None
-    sys.stderr.write(p.stderr or "")
-    for line in reversed((p.stdout or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    _log(f"workload child rc={p.returncode}, no JSON line in stdout: "
-         f"{(p.stdout or '')[-300:]!r}")
-    return None
-
-
 def main() -> None:
     if os.environ.get(_CHILD_MARK) == "1":
         _run_workload()
         return
 
-    _warn_strays()
     child_env = dict(os.environ)
     child_env[_CHILD_MARK] = "1"
+    me = os.path.abspath(__file__)
 
     # Retry across the whole window: a wedged tunnel often clears in tens of
     # minutes, and one real TPU number is worth far more than a fast CPU
     # artifact (round-2 postmortem).
-    result = None
-    deadline = time.monotonic() + _TPU_WINDOW_S
-    attempt = 0
-    while time.monotonic() < deadline:
-        if attempt:
-            backoff = min(30 * attempt, 300)
-            remaining = deadline - time.monotonic()
-            if remaining < backoff + _PROBE_TIMEOUT_S:
-                _log(f"window exhausted ({remaining:.0f}s left)")
-                break
-            _log(f"retrying in {backoff}s (attempt {attempt + 1}, "
-                 f"{remaining / 60:.1f} min left in window)")
-            time.sleep(backoff)
-        attempt += 1
-        if not _probe_backend():
-            continue
-        result = _run_child(child_env)
-        if result is not None:
-            break
+    result = bc.run_with_tpu_window(me, child_env, window_s=_TPU_WINDOW_S,
+                                    child_timeout=_CHILD_TIMEOUT_S)
 
     if result is not None and "platform=tpu" in result.get("unit", ""):
         _save_cache(result)  # parent-side too, in case an old child lacks it
@@ -236,21 +163,16 @@ def main() -> None:
     if result is None:
         cached = _load_cache()
         if cached is not None:
-            _log(f"TPU unavailable for the whole window; reporting "
-                 f"last-known-good TPU measurement from {cached['iso']}")
+            bc.log(f"TPU unavailable for the whole window; reporting "
+                   f"last-known-good TPU measurement from {cached['iso']}")
             result = dict(cached["result"])
             result["unit"] = (result["unit"].rstrip(")")
                               + f", last-known-good cached {cached['iso']})")
         else:
-            _log("TPU unavailable and no cached TPU measurement; "
-                 "falling back to virtual CPU")
-            cpu_env = dict(child_env)
-            cpu_env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon relay registration
-            cpu_env["JAX_PLATFORMS"] = "cpu"
-            flags = " ".join(f for f in cpu_env.get("XLA_FLAGS", "").split()
-                             if not f.startswith("--xla_force_host_platform_device_count"))
-            cpu_env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-            result = _run_child(cpu_env, timeout=900)
+            bc.log("TPU unavailable and no cached TPU measurement; "
+                   "falling back to virtual CPU")
+            result = bc.run_child(me, bc.cpu_fallback_env(child_env),
+                                  timeout=900)
 
     if result is None:
         raise SystemExit("bench failed on TPU and on CPU fallback")

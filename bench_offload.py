@@ -15,12 +15,11 @@ are impractical over the tunnel; on real metal set 1.5b/7b).
 import json
 import math
 import os
-import subprocess
-import sys
 import time
 
+import bench_common as bc
+
 _CHILD_MARK = "_DSTPU_OFFBENCH_CHILD"
-_PROBE_TIMEOUT_S = 120
 _WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 15 * 60))
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "OFFLOAD_BENCH.json")
@@ -29,6 +28,9 @@ _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def _run_workload():
     import jax
     import numpy as np
+
+    def jnp_dtype_size(dt):
+        return np.dtype(dt).itemsize
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model, gpt2
@@ -47,6 +49,7 @@ def _run_workload():
         "train_micro_batch_size_per_gpu": micro,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1,
                               "offload_optimizer": {"device": "cpu"}},
         "remat": {"enabled": True, "policy": "dots_saveable"},
@@ -81,37 +84,11 @@ def _run_workload():
         "bwd_s": round(float(np.mean(bwd)), 4),
         "host_step_s": round(float(np.mean(host)), 4),
         "host_lt_bwd": bool(np.mean(host) < np.mean(bwd)),
-        "hbm_resident_bytes": int(n_params * 2),   # bf16 compute copy only
+        "hbm_resident_bytes": int(
+            n_params * jnp_dtype_size(engine.compute_dtype)),  # compute copy
         "host_state_bytes": int(n_params * 4 * 3),  # fp32 master + 2 moments
     }
     print(json.dumps(result), flush=True)
-
-
-def _probe(timeout=_PROBE_TIMEOUT_S) -> bool:
-    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
-    try:
-        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return False
-    return p.returncode == 0
-
-
-def _child(env, timeout=1500):
-    try:
-        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, timeout=timeout, capture_output=True,
-                           text=True)
-    except subprocess.TimeoutExpired:
-        return None
-    sys.stderr.write(p.stderr or "")
-    for line in reversed((p.stdout or "").strip().splitlines()):
-        if line.strip().startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    return None
 
 
 def main():
@@ -120,26 +97,13 @@ def main():
         return
     env = dict(os.environ)
     env[_CHILD_MARK] = "1"
-    result = None
-    deadline = time.monotonic() + _WINDOW_S
-    attempt = 0
-    while time.monotonic() < deadline:
-        if attempt:
-            time.sleep(min(30 * attempt, 180))
-        attempt += 1
-        if not _probe():
-            continue
-        result = _child(env)
-        if result is not None:
-            break
+    me = os.path.abspath(__file__)
+    result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
+                                    child_timeout=1500, tag="offload-bench")
     if result is None:
-        cpu_env = dict(env)
-        cpu_env["PALLAS_AXON_POOL_IPS"] = ""
-        cpu_env["JAX_PLATFORMS"] = "cpu"
-        flags = " ".join(f for f in cpu_env.get("XLA_FLAGS", "").split()
-                         if not f.startswith("--xla_force_host_platform_device_count"))
-        cpu_env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-        result = _child(cpu_env, timeout=900)
+        bc.log("TPU unavailable; falling back to virtual CPU", "offload-bench")
+        result = bc.run_child(me, bc.cpu_fallback_env(env), timeout=900,
+                              tag="offload-bench")
     if result is None:
         raise SystemExit("offload bench failed on TPU and CPU fallback")
     with open(_OUT, "w") as f:

@@ -1,5 +1,7 @@
+from .agent import run_elastic
 from .elasticity import (ElasticityError, assert_elastic_config_consistent,
                          compute_elastic_config, elastic_batch_for)
 
 __all__ = ["compute_elastic_config", "elastic_batch_for",
-           "assert_elastic_config_consistent", "ElasticityError"]
+           "assert_elastic_config_consistent", "ElasticityError",
+           "run_elastic"]

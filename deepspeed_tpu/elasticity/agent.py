@@ -1,0 +1,139 @@
+"""Elastic agent: membership-change restart supervisor.
+
+Analog of the reference's ``DSElasticAgent`` (``elasticity/elastic_agent.py:28``,
+a torch-elastic ``LocalElasticAgent`` subclass that restarts worker groups
+when the rendezvous membership changes) and the ``bin/ds_elastic`` CLI.
+
+TPU-native shape: there is no torch-elastic rendezvous to subclass — JAX's
+coordination service forms a fixed process set per incarnation. So elasticity
+is a *restart loop around the launcher*: when the group fails (worker crash,
+host loss, resize request), the agent re-probes the available world, verifies
+it against the elastic schema (``compute_elastic_config`` — same global batch
+reachable at the new world size), and relaunches the script, which resumes
+from the latest checkpoint (universal-by-construction: the orbax store
+reshards onto the new topology natively, proven by
+``tests/unit/test_checkpoint_reshard.py``).
+
+World-size sources, re-probed before every incarnation:
+- ``--hostfile``: re-parsed each restart — hosts added/removed between
+  incarnations change the world (the operational analog of a membership
+  change);
+- ``--nproc_file``: a file holding the process count (tests, external
+  schedulers);
+- ``--nproc``: fixed (restart-on-failure only).
+
+Each incarnation gets a fresh coordinator port (the previous service socket
+may linger after an unclean death) and ``DSTPU_ELASTIC_RESTART=<n>`` in its
+environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dstpu-elastic",
+        description="elastic restart supervisor (reference bin/ds_elastic)")
+    p.add_argument("-H", "--hostfile", default=None,
+                   help="re-parsed before every incarnation")
+    p.add_argument("--nproc_file", default=None,
+                   help="file holding the current process count; re-read "
+                        "before every incarnation")
+    p.add_argument("--nproc", type=int, default=1)
+    p.add_argument("--max_restarts", type=int, default=100)
+    p.add_argument("--restart_delay", type=float, default=1.0,
+                   help="seconds between incarnations")
+    p.add_argument("--master_port", type=int, default=12321)
+    # elastic schema (MUST mirror config.elasticity exactly — validated
+    # pre-launch so a membership change to an incompatible world fails HERE,
+    # loudly, instead of crash-looping every incarnation in engine init)
+    p.add_argument("--max_train_batch_size", type=int, default=None)
+    p.add_argument("--micro_batch_sizes", default=None,
+                   help="comma list, e.g. 1,2,4")
+    p.add_argument("--min_devices", type=int, default=1)
+    p.add_argument("--max_devices", type=int, default=1024)
+    p.add_argument("--module", action="store_true")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def probe_world(args) -> int:
+    """Current available process count, from the freshest source."""
+    if args.nproc_file:
+        with open(args.nproc_file) as f:
+            return max(1, int(f.read().strip()))
+    if args.hostfile:
+        from ..launcher.hostfile import parse_hostfile
+
+        with open(args.hostfile) as f:
+            pool = parse_hostfile(f.read())
+        return max(1, sum(int(v) for v in pool.values()))
+    return args.nproc
+
+
+def check_world(args, world: int) -> None:
+    """Fail fast if the new world can't reach the elastic global batch."""
+    if args.max_train_batch_size is None or args.micro_batch_sizes is None:
+        return
+    micros = [int(m) for m in args.micro_batch_sizes.split(",")]
+    _, valid, _ = compute_elastic_config(
+        max_train_batch_size=args.max_train_batch_size,
+        micro_batch_sizes=micros, min_devices=args.min_devices,
+        max_devices=args.max_devices)
+    if world not in valid:
+        raise ElasticityError(
+            f"world size {world} is not in the elastic-compatible set "
+            f"{valid}; fix the hostfile/nproc or the elastic schema")
+
+
+def run_elastic(argv=None) -> int:
+    args = parse_args(argv)
+    restarts = 0
+    port = args.master_port
+    last_world = None
+    while True:
+        world = probe_world(args)
+        check_world(args, world)
+        if last_world is not None and world != last_world:
+            print(f"[dstpu-elastic] membership change: world {last_world} "
+                  f"-> {world}", file=sys.stderr, flush=True)
+        last_world = world
+        env = dict(os.environ, DSTPU_ELASTIC_RESTART=str(restarts))
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+               "--nproc", str(world), "--master_port", str(port)]
+        if args.hostfile:
+            cmd += ["--hostfile", args.hostfile]
+        if args.module:
+            cmd += ["--module"]
+        cmd += [args.script] + args.script_args
+        print(f"[dstpu-elastic] incarnation {restarts}: world={world} "
+              f"port={port}", file=sys.stderr, flush=True)
+        rc = subprocess.call(cmd, env=env)
+        if rc == 0:
+            print(f"[dstpu-elastic] job finished after {restarts} restart(s)",
+                  file=sys.stderr, flush=True)
+            return 0
+        restarts += 1
+        port += 1      # fresh coordinator socket per incarnation
+        if restarts > args.max_restarts:
+            print(f"[dstpu-elastic] giving up after {args.max_restarts} "
+                  f"restarts (last rc={rc})", file=sys.stderr, flush=True)
+            return rc
+        time.sleep(args.restart_delay)
+
+
+def main(argv=None) -> None:
+    sys.exit(run_elastic(argv))
+
+
+if __name__ == "__main__":
+    main()
