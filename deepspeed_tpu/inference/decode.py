@@ -40,15 +40,17 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                    length=jnp.zeros((), jnp.int32))
 
 
-def _cache_attend(q, ck, cv, length, flash_decode: bool = False):
+def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None):
     """q: (B, T, H, hd) vs cache (B, max_len, KV, hd); positions >= length
     masked. For prefill T = prompt len (with causal offset); decode T = 1.
 
+    ``bias`` is an additive (H, T, max_len) score bias (ALiBi).
     ``flash_decode`` routes the T == 1 hot path to the Pallas streaming
     kernel (ops/decode_attention.py) instead of materializing the full
     (B, H, 1, max_len) score tensor."""
     B, T, H, hd = q.shape
-    if flash_decode and T == 1 and ck.shape[1] % min(128, ck.shape[1]) == 0:
+    if (flash_decode and bias is None and T == 1
+            and ck.shape[1] % min(128, ck.shape[1]) == 0):
         from ..ops.decode_attention import decode_attention
 
         return decode_attention(q, ck, cv, length)
@@ -58,6 +60,8 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False):
         cv = jnp.repeat(cv, H // KV, axis=2)
     scores = jnp.einsum("bthd,bshd->bhts", q, ck).astype(jnp.float32)
     scores = scores / math.sqrt(hd)
+    if bias is not None:
+        scores = scores + bias[None]
     # query t (global position length - T + t) may attend cache slot s
     # iff s <= that position
     t_pos = length - T + jnp.arange(T)[:, None]          # (T, 1)
@@ -85,16 +89,34 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
     k = model._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, T, kv, hd)
     v = model._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, T, kv, hd)
     if cfg.pos_embedding == "rope":
-        q, k = _rope(q, k, positions, cfg.rope_theta)
+        q, k = _rope(q, k, positions, cfg.rope_theta, cfg.rotary_dim)
 
     start = length - T  # cache slots [start, start+T) receive the new k/v
     cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
                                        (0, start, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                        (0, start, 0, 0))
-    o = _cache_attend(q, cache_k, cache_v, length, flash_decode=flash_decode)
+    bias = None
+    if cfg.pos_embedding == "alibi":
+        # ALiBi distance bias, cache coordinates: query t sits at global
+        # position length-T+t, key at slot s (mirrors _attention_block's
+        # training-path bias; without it Bloom decodes with no positional
+        # signal at all).
+        from ..models.transformer import alibi_slopes
+
+        t_pos = length - T + jnp.arange(T)[:, None]
+        s_pos = jnp.arange(cache_k.shape[1])[None, :]
+        rel = (s_pos - t_pos).astype(jnp.float32)        # (T, max_len)
+        bias = alibi_slopes(h)[:, None, None] * rel[None]
+    o = _cache_attend(q, cache_k, cache_v, length, flash_decode=flash_decode,
+                      bias=bias)
     o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
                           p, "bo")
+    if cfg.parallel_residual:
+        y2 = y if cfg.parallel_shared_ln else _norm(
+            x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        out, _aux = model._mlp_block(y2, p)
+        return x + o + out, cache_k, cache_v
     x = x + o
     y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
     out, _aux = model._mlp_block(y2, p)
@@ -117,6 +139,9 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     x = params["tok_embed"].astype(cfg.dtype)[input_ids]
     if cfg.pos_embedding == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
+    if cfg.embed_norm:
+        x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
+                  cfg.norm, cfg.norm_eps)
 
     def scan_fn(carry, layer_in):
         x = carry

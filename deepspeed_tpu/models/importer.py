@@ -57,19 +57,26 @@ def _to_numpy(t) -> np.ndarray:
     raise TypeError(f"unsupported tensor type {type(t)!r}")
 
 
-def _rope_interleave_perm(n_heads: int, head_dim: int) -> np.ndarray:
+def _rope_interleave_perm(n_heads: int, head_dim: int,
+                          rotary_dim: int | None = None) -> np.ndarray:
     """Column permutation converting HF rotate-half q/k projections to the
     trunk's interleaved-pair RoPE basis.
 
-    HF rotates dim ``j`` with dim ``j + hd/2`` (shared freq_j); the trunk
+    HF rotates dim ``j`` with dim ``j + rd/2`` (shared freq_j); the trunk
     rotates dims ``(2j, 2j+1)``.  Mapping output column ``2j ← j`` and
-    ``2j+1 ← j + hd/2`` per head makes both compute identical attention
+    ``2j+1 ← j + rd/2`` per head makes both compute identical attention
     scores (the permutation is applied to q AND k, so dot products are
-    invariant and ``wo`` needs no change)."""
-    half = head_dim // 2
-    per_head = np.empty((head_dim,), dtype=np.int64)
-    per_head[0::2] = np.arange(half)
-    per_head[1::2] = np.arange(half) + half
+    invariant and ``wo`` needs no change).  With partial rotary
+    (``rotary_dim`` < head_dim, NeoX ``rotary_pct``), only the leading
+    rotary columns permute; the pass-through tail keeps identity order.
+    GPT-J needs NO permutation — its rotary is natively interleaved."""
+    rd = rotary_dim or head_dim
+    half = rd // 2
+    per_head = np.arange(head_dim, dtype=np.int64)
+    rot = np.empty((rd,), dtype=np.int64)
+    rot[0::2] = np.arange(half)
+    rot[1::2] = np.arange(half) + half
+    per_head[:rd] = rot
     return (np.arange(n_heads)[:, None] * head_dim + per_head[None, :]).reshape(-1)
 
 
@@ -278,6 +285,277 @@ def _opt_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     }
 
 
+
+# ------------------------------------------------------------- family: gptj
+def _gptj_config(hf: dict) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["n_layer"],
+        n_head=hf["n_head"],
+        d_model=hf["n_embd"],
+        d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+        max_seq=hf.get("n_positions", 2048),
+        pos_embedding="rope", rotary_dim=hf.get("rotary_dim"),
+        norm="layernorm", activation="gelu",   # gelu_new = tanh approx
+        use_bias=True, tie_embeddings=False, lm_head_bias=True,
+        parallel_residual=True, parallel_shared_ln=True,
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def _gptj_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """GPT-J: parallel residual, ONE layernorm, separate unbiased q/k/v,
+    partial interleaved rotary (native basis — no permutation)."""
+    d, hh = cfg.d_model, cfg.n_head * cfg.head_dim
+    zeros_h = np.zeros((hh,), np.float32)
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"h.{i}."
+        per_layer.append({
+            "ln1_scale": sd.take(h + "ln_1.weight"),
+            "ln1_bias": sd.take(h + "ln_1.bias"),
+            "wq": sd.take(h + "attn.q_proj.weight").T,
+            "wk": sd.take(h + "attn.k_proj.weight").T,
+            "wv": sd.take(h + "attn.v_proj.weight").T,
+            "bq": zeros_h, "bk": zeros_h, "bv": zeros_h,
+            "wo": sd.take(h + "attn.out_proj.weight").T,
+            "bo": np.zeros((d,), np.float32),
+            "w_in": sd.take(h + "mlp.fc_in.weight").T,
+            "b_in": sd.take(h + "mlp.fc_in.bias"),
+            "w_out": sd.take(h + "mlp.fc_out.weight").T,
+            "b_out": sd.take(h + "mlp.fc_out.bias"),
+        })
+    return {
+        "tok_embed": sd.take("wte.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("ln_f.weight"),
+        "lnf_bias": sd.take("ln_f.bias"),
+        "lm_head": sd.take("lm_head.weight").T,
+        "lm_head_bias": sd.take("lm_head.bias"),
+    }
+
+
+# --------------------------------------------------------- family: gpt_neox
+def _neox_config(hf: dict) -> TransformerConfig:
+    hd = hf["hidden_size"] // hf["num_attention_heads"]
+    if not hf.get("use_parallel_residual", True):
+        raise ValueError("gpt_neox with use_parallel_residual=False: use the "
+                         "sequential trunk via a custom config")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        d_model=hf["hidden_size"],
+        d_ff=hf["intermediate_size"],
+        max_seq=hf.get("max_position_embeddings", 2048),
+        pos_embedding="rope",
+        rotary_dim=int(hd * hf.get("rotary_pct", 0.25)),
+        rope_theta=hf.get("rotary_emb_base", 10000.0),
+        norm="layernorm", activation="gelu_exact",
+        use_bias=True, tie_embeddings=False,
+        parallel_residual=True, parallel_shared_ln=False,
+        norm_eps=hf.get("layer_norm_eps", 1e-5),
+    )
+
+
+def _split_fused_qkv_per_head(w, n_head, head_dim, d):
+    """(3*h*hd, d) torch weight with per-head [q|k|v] interleave →
+    three (d, h*hd) matmul weights (NeoX/Bloom layout)."""
+    w = w.reshape(n_head, 3, head_dim, d)
+    return tuple(w[:, j].reshape(n_head * head_dim, d).T for j in range(3))
+
+
+def _neox_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """GPT-NeoX: parallel residual with TWO layernorms, fused per-head-
+    interleaved qkv, partial rotate-half rotary → permute rotary columns."""
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    perm = _rope_interleave_perm(h, hd, cfg.rotary_dim)
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"layers.{i}."
+        wq, wk, wv = _split_fused_qkv_per_head(
+            sd.take(p + "attention.query_key_value.weight"), h, hd, d)
+        bq, bk, bv = (sd.take(p + "attention.query_key_value.bias")
+                      .reshape(h, 3, hd)[:, j].reshape(-1) for j in range(3))
+        per_layer.append({
+            "ln1_scale": sd.take(p + "input_layernorm.weight"),
+            "ln1_bias": sd.take(p + "input_layernorm.bias"),
+            "ln2_scale": sd.take(p + "post_attention_layernorm.weight"),
+            "ln2_bias": sd.take(p + "post_attention_layernorm.bias"),
+            "wq": wq[:, perm], "wk": wk[:, perm], "wv": wv,
+            "bq": bq[perm], "bk": bk[perm], "bv": bv,
+            "wo": sd.take(p + "attention.dense.weight").T,
+            "bo": sd.take(p + "attention.dense.bias"),
+            "w_in": sd.take(p + "mlp.dense_h_to_4h.weight").T,
+            "b_in": sd.take(p + "mlp.dense_h_to_4h.bias"),
+            "w_out": sd.take(p + "mlp.dense_4h_to_h.weight").T,
+            "b_out": sd.take(p + "mlp.dense_4h_to_h.bias"),
+        })
+    return {
+        "tok_embed": sd.take("embed_in.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("final_layer_norm.weight"),
+        "lnf_bias": sd.take("final_layer_norm.bias"),
+        "lm_head": sd.take("embed_out.weight").T,
+    }
+
+
+# ------------------------------------------------------------ family: falcon
+def _falcon_config(hf: dict) -> TransformerConfig:
+    new_arch = hf.get("new_decoder_architecture", False)
+    if not hf.get("parallel_attn", True):
+        raise ValueError("falcon with parallel_attn=False is not supported")
+    if hf.get("alibi", False):
+        raise ValueError(
+            "falcon with alibi=True (falcon-rw style): the converter maps "
+            "the falcon family to rotary positions; importing would silently "
+            "change attention. Unsupported.")
+    if new_arch:
+        n_kv = hf.get("num_kv_heads") or hf["num_attention_heads"]
+    else:
+        n_kv = 1 if hf.get("multi_query", True) else hf["num_attention_heads"]
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_head=n_kv,
+        d_model=hf["hidden_size"],
+        d_ff=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
+        max_seq=hf.get("max_position_embeddings", 2048),
+        pos_embedding="rope", rope_theta=hf.get("rope_theta", 10000.0),
+        norm="layernorm", activation="gelu_exact",
+        use_bias=True,
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        parallel_residual=True,
+        parallel_shared_ln=not new_arch,   # 7B: one ln; 40B: ln_attn+ln_mlp
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def _falcon_split_qkv(w, n_head, n_kv, head_dim):
+    """Falcon fused qkv → (wq, wk, wv) matmul weights.
+
+    multi_query (7B): rows = [h*hd q | hd k | hd v].
+    new_decoder_architecture (40B): rows grouped per kv head:
+    [group0: q*(h/kv)·hd, k·hd, v·hd | group1: ...]."""
+    d = w.shape[1]
+    if n_kv == n_head:   # grouped layout degenerates per-head
+        w = w.reshape(n_head, 3, head_dim, d)
+        return tuple(w[:, j].reshape(-1, d).T for j in range(3))
+    if n_kv == 1:
+        hh = n_head * head_dim
+        return (w[:hh].T, w[hh:hh + head_dim].T, w[hh + head_dim:].T)
+    q_per = n_head // n_kv
+    w = w.reshape(n_kv, q_per + 2, head_dim, d)
+    wq = w[:, :q_per].reshape(-1, d).T
+    wk = w[:, q_per].reshape(-1, d).T
+    wv = w[:, q_per + 1].reshape(-1, d).T
+    return wq, wk, wv
+
+
+def _falcon_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    d, h, kv, hd = cfg.d_model, cfg.n_head, cfg.kv_heads, cfg.head_dim
+    q_perm = _rope_interleave_perm(h, hd)
+    kv_perm = _rope_interleave_perm(kv, hd)
+
+    def bias_or_zeros(key, size):
+        got = sd.get(key)     # falcon-rw ships biases; mainline has none
+        return got if got is not None else np.zeros((size,), np.float32)
+
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        wq, wk, wv = _falcon_split_qkv(
+            sd.take(p + "self_attention.query_key_value.weight"), h, kv, hd)
+        qkv_b = sd.get(p + "self_attention.query_key_value.bias")
+        if qkv_b is not None:
+            bq, bk, bv = (b.reshape(-1) for b in _falcon_split_qkv(
+                qkv_b[:, None], h, kv, hd))
+            bq, bk, bv = bq[q_perm], bk[kv_perm], bv
+        else:
+            bq = np.zeros((h * hd,), np.float32)
+            bk = np.zeros((kv * hd,), np.float32)
+            bv = np.zeros((kv * hd,), np.float32)
+        lyr = {
+            "wq": wq[:, q_perm], "wk": wk[:, kv_perm], "wv": wv,
+            "bq": bq, "bk": bk, "bv": bv,
+            "bo": bias_or_zeros(p + "self_attention.dense.bias", d),
+            "b_in": bias_or_zeros(p + "mlp.dense_h_to_4h.bias", cfg.ffn_dim),
+            "b_out": bias_or_zeros(p + "mlp.dense_4h_to_h.bias", d),
+            "wo": sd.take(p + "self_attention.dense.weight").T,
+            "w_in": sd.take(p + "mlp.dense_h_to_4h.weight").T,
+            "w_out": sd.take(p + "mlp.dense_4h_to_h.weight").T,
+        }
+        if cfg.parallel_shared_ln:   # 7B: single input_layernorm
+            lyr["ln1_scale"] = sd.take(p + "input_layernorm.weight")
+            lyr["ln1_bias"] = sd.take(p + "input_layernorm.bias")
+        else:                        # 40B: ln_attn (attn) + ln_mlp (mlp)
+            lyr["ln1_scale"] = sd.take(p + "ln_attn.weight")
+            lyr["ln1_bias"] = sd.take(p + "ln_attn.bias")
+            lyr["ln2_scale"] = sd.take(p + "ln_mlp.weight")
+            lyr["ln2_bias"] = sd.take(p + "ln_mlp.bias")
+        per_layer.append(lyr)
+    params = {
+        "tok_embed": sd.take("word_embeddings.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("ln_f.weight"),
+        "lnf_bias": sd.take("ln_f.bias"),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd.take("lm_head.weight").T
+    return params
+
+
+# ------------------------------------------------------------- family: bloom
+def _bloom_config(hf: dict) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf.get("n_layer") or hf["num_hidden_layers"],
+        n_head=hf.get("n_head") or hf["num_attention_heads"],
+        d_model=hf.get("hidden_size") or hf["n_embed"],
+        d_ff=4 * (hf.get("hidden_size") or hf["n_embed"]),
+        max_seq=hf.get("seq_length", 2048),
+        pos_embedding="alibi", norm="layernorm", activation="gelu",
+        use_bias=True, tie_embeddings=True, embed_norm=True,
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def _bloom_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Bloom-HF: sequential residual, ALiBi, word-embedding layernorm,
+    fused per-head-interleaved qkv (same layout as NeoX)."""
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        wq, wk, wv = _split_fused_qkv_per_head(
+            sd.take(p + "self_attention.query_key_value.weight"), h, hd, d)
+        bq, bk, bv = (sd.take(p + "self_attention.query_key_value.bias")
+                      .reshape(h, 3, hd)[:, j].reshape(-1) for j in range(3))
+        per_layer.append({
+            "ln1_scale": sd.take(p + "input_layernorm.weight"),
+            "ln1_bias": sd.take(p + "input_layernorm.bias"),
+            "ln2_scale": sd.take(p + "post_attention_layernorm.weight"),
+            "ln2_bias": sd.take(p + "post_attention_layernorm.bias"),
+            "wq": wq, "wk": wk, "wv": wv,
+            "bq": bq, "bk": bk, "bv": bv,
+            "wo": sd.take(p + "self_attention.dense.weight").T,
+            "bo": sd.take(p + "self_attention.dense.bias"),
+            "w_in": sd.take(p + "mlp.dense_h_to_4h.weight").T,
+            "b_in": sd.take(p + "mlp.dense_h_to_4h.bias"),
+            "w_out": sd.take(p + "mlp.dense_4h_to_h.weight").T,
+            "b_out": sd.take(p + "mlp.dense_4h_to_h.bias"),
+        })
+    return {
+        "tok_embed": sd.take("word_embeddings.weight"),
+        "embed_ln_scale": sd.take("word_embeddings_layernorm.weight"),
+        "embed_ln_bias": sd.take("word_embeddings_layernorm.bias"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("ln_f.weight"),
+        "lnf_bias": sd.take("ln_f.bias"),
+    }
+
+
 _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
@@ -285,6 +563,10 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "mistral": (_llama_config, _llama_convert, ("model.",)),
     "mixtral": (_llama_config, _llama_convert, ("model.",)),
     "opt": (_opt_config, _opt_convert, ("model.decoder.", "decoder.")),
+    "gptj": (_gptj_config, _gptj_convert, ("transformer.",)),
+    "gpt_neox": (_neox_config, _neox_convert, ("gpt_neox.",)),
+    "falcon": (_falcon_config, _falcon_convert, ("transformer.",)),
+    "bloom": (_bloom_config, _bloom_convert, ("transformer.",)),
 }
 
 
@@ -296,6 +578,14 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "mixtral"
     if any("decoder.layers" in k and "fc1" in k for k in keys):
         return "opt"
+    if any("mlp.fc_in" in k for k in keys):
+        return "gptj"
+    if any("gpt_neox" in k or "embed_in" in k for k in keys):
+        return "gpt_neox"
+    if any("word_embeddings_layernorm" in k for k in keys):
+        return "bloom"
+    if any("self_attention.query_key_value" in k for k in keys):
+        return "falcon"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
     raise ValueError("cannot detect model family from checkpoint keys; "

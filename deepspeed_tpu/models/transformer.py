@@ -56,6 +56,16 @@ class TransformerConfig:
     causal: bool = True                   # False => encoder (BERT family)
     objective: str = "clm"                # "clm" next-token | "mlm" (BERT)
     rope_theta: float = 10000.0
+    rotary_dim: Optional[int] = None      # partial rotary (GPT-J/NeoX):
+                                          # rotate only the first N dims/head
+    # parallel residual: x + attn(norm1(x)) + mlp(norm_mlp(x)) in one hop
+    # (GPT-J / GPT-NeoX / Falcon) instead of the sequential two-hop block.
+    parallel_residual: bool = False
+    # GPT-J / Falcon-7B share ONE layernorm for both branches (norm_mlp =
+    # norm1); NeoX / Falcon-40B keep a second one.
+    parallel_shared_ln: bool = False
+    embed_norm: bool = False              # Bloom word_embeddings_layernorm
+    lm_head_bias: bool = False            # GPT-J lm_head has a bias
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16             # compute dtype
     # MoE (dense when num_experts == 1); see models/moe.py
@@ -129,20 +139,29 @@ def _norm(x, scale, bias, kind: str, eps: float = 1e-5):
     return y.astype(x.dtype)
 
 
-def _rope(q, k, positions, theta: float):
-    """Rotary embeddings on (B, S, H, hd) q/k."""
+def _rope(q, k, positions, theta: float, rotary_dim: int | None = None):
+    """Rotary embeddings on (B, S, H, hd) q/k (interleaved-pair basis).
+
+    ``rotary_dim`` < head_dim rotates only the leading dims of each head
+    (GPT-J's ``rotary_dim``, NeoX's ``rotary_pct``); the tail passes through.
+    Frequencies are computed over ``rotary_dim``, matching those models.
+    """
     hd = q.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, hd/2)
+    rd = rotary_dim or hd
+    freqs = 1.0 / (theta ** (jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, rd/2)
     cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
 
     def rot(x):
-        x1, x2 = x[..., ::2], x[..., 1::2]
+        xr, xp = x[..., :rd], x[..., rd:]
+        x1, x2 = xr[..., ::2], xr[..., 1::2]
         xr1 = x1 * cos - x2 * sin
         xr2 = x2 * cos + x1 * sin
-        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+        out = jnp.stack([xr1, xr2], axis=-1).reshape(xr.shape)
+        return jnp.concatenate([out, xp], axis=-1) if rd < hd else out
 
-    return rot(q.astype(jnp.float32)).astype(q.dtype), rot(k.astype(jnp.float32)).astype(k.dtype)
+    return (rot(q.astype(jnp.float32)).astype(q.dtype),
+            rot(k.astype(jnp.float32)).astype(k.dtype))
 
 
 def alibi_slopes(n_head: int) -> jnp.ndarray:
@@ -219,14 +238,16 @@ class TransformerLM:
             return (jax.random.normal(key, shape, jnp.float32) * scale)
 
         dense_ffn = cfg.num_experts == 1  # MoE trunks build expert banks instead
+        two_ln = not (cfg.parallel_residual and cfg.parallel_shared_ln)
         layers = {
             "ln1_scale": jnp.ones((L, d), jnp.float32),
             "wq": dense(next(k), (L, d, h * hd)),
             "wk": dense(next(k), (L, d, kv * hd)),
             "wv": dense(next(k), (L, d, kv * hd)),
             "wo": dense(next(k), (L, h * hd, d), scale=1.0 / math.sqrt(2 * L * d)),
-            "ln2_scale": jnp.ones((L, d), jnp.float32),
         }
+        if two_ln:
+            layers["ln2_scale"] = jnp.ones((L, d), jnp.float32)
         if dense_ffn:
             layers["w_in"] = dense(next(k), (L, d, f))
             layers["w_out"] = dense(next(k), (L, f, d), scale=1.0 / math.sqrt(2 * L * f))
@@ -235,12 +256,13 @@ class TransformerLM:
         if cfg.use_bias:
             layers.update({
                 "ln1_bias": jnp.zeros((L, d), jnp.float32),
-                "ln2_bias": jnp.zeros((L, d), jnp.float32),
                 "bq": jnp.zeros((L, h * hd), jnp.float32),
                 "bk": jnp.zeros((L, kv * hd), jnp.float32),
                 "bv": jnp.zeros((L, kv * hd), jnp.float32),
                 "bo": jnp.zeros((L, d), jnp.float32),
             })
+            if two_ln:
+                layers["ln2_bias"] = jnp.zeros((L, d), jnp.float32)
             if dense_ffn:
                 layers["b_in"] = jnp.zeros((L, f), jnp.float32)
                 layers["b_out"] = jnp.zeros((L, d), jnp.float32)
@@ -254,6 +276,12 @@ class TransformerLM:
                                                     jnp.float32) * 0.02
         if cfg.use_bias:
             params["lnf_bias"] = jnp.zeros((d,), jnp.float32)
+        if cfg.embed_norm:
+            params["embed_ln_scale"] = jnp.ones((d,), jnp.float32)
+            if cfg.use_bias:
+                params["embed_ln_bias"] = jnp.zeros((d,), jnp.float32)
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,), jnp.float32)
         if not cfg.tie_embeddings:
             params["lm_head"] = dense(next(k), (d, cfg.vocab_size), scale=0.02)
         return params
@@ -264,14 +292,16 @@ class TransformerLM:
         qkv/w_in column-split, wo/w_out row-split, embeddings vocab-split."""
         cfg = self.cfg
         dense_ffn = cfg.num_experts == 1
+        two_ln = not (cfg.parallel_residual and cfg.parallel_shared_ln)
         layers = {
             "ln1_scale": P(None, None),
             "wq": P(None, None, "model"),
             "wk": P(None, None, "model"),
             "wv": P(None, None, "model"),
             "wo": P(None, "model", None),
-            "ln2_scale": P(None, None),
         }
+        if two_ln:
+            layers["ln2_scale"] = P(None, None)
         if dense_ffn:
             layers["w_in"] = P(None, None, "model")
             layers["w_out"] = P(None, "model", None)
@@ -279,10 +309,12 @@ class TransformerLM:
                 layers["w_gate"] = P(None, None, "model")
         if cfg.use_bias:
             layers.update({
-                "ln1_bias": P(None, None), "ln2_bias": P(None, None),
+                "ln1_bias": P(None, None),
                 "bq": P(None, "model"), "bk": P(None, "model"), "bv": P(None, "model"),
                 "bo": P(None, None),
             })
+            if two_ln:
+                layers["ln2_bias"] = P(None, None)
             if dense_ffn:
                 layers["b_in"] = P(None, "model")
                 layers["b_out"] = P(None, None)
@@ -295,8 +327,14 @@ class TransformerLM:
             specs["pos_embed"] = P(None, None)
         if cfg.use_bias:
             specs["lnf_bias"] = P(None)
+        if cfg.embed_norm:
+            specs["embed_ln_scale"] = P(None)
+            if cfg.use_bias:
+                specs["embed_ln_bias"] = P(None)
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, "model")
+        if cfg.lm_head_bias:
+            specs["lm_head_bias"] = P("model")
         return specs
 
     def stacked_fn(self):
@@ -322,7 +360,7 @@ class TransformerLM:
         kk = self._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, S, kv, hd)
         vv = self._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, S, kv, hd)
         if cfg.pos_embedding == "rope":
-            q, kk = _rope(q, kk, positions, cfg.rope_theta)
+            q, kk = _rope(q, kk, positions, cfg.rope_theta, cfg.rotary_dim)
         attn_kw = {}
         if cfg.pos_embedding == "alibi":
             # ALiBi (Bloom): linear distance bias on the scores instead of
@@ -346,7 +384,7 @@ class TransformerLM:
             o = self.attention_fn(qs, ks, vs, mask=attn_mask, **attn_kw)
             o = constrain(o, P(B_AXES, "seq", "model", None))
         o = self._maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), p, "bo")
-        return x + o
+        return o
 
     def _mlp_block(self, y, p):
         """FFN half. Returns (out, aux_loss); MoE trunks override this."""
@@ -355,7 +393,9 @@ class TransformerLM:
         if cfg.is_glu:
             u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
         elif cfg.activation == "gelu":
-            u = jax.nn.gelu(u)
+            u = jax.nn.gelu(u)                      # tanh approx (gelu_new)
+        elif cfg.activation == "gelu_exact":
+            u = jax.nn.gelu(u, approximate=False)   # erf gelu (NeoX/Falcon)
         elif cfg.activation == "relu":
             u = jax.nn.relu(u)
         else:
@@ -367,10 +407,22 @@ class TransformerLM:
     def _layer(self, x, layer_params, positions, attn_mask):
         cfg = self.cfg
         p = layer_params
-        x = self._attention_block(x, p, positions, attn_mask)
-        y = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-        out, aux = self._mlp_block(y, p)
-        x = x + out
+        o = self._attention_block(x, p, positions, attn_mask)
+        if cfg.parallel_residual:
+            # x + attn(n1(x)) + mlp(n(x)) — GPT-J/NeoX/Falcon block shape;
+            # shared_ln reuses n1 (XLA CSEs the recompute with the one
+            # inside the attention branch).
+            ln = ("ln1" if cfg.parallel_shared_ln else "ln2")
+            y = _norm(x, p[f"{ln}_scale"], p.get(f"{ln}_bias"),
+                      cfg.norm, cfg.norm_eps)
+            out, aux = self._mlp_block(y, p)
+            x = x + o + out
+        else:
+            x = x + o
+            y = _norm(x, p["ln2_scale"], p.get("ln2_bias"),
+                      cfg.norm, cfg.norm_eps)
+            out, aux = self._mlp_block(y, p)
+            x = x + out
         return constrain(x, P(B_AXES, "seq", None)), aux
 
     def _tok_lookup(self, table, ids):
@@ -423,6 +475,10 @@ class TransformerLM:
         positions = self._positions(B, S)
         if cfg.pos_embedding == "learned":
             x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
+        if cfg.embed_norm:
+            # Bloom word_embeddings_layernorm
+            x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
+                      cfg.norm, cfg.norm_eps)
         return constrain(x, P(B_AXES, "seq", None)), positions
 
     def _scan_layers(self, x, layers, positions, attn_mask, remat_policy):
@@ -473,6 +529,8 @@ class TransformerLM:
             logits = x @ params["tok_embed"].astype(x.dtype).T
         else:
             logits = x @ params["lm_head"].astype(x.dtype)
+        if cfg.lm_head_bias:
+            logits = logits + params["lm_head_bias"].astype(logits.dtype)
         return constrain(logits, P(B_AXES, "seq", "model"))
 
     def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None,

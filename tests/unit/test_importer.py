@@ -119,3 +119,94 @@ def test_max_seq_override(tiny_gpt2, tmp_path):
     save_file(sd, str(ckpt / "model.safetensors"))
     cfg, _ = load_hf_checkpoint(str(ckpt), max_seq=32)
     assert cfg.max_seq == 32
+
+
+# ------------------------------------------------ round-3 families (4 new)
+@pytest.fixture(scope="module")
+def tiny_gptj():
+    torch.manual_seed(2)
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8)
+    return transformers.GPTJForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_neox():
+    torch.manual_seed(3)
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True)
+    return transformers.GPTNeoXForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_falcon():
+    torch.manual_seed(4)
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, parallel_attn=True, multi_query=True,
+        new_decoder_architecture=False, bias=False, alibi=False)
+    return transformers.FalconForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_bloom():
+    torch.manual_seed(5)
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+    return transformers.BloomForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+def _roundtrip(model, hf_cfg, seed, checks=None):
+    ids = np.random.default_rng(seed).integers(0, 128, (2, 16), dtype=np.int64)
+    cfg, params = import_state_dict(model.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    if checks:
+        assert checks(cfg), cfg
+    got = _native_logits(cfg, params, ids.astype(np.int32))
+    want = _hf_logits(model, ids)
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_gptj_logits_match(tiny_gptj):
+    """Parallel residual + shared LN + partial interleaved rotary + head bias."""
+    model, hf_cfg = tiny_gptj
+    _roundtrip(model, hf_cfg, 2,
+               lambda cfg: cfg.parallel_residual and cfg.parallel_shared_ln
+               and cfg.rotary_dim == 8 and cfg.lm_head_bias)
+
+
+def test_neox_logits_match(tiny_neox):
+    """Parallel residual + two LNs + fused qkv + rotate-half partial rotary."""
+    model, hf_cfg = tiny_neox
+    _roundtrip(model, hf_cfg, 3,
+               lambda cfg: cfg.parallel_residual
+               and not cfg.parallel_shared_ln and cfg.rotary_dim == 4)
+
+
+def test_falcon_logits_match(tiny_falcon):
+    """Parallel attn + MQA fused qkv + rotate-half rotary, no linear biases."""
+    model, hf_cfg = tiny_falcon
+    _roundtrip(model, hf_cfg, 4,
+               lambda cfg: cfg.parallel_residual and cfg.parallel_shared_ln
+               and cfg.kv_heads == 1)
+
+
+def test_bloom_logits_match(tiny_bloom):
+    """ALiBi + embedding layernorm + per-head fused qkv, sequential block."""
+    model, hf_cfg = tiny_bloom
+    _roundtrip(model, hf_cfg, 5,
+               lambda cfg: cfg.pos_embedding == "alibi" and cfg.embed_norm
+               and not cfg.parallel_residual)
+
+
+def test_new_family_autodetect(tiny_gptj, tiny_neox, tiny_falcon, tiny_bloom):
+    from deepspeed_tpu.models.importer import _detect_family
+
+    assert _detect_family(tiny_gptj[0].state_dict()) == "gptj"
+    assert _detect_family(tiny_neox[0].state_dict()) == "gpt_neox"
+    assert _detect_family(tiny_falcon[0].state_dict()) == "falcon"
+    assert _detect_family(tiny_bloom[0].state_dict()) == "bloom"
