@@ -1,4 +1,5 @@
-"""Autotuner: measured search over ZeRO stage × micro-batch × remat.
+"""Autotuner: measured search over mesh shape × ZeRO stage × offload ×
+micro-batch × remat (GAS follows: global = micro × gas × dp(mesh)).
 
 Analog of the reference autotuner (``autotuning/autotuner.py:404``), which
 profiles the model, generates a grid of experiments (ZeRO stage,
@@ -41,13 +42,17 @@ class Experiment:
     zero_stage: int
     micro_batch: int
     remat: bool
+    mesh: dict = field(default_factory=dict)
+    offload: Optional[str] = None
     samples_per_sec: float = 0.0
     ok: bool = False
     error: str = ""
 
     def label(self) -> str:
-        return (f"z{self.zero_stage}_mbs{self.micro_batch}"
-                f"{'_remat' if self.remat else ''}")
+        mesh = "x".join(f"{k}{v}" for k, v in sorted(self.mesh.items())) or "dp"
+        return (f"{mesh}_z{self.zero_stage}_mbs{self.micro_batch}"
+                f"{'_remat' if self.remat else ''}"
+                f"{'_off-' + self.offload if self.offload else ''}")
 
 
 class Autotuner:
@@ -62,6 +67,8 @@ class Autotuner:
                  stages: Sequence[int] = (3, 2, 1, 0),
                  micro_batches: Optional[Sequence[int]] = None,
                  remat_options: Sequence[bool] = (False,),
+                 mesh_options: "Optional[Sequence[dict]] | str" = None,
+                 offload_options: Sequence[Optional[str]] = (None,),
                  steps: int = 3, warmup: int = 1,
                  early_stop_margin: float = 0.05,
                  results_path: Optional[str] = None):
@@ -71,6 +78,11 @@ class Autotuner:
         self.stages = list(stages)
         self.micro_batches = list(micro_batches) if micro_batches else None
         self.remat_options = list(remat_options)
+        # mesh candidates: None = pure DP only; "auto" = factor the device
+        # count into model/seq splits (on TPU the mesh shape is THE knob —
+        # reference tunes only stage+mbs, autotuner.py:404)
+        self.mesh_options = mesh_options
+        self.offload_options = list(offload_options)
         self.steps = steps
         self.warmup = warmup
         self.early_stop_margin = early_stop_margin
@@ -78,6 +90,33 @@ class Autotuner:
         self.experiments: list[Experiment] = []
 
     # ------------------------------------------------------------------ grid
+    @staticmethod
+    def _auto_mesh_options(n_dev: int) -> list[dict]:
+        """Candidate (model, seq) splits of the device count; ``data``
+        absorbs the remainder. Bounded: at most ~6 candidates."""
+        out: list[dict] = [{}]
+        for m in (2, 4):
+            if n_dev % m == 0 and n_dev > m:
+                out.append({"model": m})
+        if n_dev % 2 == 0 and n_dev > 2:
+            out.append({"seq": 2})
+        if n_dev % 4 == 0 and n_dev > 4:
+            out.append({"model": 2, "seq": 2})
+        return out
+
+    def _mesh_candidates(self, n_dev: int) -> list[dict]:
+        if self.mesh_options is None:
+            return [{}]
+        if self.mesh_options == "auto":
+            return self._auto_mesh_options(n_dev)
+        return [dict(m) for m in self.mesh_options]
+
+    @staticmethod
+    def _dp_for_mesh(mesh: dict, n_dev: int) -> int:
+        non_dp = int(np.prod([v for k, v in mesh.items()
+                              if k not in ("data", "zero", "expert")])) or 1
+        return max(1, n_dev // non_dp)
+
     def _candidate_micro_batches(self, dp: int) -> list[int]:
         if self.micro_batches is not None:
             return self.micro_batches
@@ -93,6 +132,10 @@ class Autotuner:
         cfg = copy.deepcopy(self.base_config)
         zo = cfg.setdefault("zero_optimization", {})
         zo["stage"] = exp.zero_stage
+        if exp.mesh:
+            cfg["mesh"] = dict(exp.mesh)   # data axis auto-absorbs the rest
+        if exp.offload:
+            zo["offload_optimizer"] = {"device": exp.offload}
         cfg["train_micro_batch_size_per_gpu"] = exp.micro_batch
         global_bs = int(cfg.get("train_batch_size", dp * exp.micro_batch))
         cfg["gradient_accumulation_steps"] = max(
@@ -148,37 +191,42 @@ class Autotuner:
         ``results_path`` JSON."""
         from ..platform.accelerator import get_accelerator
 
-        dp = max(1, get_accelerator().device_count())
+        n_dev = max(1, get_accelerator().device_count())
         best: Optional[Experiment] = None
-        for stage in self.stages:
-            stage_best: Optional[Experiment] = None
-            for remat in self.remat_options:
-                # turnover baseline is per remat sweep: remat=True starts
-                # slower at small mbs and only wins at larger ones, so it
-                # must not be early-stopped against the non-remat best
-                sweep_best: Optional[Experiment] = None
-                for mbs in self._candidate_micro_batches(dp):
-                    exp = Experiment(stage, mbs, remat)
-                    log_dist(f"autotune: running {exp.label()}", ranks=[0])
-                    exp = self._run_one(exp, dp)
-                    self.experiments.append(exp)
-                    log_dist(f"autotune: {exp.label()} → "
-                             f"{exp.samples_per_sec:.1f} samples/s"
-                             f"{'' if exp.ok else ' (FAILED: ' + exp.error + ')'}",
-                             ranks=[0])
-                    if not exp.ok:
-                        break  # larger micro-batches will also OOM
-                    if sweep_best and exp.samples_per_sec < \
-                            sweep_best.samples_per_sec * (1 - self.early_stop_margin):
-                        break  # throughput turned over; stop growing mbs
-                    if not sweep_best or exp.samples_per_sec > sweep_best.samples_per_sec:
-                        sweep_best = exp
-                if sweep_best and (not stage_best or sweep_best.samples_per_sec
-                                   > stage_best.samples_per_sec):
-                    stage_best = sweep_best
-            if stage_best and (not best or stage_best.samples_per_sec >
-                               best.samples_per_sec):
-                best = stage_best
+        for mesh in self._mesh_candidates(n_dev):
+            dp = self._dp_for_mesh(mesh, n_dev)
+            for offload in self.offload_options:
+                for stage in self.stages:
+                    if offload and stage < 1:
+                        continue   # host optimizer needs a sharded master
+                    for remat in self.remat_options:
+                        # turnover baseline is per sweep: remat=True starts
+                        # slower at small mbs and only wins at larger ones, so
+                        # it must not be early-stopped against another sweep
+                        sweep_best: Optional[Experiment] = None
+                        for mbs in self._candidate_micro_batches(dp):
+                            exp = Experiment(stage, mbs, remat, mesh=mesh,
+                                             offload=offload)
+                            log_dist(f"autotune: running {exp.label()}",
+                                     ranks=[0])
+                            exp = self._run_one(exp, dp)
+                            self.experiments.append(exp)
+                            log_dist(
+                                f"autotune: {exp.label()} → "
+                                f"{exp.samples_per_sec:.1f} samples/s"
+                                f"{'' if exp.ok else ' (FAILED: ' + exp.error + ')'}",
+                                ranks=[0])
+                            if not exp.ok:
+                                break  # larger micro-batches will also OOM
+                            if sweep_best and exp.samples_per_sec < \
+                                    sweep_best.samples_per_sec * (1 - self.early_stop_margin):
+                                break  # throughput turned over
+                            if not sweep_best or exp.samples_per_sec > \
+                                    sweep_best.samples_per_sec:
+                                sweep_best = exp
+                        if sweep_best and (not best or sweep_best.samples_per_sec
+                                           > best.samples_per_sec):
+                            best = sweep_best
         if self.results_path and jax.process_index() == 0:
             with open(self.results_path, "w") as f:
                 json.dump([e.__dict__ for e in self.experiments], f, indent=2)
@@ -188,8 +236,8 @@ class Autotuner:
             return copy.deepcopy(self.base_config)
         log_dist(f"autotune: best = {best.label()} "
                  f"({best.samples_per_sec:.1f} samples/s)", ranks=[0])
-        dp = max(1, get_accelerator().device_count())
-        return self._experiment_config(best, dp)
+        return self._experiment_config(
+            best, self._dp_for_mesh(best.mesh, n_dev))
 
 
 def autotune(base_config: dict, model_builder, make_batch, **kw) -> dict:

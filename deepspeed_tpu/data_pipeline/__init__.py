@@ -1,7 +1,9 @@
 from .curriculum import CurriculumScheduler
 from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+from .metric_index import MetricIndex, build_metric_index
 from .random_ltd import convert_to_random_ltd
 from .sampler import CurriculumSampler
 
 __all__ = ["CurriculumScheduler", "CurriculumSampler", "MMapIndexedDataset",
-           "MMapIndexedDatasetBuilder", "convert_to_random_ltd"]
+           "MMapIndexedDatasetBuilder", "MetricIndex", "build_metric_index",
+           "convert_to_random_ltd"]

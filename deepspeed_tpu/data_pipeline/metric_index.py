@@ -1,0 +1,100 @@
+"""Precomputed difficulty-metric cluster index (data-analyzer analog).
+
+Reference: the data-efficiency library's analyzer precomputes per-sample
+metric files and clusters samples by metric value into index files; its
+``DeepSpeedDataSampler`` (``data_efficiency/.../data_sampler.py:36``) then
+draws from the eligible clusters at each step. This module is the same
+two-phase design: ``build_metric_index`` is the offline analyzer (map a
+metric over the dataset once, bucket, persist as ``.npy`` files), and
+:class:`MetricIndex` is the cluster structure the curriculum sampler reads —
+startup cost is loading two small arrays, not re-scoring the corpus.
+
+Files per index directory:
+    metric_values.npy     (N,)  per-sample metric value
+    bucket_bounds.npy     (B,)  right edge of each bucket (sorted)
+    sorted_indices.npy    (N,)  sample ids sorted by metric (stable)
+    bucket_offsets.npy    (B+1,) bucket b owns sorted_indices[off[b]:off[b+1]]
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+_FILES = ("metric_values", "bucket_bounds", "sorted_indices", "bucket_offsets")
+
+
+class MetricIndex:
+    """Samples clustered by difficulty-metric value."""
+
+    def __init__(self, values: np.ndarray, bounds: np.ndarray,
+                 sorted_indices: np.ndarray, offsets: np.ndarray):
+        self.values = values
+        self.bounds = bounds
+        self.sorted_indices = sorted_indices
+        self.offsets = offsets
+        self._sorted_values = values[sorted_indices]
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.bounds)
+
+    def eligible(self, difficulty) -> np.ndarray:
+        """All sample ids whose metric ≤ difficulty, as one contiguous
+        (pre-sorted) view — exact threshold, not bucket-granular (buckets
+        exist for per-cluster bookkeeping/draws). Never empty: the easiest
+        sample always qualifies."""
+        end = int(np.searchsorted(self._sorted_values, difficulty,
+                                  side="right"))
+        return self.sorted_indices[:max(end, 1)]
+
+    def bucket_of(self, sample_id: int) -> int:
+        return int(np.searchsorted(self.bounds, self.values[sample_id],
+                                   side="left"))
+
+    # -------------------------------------------------------------- persist
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        for name, arr in zip(_FILES, (self.values, self.bounds,
+                                      self.sorted_indices, self.offsets)):
+            np.save(os.path.join(path, f"{name}.npy"), arr)
+
+    @classmethod
+    def load(cls, path: str) -> "MetricIndex":
+        return cls(*(np.load(os.path.join(path, f"{n}.npy"))
+                     for n in _FILES))
+
+
+def build_metric_index(dataset=None, *, metric: Optional[Callable] = None,
+                       values: "Optional[Sequence[float]]" = None,
+                       n_buckets: int = 64,
+                       path: Optional[str] = None) -> MetricIndex:
+    """The analyzer pass: score every sample once, cluster by value.
+
+    ``values`` short-circuits scoring (e.g. ``MMapIndexedDataset.lengths``).
+    Buckets are quantile-based over the distinct values so skewed metric
+    distributions still spread across clusters; ``path`` persists the index.
+    """
+    if values is None:
+        if dataset is None:
+            raise ValueError("need a dataset or precomputed values")
+        metric = metric or (lambda s: len(s["input_ids"]))
+        values = [metric(dataset[i]) for i in range(len(dataset))]
+    values = np.asarray(values)
+    order = np.argsort(values, kind="stable")
+    svals = values[order]
+    uniq = np.unique(svals)
+    if len(uniq) <= n_buckets:
+        bounds = uniq
+    else:
+        qs = np.quantile(uniq, np.linspace(0, 1, n_buckets + 1)[1:])
+        bounds = np.unique(qs)
+    # bucket b = metrics in (bounds[b-1], bounds[b]]
+    offsets = np.concatenate([
+        [0], np.searchsorted(svals, bounds, side="right")]).astype(np.int64)
+    idx = MetricIndex(values, bounds, order.astype(np.int64), offsets)
+    if path:
+        idx.save(path)
+    return idx

@@ -33,16 +33,31 @@ class CurriculumSampler:
     def __init__(self, dataset, scheduler: CurriculumScheduler, *,
                  metric: Callable | None = None,
                  metrics: Sequence[float] | np.ndarray | None = None,
+                 metric_index=None,
                  seed: int = 0, batch_size: int = 1,
                  shard_by_process: bool = True):
         self.dataset = dataset
         self.scheduler = scheduler
+        self.metric_index = metric_index   # precomputed cluster files
         self.seed = seed
         self.batch_size = batch_size
         self.epoch = 0
         self.global_step = 0
         self.rank = jax.process_index() if shard_by_process else 0
         self.world = jax.process_count() if shard_by_process else 1
+        if metric_index is not None:
+            # precomputed difficulty-metric cluster index (reference
+            # data_sampler.py:36 reads the analyzer's index files); the
+            # sampler never touches the dataset to score it, and reuses the
+            # index's sorted view rather than re-deriving it
+            if len(metric_index.values) != len(dataset):
+                raise ValueError(
+                    f"metric index covers {len(metric_index.values)} samples "
+                    f"but dataset has {len(dataset)}")
+            self._metrics = metric_index.values
+            self._order = metric_index.sorted_indices
+            self._sorted_metrics = metric_index._sorted_values
+            return
         if metrics is not None:
             # precomputed per-sample metrics (O(1) startup — pass
             # MMapIndexedDataset.lengths for a seqlen curriculum)

@@ -1,0 +1,128 @@
+"""Multinode runner command builders: SLURM / OpenMPI / MPICH / Intel MPI.
+
+Analog of the reference's ``launcher/multinode_runner.py:18-366`` (PDSH,
+OpenMPI, MPICH, IMPI, SLURM, MVAPICH command builders). ssh/pdsh live in
+``runner.py``; these cover scheduler-managed sites (GKE/SLURM clusters
+fronting TPU pods, CPU fleets). Each builder returns ONE argv that starts
+the per-node launcher (``launcher.launch``) on every allocated node — the
+runner does not need MPI for communication (JAX's coordination service does
+rendezvous); MPI/SLURM is only the process *starter*.
+
+Because scheduler starters run the SAME command on every node (node identity
+comes from the starter's env: SLURM_NODEID / OMPI_COMM_WORLD_RANK /
+PMI_RANK), they require a homogeneous allocation — per-host slot counts
+must match and per-host slot *filters* can't be expressed. Both are
+validated loudly; heterogeneous or slot-filtered jobs belong on the
+ssh/pdsh path. Environment exports are inlined into the remote command
+(``export K=V;`` with shell quoting) — srun's ``--export K=V`` list splits
+on commas and silently truncates values like LIBTPU_INIT_ARGS.
+"""
+
+from __future__ import annotations
+
+import shlex
+from collections import OrderedDict
+
+# Placeholders substituted per starter; they are the ONLY unquoted shell
+# expansions in the remote command.
+_NODE_RANK = "__DSTPU_NODE_RANK__"
+_PROC_BASE = "__DSTPU_PROC_BASE__"
+
+
+def check_homogeneous(resources: "OrderedDict[str, list[int]]",
+                      launcher: str) -> int:
+    """Scheduler starters can't express per-host differences; fail loudly
+    (the silent alternative is a hung rendezvous). Returns the per-node
+    slot count."""
+    counts = {h: len(s) for h, s in resources.items()}
+    if len(set(counts.values())) > 1:
+        raise SystemExit(
+            f"dstpu: --launcher {launcher} runs one identical command per "
+            f"node and needs homogeneous slot counts, got {counts}; use "
+            "--launcher ssh/pdsh for heterogeneous hosts")
+    per_node = next(iter(counts.values()))
+    for host, slots in resources.items():
+        if slots != list(range(per_node)):
+            raise SystemExit(
+                f"dstpu: --launcher {launcher} cannot forward per-host slot "
+                f"filters (host {host} selected {slots}); use ssh/pdsh")
+    return per_node
+
+
+def _remote_command(args, launch_argv_fn, nnodes: int, nproc: int,
+                    exports: "OrderedDict[str, str]",
+                    coordinator: str) -> str:
+    """The bash -c payload: inlined exports + the shared launch argv (from
+    ``runner._launch_cmd`` via ``launch_argv_fn`` — one construction site,
+    no drift) with rank placeholders left as shell expansions."""
+    argv = launch_argv_fn(args, _NODE_RANK, nnodes, nproc,
+                          nnodes * nproc, _PROC_BASE, coordinator)
+    quoted = []
+    for part in argv:
+        if part in (_NODE_RANK, _PROC_BASE):
+            quoted.append(part)   # substituted below, must stay expandable
+        else:
+            quoted.append(shlex.quote(part))
+    cmd = " ".join(quoted)
+    export_str = "".join(f"export {k}={shlex.quote(v)}; "
+                         for k, v in exports.items())
+    return export_str + cmd
+
+
+def _finish(cmd: str, node_rank_var: str, nproc: int) -> str:
+    return (cmd.replace(_NODE_RANK, f'"${node_rank_var}"')
+               .replace(_PROC_BASE, f'"$(({node_rank_var} * {nproc}))"'))
+
+
+def slurm_command(args, resources, coordinator, exports,
+                  launch_argv_fn) -> list[str]:
+    """``srun`` line (reference ``SlurmRunner.get_cmd``,
+    ``multinode_runner.py:283``): one task per node, rank from SLURM_NODEID."""
+    nproc = check_homogeneous(resources, "slurm")
+    nnodes = len(resources)
+    inner = _finish(_remote_command(args, launch_argv_fn, nnodes, nproc,
+                                    exports, coordinator),
+                    "SLURM_NODEID", nproc)
+    cmd = ["srun", "--nodes", str(nnodes), "--ntasks", str(nnodes),
+           "--ntasks-per-node", "1"]
+    if getattr(args, "slurm_partition", None):
+        cmd += ["--partition", args.slurm_partition]
+    cmd += ["bash", "-c", inner]
+    return cmd
+
+
+def openmpi_command(args, resources, coordinator, exports,
+                    launch_argv_fn) -> list[str]:
+    """``mpirun`` line (reference ``OpenMPIRunner.get_cmd``,
+    ``multinode_runner.py:108``): one rank per node, rank from
+    OMPI_COMM_WORLD_RANK."""
+    nproc = check_homogeneous(resources, "openmpi")
+    nnodes = len(resources)
+    hosts = ",".join(f"{h}:1" for h in resources)
+    inner = _finish(_remote_command(args, launch_argv_fn, nnodes, nproc,
+                                    exports, coordinator),
+                    "OMPI_COMM_WORLD_RANK", nproc)
+    return ["mpirun", "-n", str(nnodes), "--host", hosts,
+            "--allow-run-as-root", "--tag-output", "bash", "-c", inner]
+
+
+def mpich_command(args, resources, coordinator, exports,
+                  launch_argv_fn) -> list[str]:
+    """``mpiexec`` line (reference ``MPICHRunner`` / ``IMPIRunner``,
+    ``multinode_runner.py:159,197``): rank from PMI_RANK."""
+    nproc = check_homogeneous(resources, "mpich")
+    nnodes = len(resources)
+    hosts = ",".join(resources)
+    inner = _finish(_remote_command(args, launch_argv_fn, nnodes, nproc,
+                                    exports, coordinator),
+                    "PMI_RANK", nproc)
+    return ["mpiexec", "-n", str(nnodes), "-hosts", hosts, "-ppn", "1",
+            "bash", "-c", inner]
+
+
+BUILDERS = {
+    "slurm": slurm_command,
+    "openmpi": openmpi_command,
+    "mpich": mpich_command,
+    "impi": mpich_command,   # Intel MPI shares the mpiexec/PMI contract
+}

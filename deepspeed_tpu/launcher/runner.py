@@ -50,7 +50,10 @@ def parse_args(argv=None):
                    help="coordinator host (default: first host / 127.0.0.1)")
     p.add_argument("--master_port", type=int, default=12321)
     p.add_argument("--ssh_port", type=int, default=22)
-    p.add_argument("--launcher", choices=("ssh", "pdsh"), default="ssh")
+    p.add_argument("--launcher",
+                   choices=("ssh", "pdsh", "slurm", "openmpi", "mpich", "impi"),
+                   default="ssh")
+    p.add_argument("--slurm_partition", default=None)
     p.add_argument("--env_file", default=_ENV_FILE,
                    help="extra KEY=VALUE lines to export on every node")
     p.add_argument("--log_dir", default=None)
@@ -159,6 +162,20 @@ def main(argv=None) -> None:
             + (["--module"] if args.module else [])
             + [args.script] + args.script_args)
         sys.exit(launch_mod.launch_local(largs))
+
+    if args.launcher in ("slurm", "openmpi", "mpich", "impi"):
+        # Scheduler-managed starters run ONE command that fans out to every
+        # node (reference SlurmRunner/OpenMPIRunner/MPICHRunner); node rank
+        # comes from the starter's env, so there is no per-host Popen table.
+        # --nproc overrides slot counts exactly as on the ssh path.
+        from .multinode import BUILDERS
+
+        if args.nproc > 0:
+            resources = OrderedDict(
+                (h, list(range(args.nproc))) for h in resources)
+        cmd = BUILDERS[args.launcher](args, resources, coordinator,
+                                      gather_env(args.env_file), _launch_cmd)
+        sys.exit(subprocess.call(cmd))
 
     cmds = build_remote_commands(args, resources, coordinator)
     procs = {h: subprocess.Popen(c) for h, c in cmds.items()}

@@ -1,0 +1,122 @@
+"""aio tier microbenchmark: sweep n_threads × block size × O_DIRECT.
+
+Analog of the reference's ``csrc/aio/py_test/`` suite (``ds_aio_basic.py`` /
+``aio_bench_perf_sweep.py``), which exists to tune the NVMe swap tier's
+queue-depth/block-size before committing a ZeRO-Infinity config. Reports
+MB/s per (threads, block, direct) cell for sequential write and read of a
+test file, plus the winning cell — feed those numbers into
+``zero_optimization.offload_optimizer.buffer_count`` / aio settings.
+
+CLI: ``dstpu_aio_bench [--path DIR] [--size-mb N] [--threads 1,2,4,8]
+[--blocks 256k,1m,4m] [--no-direct] [--json OUT]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+from .aio import AsyncIOHandle
+
+
+def _parse_size(s: str) -> int:
+    s = s.strip().lower()
+    mult = 1
+    if s.endswith("k"):
+        mult, s = 1 << 10, s[:-1]
+    elif s.endswith("m"):
+        mult, s = 1 << 20, s[:-1]
+    return int(s) * mult
+
+
+def bench_cell(path: str, size: int, n_threads: int, block: int,
+               direct: bool, chunks: int = 8) -> dict:
+    """One (threads, block, direct) cell: write then read ``size`` bytes
+    split into ``chunks`` parallel tickets; MB/s from wall time."""
+    h = AsyncIOHandle(n_threads=n_threads, block_size=block, use_direct=direct)
+    per = size // chunks
+    bufs = [np.random.default_rng(i).integers(
+        0, 255, per, dtype=np.uint8).view(np.uint8) for i in range(chunks)]
+    files = [os.path.join(path, f"aio_bench_{i}.bin") for i in range(chunks)]
+    try:
+        t0 = time.perf_counter()
+        tickets = [h.submit_write(f, b) for f, b in zip(files, bufs)]
+        for t in tickets:
+            h.wait(t)
+        w_dt = time.perf_counter() - t0
+
+        outs = [np.zeros(per, np.uint8) for _ in range(chunks)]
+        t0 = time.perf_counter()
+        tickets = [h.submit_read(f, o) for f, o in zip(files, outs)]
+        for t in tickets:
+            h.wait(t)
+        r_dt = time.perf_counter() - t0
+        ok = all(np.array_equal(o, b) for o, b in zip(outs, bufs))
+    finally:
+        h.close()
+        for f in files:
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+    mb = size / (1 << 20)
+    return {"threads": n_threads, "block": block, "direct": direct,
+            "write_mb_s": round(mb / w_dt, 1), "read_mb_s": round(mb / r_dt, 1),
+            "verified": ok}
+
+
+def run_sweep(path: str, size: int, threads, blocks, direct_opts) -> list[dict]:
+    os.makedirs(path, exist_ok=True)
+    cells = []
+    for direct in direct_opts:
+        for n in threads:
+            for b in blocks:
+                cell = bench_cell(path, size, n, b, direct)
+                cells.append(cell)
+                print(f"threads={n:<3} block={b >> 10:>5}K "
+                      f"direct={int(direct)}  "
+                      f"write={cell['write_mb_s']:>8.1f} MB/s  "
+                      f"read={cell['read_mb_s']:>8.1f} MB/s"
+                      f"{'' if cell['verified'] else '  VERIFY-FAILED'}",
+                      flush=True)
+    return cells
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="dstpu_aio_bench",
+        description="aio tier sweep (reference csrc/aio/py_test analog)")
+    p.add_argument("--path", default="/tmp/dstpu_aio_bench")
+    p.add_argument("--size-mb", type=int, default=64)
+    p.add_argument("--threads", default="1,2,4,8")
+    p.add_argument("--blocks", default="256k,1m,4m")
+    p.add_argument("--no-direct", action="store_true",
+                   help="skip the O_DIRECT cells (fs may not support it)")
+    p.add_argument("--json", default=None, help="write results JSON here")
+    args = p.parse_args(argv)
+
+    threads = [int(t) for t in args.threads.split(",")]
+    blocks = [_parse_size(b) for b in args.blocks.split(",")]
+    direct_opts = [False] if args.no_direct else [False, True]
+    cells = run_sweep(args.path, args.size_mb << 20, threads, blocks,
+                      direct_opts)
+    best_r = max(cells, key=lambda c: c["read_mb_s"])
+    best_w = max(cells, key=lambda c: c["write_mb_s"])
+    print(f"best read : threads={best_r['threads']} "
+          f"block={best_r['block'] >> 10}K direct={int(best_r['direct'])} "
+          f"({best_r['read_mb_s']} MB/s)")
+    print(f"best write: threads={best_w['threads']} "
+          f"block={best_w['block'] >> 10}K direct={int(best_w['direct'])} "
+          f"({best_w['write_mb_s']} MB/s)")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"cells": cells, "best_read": best_r,
+                       "best_write": best_w}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -226,3 +226,115 @@ def test_spatial_ops():
     assert gn.shape == x.shape
     flat = np.asarray(gn).reshape(2, -1, 2, 4).transpose(0, 2, 1, 3).reshape(2, 2, -1)
     np.testing.assert_allclose(flat.mean(-1), 0.0, atol=1e-5)
+
+
+# ------------------------------------------------- aio microbench (round 3)
+def test_aio_bench_sweep(tmp_path):
+    """Reference csrc/aio/py_test analog: the sweep must produce verified
+    MB/s cells for every (threads, block, direct) combination."""
+    from deepspeed_tpu.ops.aio_bench import run_sweep
+
+    cells = run_sweep(str(tmp_path), 4 << 20, threads=[1, 2],
+                      blocks=[256 << 10], direct_opts=[False])
+    assert len(cells) == 2
+    for c in cells:
+        assert c["verified"] and c["read_mb_s"] > 0 and c["write_mb_s"] > 0
+
+
+# --------------------------------------- multinode runner builders (round 3)
+def test_multinode_command_builders():
+    """SLURM/OpenMPI/MPICH lines (reference multinode_runner.py:108-366):
+    correct starter, per-node fan-out flags, env export, node-rank source."""
+    from collections import OrderedDict
+    from types import SimpleNamespace
+
+    import pytest as _pytest
+
+    from deepspeed_tpu.launcher.multinode import (mpich_command,
+                                                  openmpi_command,
+                                                  slurm_command)
+    from deepspeed_tpu.launcher.runner import _launch_cmd
+
+    args = SimpleNamespace(script="train.py", script_args=["--x", "1"],
+                           log_dir=None, module=False, slurm_partition=None)
+    hosts = OrderedDict([("node1", [0, 1, 2, 3]), ("node2", [0, 1, 2, 3])])
+    # comma-bearing value must survive (srun --export would split on it)
+    env = OrderedDict([("LIBTPU_INIT_ARGS", "--xla_a=1,--xla_b=2")])
+
+    s = slurm_command(args, hosts, "node1:1234", env, _launch_cmd)
+    assert s[0] == "srun" and "--ntasks-per-node" in s
+    inner = s[-1]
+    assert "SLURM_NODEID" in inner
+    assert "export LIBTPU_INIT_ARGS=--xla_a=1,--xla_b=2;" in inner
+
+    o = openmpi_command(args, hosts, "node1:1234", env, _launch_cmd)
+    assert o[0] == "mpirun" and "--host" in o
+    assert "OMPI_COMM_WORLD_RANK" in o[-1]
+
+    m = mpich_command(args, hosts, "node1:1234", env, _launch_cmd)
+    assert m[0] == "mpiexec" and "-ppn" in m
+    assert "PMI_RANK" in m[-1]
+
+    # user args containing $ stay literal (shlex-quoted), placeholders don't
+    args2 = SimpleNamespace(script="train.py", script_args=["--out", "run$v"],
+                            log_dir=None, module=False, slurm_partition=None)
+    s2 = slurm_command(args2, hosts, "node1:1234", env, _launch_cmd)
+    assert "'run$v'" in s2[-1]
+
+    # heterogeneous or slot-filtered allocations fail loudly
+    with _pytest.raises(SystemExit):
+        slurm_command(args, OrderedDict([("a", [0, 1]), ("b", [0])]),
+                      "a:1", env, _launch_cmd)
+    with _pytest.raises(SystemExit):
+        slurm_command(args, OrderedDict([("a", [1, 2]), ("b", [1, 2])]),
+                      "a:1", env, _launch_cmd)
+
+
+# ------------------------------------- curriculum metric clusters (round 3)
+def test_metric_index_build_save_load(tmp_path):
+    from deepspeed_tpu.data_pipeline import MetricIndex, build_metric_index
+
+    values = np.array([5, 1, 9, 3, 7, 1, 9, 2], dtype=np.int64)
+    idx = build_metric_index(values=values, n_buckets=4,
+                             path=str(tmp_path / "idx"))
+    # eligible = exactly the samples with metric <= difficulty
+    for difficulty in (0, 1, 3, 6, 9):
+        got = sorted(idx.eligible(difficulty).tolist())
+        want = sorted(np.nonzero(values <= difficulty)[0].tolist()) or [
+            int(np.argmin(values))]
+        assert got == want, (difficulty, got, want)
+    # round-trips through the .npy files
+    idx2 = MetricIndex.load(str(tmp_path / "idx"))
+    np.testing.assert_array_equal(idx2.sorted_indices, idx.sorted_indices)
+    np.testing.assert_array_equal(idx2.bounds, idx.bounds)
+
+
+def test_curriculum_sampler_from_metric_index(tmp_path):
+    """The sampler draws from precomputed cluster files without scoring the
+    dataset (reference data_sampler.py:36 semantics)."""
+    from deepspeed_tpu.data_pipeline import (CurriculumScheduler,
+                                             CurriculumSampler,
+                                             build_metric_index)
+
+    lengths = np.array([4, 8, 16, 32, 4, 8, 16, 32])
+    idx = build_metric_index(values=lengths, path=str(tmp_path / "idx"))
+
+    class NoScore:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            raise AssertionError("sampler must not score the dataset")
+
+    sched = CurriculumScheduler(min_difficulty=4, max_difficulty=32,
+                                schedule_type="fixed_linear",
+                                total_curriculum_step=4, difficulty_step=4)
+    sampler = CurriculumSampler(NoScore(), sched, metric_index=idx,
+                                batch_size=4, shard_by_process=False)
+    it = iter(sampler)
+    picks, difficulty = next(it)
+    assert difficulty < 32
+    assert all(lengths[i] <= difficulty for i in picks), (picks, difficulty)
+    for _ in range(5):
+        picks, difficulty = next(it)
+    assert difficulty == 32
