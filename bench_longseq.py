@@ -1,0 +1,108 @@
+"""Long-sequence benchmark: GPT-2 training MFU at 4k–8k tokens with the
+Pallas flash-attention kernel (BASELINE config 4's single-chip leg).
+
+Anchor: the reference's long-context headline is DeepSpeed-Ulysses at
+175 TFLOPS/GPU sustained = 54% of an A100's younger peak
+(``blogs/deepspeed-ulysses/README.md:78-83``). vs_baseline = achieved
+MFU / 0.54 — ≥1.0 means this framework sustains a higher fraction of its
+chip at long sequence than the reference's flagship long-context number.
+(The multi-chip Ulysses/ring sequence-parallel path is exercised by the
+dryrun and test_sequence.py; single-tunnel hardware measures the per-chip
+kernel side.)
+
+Writes ``LONGSEQ_BENCH.json``. Tunnel armor via bench_common.
+"""
+
+import json
+import math
+import os
+import time
+
+import bench_common as bc
+
+_CHILD_MARK = "_DSTPU_LONGSEQ_CHILD"
+_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 15 * 60))
+_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "LONGSEQ_BENCH.json")
+
+
+def _run_workload():
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, gpt2
+    from deepspeed_tpu.ops.flash_attention import make_flash_attention
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+    from deepspeed_tpu.utils.timer import peak_flops_for
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        seq, micro, n_steps, size = int(os.environ.get(
+            "DSTPU_LONGSEQ", 4096)), 2, 5, "125m"
+        attn = make_flash_attention(block=512)
+    else:
+        seq, micro, n_steps, size = 512, 1, 2, "125m"
+        attn = make_flash_attention(block=128, interpret=True)
+
+    cfg = {
+        "train_batch_size": micro * len(devices),
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "remat": {"enabled": True, "policy": "dots_saveable"},
+        "steps_per_print": 10 ** 9,
+    }
+    model_cfg = gpt2(size, max_seq=seq)
+    engine = ds.initialize(cfg, build_model(model_cfg, attention_fn=attn))
+    data = random_token_dataset(engine.train_batch_size, seq_len=seq,
+                                vocab_size=model_cfg.vocab_size)
+    batch = DataLoader(data, local_batch_size=engine.train_batch_size,
+                       shuffle=False).collate_fn(data)
+
+    # host readback is the barrier (bench.py's round-2 lesson)
+    assert math.isfinite(float(engine.train_batch(batch)["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        m = engine.train_batch(batch)
+    final = float(m["loss"])
+    dt = (time.perf_counter() - t0) / n_steps
+    assert math.isfinite(final)
+
+    tokens_per_sec = engine.train_batch_size * seq / dt
+    flops_per_token = model_cfg.flops_per_token()   # fwd+bwd incl. attention
+    mfu = tokens_per_sec * flops_per_token / (
+        peak_flops_for(devices[0]) * len(devices))
+    result = {
+        "metric": f"gpt2_flash_seq{seq}_mfu",
+        "value": round(mfu, 4),
+        "unit": (f"MFU (tokens/s={tokens_per_sec:.0f}, seq={seq}, "
+                 f"step={dt * 1000:.1f}ms, platform={devices[0].platform}"
+                 + ("" if on_tpu else ", CPU-FALLBACK") + ")"),
+        "vs_baseline": round(mfu / 0.54, 4),   # Ulysses 54%-of-peak anchor
+    }
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    if os.environ.get(_CHILD_MARK) == "1":
+        _run_workload()
+        return
+    env = dict(os.environ)
+    env[_CHILD_MARK] = "1"
+    me = os.path.abspath(__file__)
+    result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
+                                    child_timeout=1500, tag="longseq-bench")
+    if result is None:
+        bc.log("TPU unavailable; falling back to virtual CPU", "longseq-bench")
+        result = bc.run_child(me, bc.cpu_fallback_env(env, n_devices=1),
+                              timeout=1200, tag="longseq-bench")
+    if result is None:
+        raise SystemExit("longseq bench failed on TPU and CPU fallback")
+    with open(_OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -575,7 +575,10 @@ class Engine:
     def _flops_per_sample(self) -> float:
         cfg = getattr(self.model, "cfg", None)
         if cfg is not None and hasattr(cfg, "flops_per_token"):
-            return cfg.flops_per_token() * getattr(cfg, "max_seq", 1) * 3  # fwd+bwd
+            # flops_per_token() is ALREADY fwd+bwd (6N + attention term);
+            # multiplying by 3 here triple-counted and inflated reported
+            # TFLOPS/MFU 3x (round-3 audit)
+            return cfg.flops_per_token() * getattr(cfg, "max_seq", 1)
         return 0.0
 
     def _batch_sharding(self, gas_dim: bool = True):
