@@ -18,26 +18,34 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT_S = 120
+PROBE_TIMEOUT_S = 240   # a draining tunnel can take minutes to grant
 
 
 def log(msg: str, tag: str = "bench") -> None:
     print(f"[{tag}] {msg}", file=sys.stderr, flush=True)
 
 
-def probe_backend(timeout: float = PROBE_TIMEOUT_S, tag: str = "bench") -> bool:
-    """Can a fresh interpreter claim the ambient backend right now?"""
+def probe_backend(timeout: float = PROBE_TIMEOUT_S, tag: str = "bench"):
+    """Can a fresh interpreter claim the ambient backend right now?
+
+    Returns True / "timeout" / "failed". The distinction matters: killing a
+    timed-out probe mid-claim RE-WEDGES the tunnel (orphaned grant), so the
+    caller must back off long after a timeout rather than immediately
+    stacking another claim attempt (round-3 postmortem: a 30s-backoff
+    probe loop kept the tunnel wedged for hours by SIGKILLing its own
+    probes every 2.5 minutes)."""
     code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
     try:
         p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                            capture_output=True, text=True)
     except subprocess.TimeoutExpired:
-        log(f"backend probe timed out after {timeout}s (tunnel wedged?)", tag)
-        return False
+        log(f"backend probe timed out after {timeout}s (tunnel wedged; the "
+            "kill re-wedges it — backing off long)", tag)
+        return "timeout"
     if p.returncode != 0:
         tail = (p.stderr or "").strip().splitlines()[-1:]
         log(f"backend probe failed rc={p.returncode}: {tail}", tag)
-        return False
+        return "failed"
     log(f"backend probe ok: {p.stdout.strip()}", tag)
     return True
 
@@ -86,22 +94,29 @@ def run_with_tpu_window(script_path: str, child_env: dict, *,
     warn_strays(tag)
     deadline = time.monotonic() + window_s
     attempt = 0
+    backoff = 0.0
     while time.monotonic() < deadline:
         if attempt:
-            backoff = min(30 * attempt, 300)
             remaining = deadline - time.monotonic()
             if remaining < backoff + probe_timeout:
                 log(f"window exhausted ({remaining:.0f}s left)", tag)
                 break
-            log(f"retrying in {backoff}s (attempt {attempt + 1}, "
+            log(f"retrying in {backoff:.0f}s (attempt {attempt + 1}, "
                 f"{remaining / 60:.1f} min left in window)", tag)
             time.sleep(backoff)
         attempt += 1
-        if not probe_backend(probe_timeout, tag):
-            continue
-        result = run_child(script_path, child_env, child_timeout, tag)
-        if result is not None:
-            return result
+        status = probe_backend(probe_timeout, tag)
+        if status is True:
+            result = run_child(script_path, child_env, child_timeout, tag)
+            if result is not None:
+                return result
+            backoff = 120.0   # child failed after a good claim: brief pause
+        elif status == "timeout":
+            # our kill just re-wedged the grant: stay quiet long enough for
+            # the server-side grant timeout to clear before touching it again
+            backoff = 600.0
+        else:
+            backoff = 60.0    # fast failure (chip busy): cheap to re-ask
     return None
 
 

@@ -78,9 +78,15 @@ class Engine:
     """Owns mesh, sharded state, and the compiled train/eval steps."""
 
     def __init__(self, config: Config | dict | str | None, model,
-                 mesh: Optional[Mesh] = None, seed: Optional[int] = None):
+                 mesh: Optional[Mesh] = None, seed: Optional[int] = None,
+                 params=None):
         self.config = Config.from_any(config)
         self.model = model
+        # pretrained initial weights (HF import, numpy/jax trees): become
+        # the fp32 master instead of model.init(rng) — the zero.Init-style
+        # born-sharded construction still applies (passed as a jit argument,
+        # resharded by out_shardings, never baked in as constants)
+        self._initial_params = params
         de = self.config.data_efficiency
         self.curriculum = None
         if de.curriculum_learning.enabled:
@@ -320,8 +326,15 @@ class Engine:
             comm_err=comm_err_shardings,
         )
         with self.mesh:
-            init_fn = jax.jit(self._init_state, out_shardings=self.state_shardings)
-            self.state: TrainState = init_fn(rng)
+            if self._initial_params is not None:
+                init_fn = jax.jit(self._init_state_from,
+                                  out_shardings=self.state_shardings)
+                self.state: TrainState = init_fn(self._initial_params)
+                self._initial_params = None   # free the host copy
+            else:
+                init_fn = jax.jit(self._init_state,
+                                  out_shardings=self.state_shardings)
+                self.state = init_fn(rng)
 
         # opt_state moments for optimizers that don't use nu/mu are empty (0,)
         # arrays; fix their shardings to replicated to avoid spec-rank mismatch.
@@ -479,10 +492,15 @@ class Engine:
                          "(host-backed) device memory; streaming is inert",
                          ranks=[0])
 
-        with self.mesh:
-            init_params = jax.jit(self._init_master)(rng)
-        host_master = jax.tree.map(np.asarray, init_params)
-        del init_params
+        if self._initial_params is not None:
+            host_master = jax.tree.map(
+                lambda a: np.asarray(a, np.float32), self._initial_params)
+            self._initial_params = None
+        else:
+            with self.mesh:
+                init_params = jax.jit(self._init_master)(rng)
+            host_master = jax.tree.map(np.asarray, init_params)
+            del init_params
         fp32_names = tuple(getattr(self.model, "fp32_param_names", lambda: ())())
         self.host_opt = HostOffloadOptimizer(
             host_master, self.optimizer, zoff,
@@ -520,6 +538,14 @@ class Engine:
     def _init_master(self, rng):
         return jax.tree.map(lambda a: a.astype(jnp.float32),
                             self.model.init(rng))
+
+    def fp32_params(self):
+        """Full (host) fp32 master tree — the zero_to_fp32 /
+        consolidated-state-dict analog, e.g. for export_hf_checkpoint."""
+        if self.offload:
+            return self.host_opt.master_tree()
+        return jax.tree.map(lambda a: np.asarray(a, np.float32),
+                            self.state.master_params)
 
     def _grad_step_impl(self, compute_params, batch):
         """Forward+backward only — the update happens on the host. Gradient
@@ -590,6 +616,13 @@ class Engine:
 
     def _init_state(self, rng) -> TrainState:
         master = jax.tree.map(lambda a: a.astype(jnp.float32), self.model.init(rng))
+        return self._state_around(master)
+
+    def _init_state_from(self, params) -> TrainState:
+        master = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+        return self._state_around(master)
+
+    def _state_around(self, master) -> TrainState:
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             master_params=master,

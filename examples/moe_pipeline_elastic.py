@@ -1,0 +1,41 @@
+"""The composed deep end: MoE trunk + 1F1B pipeline + elastic schema.
+
+Run under the elastic agent so worker loss relaunches at a new world size
+and resumes from the checkpoint:
+
+    dstpu_elastic --nproc 1 --max_train_batch_size 32 \
+        --micro_batch_sizes 1,2,4 examples/moe_pipeline_elastic.py
+"""
+
+import pathlib
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import tiny_test
+from deepspeed_tpu.models.pipeline import build_pipeline_model
+from deepspeed_tpu.runtime.dataloader import (DataLoader, RepeatingLoader,
+                                              random_token_dataset)
+
+CKPT = "ckpts/moe_pipe"
+
+cfg = tiny_test(n_layer=4, num_experts=2, max_seq=64)
+model = build_pipeline_model(cfg, n_stages=2, num_micro=4, schedule="1f1b")
+engine = ds.initialize({
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 1},
+    "elasticity": {"enabled": True, "max_train_batch_size": 32,
+                   "micro_batch_sizes": [1, 2, 4], "max_devices": 64},
+    "mesh": {"pipe": 2},
+}, model)
+if (pathlib.Path(CKPT) / "latest").exists():
+    engine.load_checkpoint(CKPT)
+
+data = random_token_dataset(32, seq_len=64, vocab_size=cfg.vocab_size,
+                            learnable=True)
+loader = DataLoader(data, local_batch_size=engine.train_batch_size)
+it = iter(RepeatingLoader(loader))
+loss = float("nan")
+while engine.global_steps < 8:
+    loss = engine.train_batch(dict(next(it)))["loss"]
+    engine.save_checkpoint(CKPT)
+print(f"done at step {engine.global_steps}" +
+      ("" if loss != loss else f", loss {loss:.4f}"))

@@ -1,0 +1,45 @@
+"""Pretrain GPT-2 from scratch: ZeRO + mixed precision + checkpoints.
+
+Run (single host):  python examples/pretrain_gpt2.py
+Multi-host:         dstpu -H hostfile examples/pretrain_gpt2.py
+Smallest smoke:     DSTPU_EXAMPLE_SMOKE=1 python examples/pretrain_gpt2.py
+"""
+
+import os
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, gpt2, tiny_test
+from deepspeed_tpu.runtime.dataloader import (DataLoader, RepeatingLoader,
+                                              random_token_dataset)
+
+SMOKE = os.environ.get("DSTPU_EXAMPLE_SMOKE") == "1"
+
+config = {
+    "train_batch_size": 8 if SMOKE else 256,
+    "optimizer": {"type": "adamw",
+                  "params": {"lr": 3e-4, "weight_decay": 0.01}},
+    "scheduler": {"type": "WarmupDecayLR",
+                  "params": {"warmup_num_steps": 10 if SMOKE else 2000,
+                             "total_num_steps": 20 if SMOKE else 100000}},
+    "gradient_clipping": 1.0,
+    "zero_optimization": {"stage": 1},
+    "remat": {"enabled": True, "policy": "dots_saveable"},
+    "steps_per_print": 5,
+}
+
+model_cfg = tiny_test(max_seq=64) if SMOKE else gpt2("125m", max_seq=1024)
+engine = ds.initialize(config, build_model(model_cfg))
+
+# Real training would iterate an MMapIndexedDataset; random tokens here.
+data = random_token_dataset(4 * engine.train_batch_size,
+                            seq_len=model_cfg.max_seq,
+                            vocab_size=model_cfg.vocab_size, learnable=True)
+loader = DataLoader(data, local_batch_size=engine.train_batch_size)
+
+steps = 6 if SMOKE else 1000
+it = iter(RepeatingLoader(loader))
+for step in range(steps):
+    metrics = engine.train_batch(dict(next(it)))
+    if (step + 1) % 3 == 0:
+        engine.save_checkpoint("ckpts/gpt2_pretrain")
+print(f"final loss {metrics['loss']:.4f} after {steps} steps")
