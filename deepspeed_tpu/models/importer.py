@@ -168,7 +168,7 @@ def _llama_config(hf: dict) -> TransformerConfig:
             "checkpoint uses rope_scaling (extended-context RoPE remap); the "
             "native trunk applies plain rope_theta positions — importing "
             "would silently change long-range attention. Unsupported.")
-    if hf.get("sliding_window"):
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
         log_dist("importer: checkpoint declares sliding_window="
                  f"{hf['sliding_window']} — the native trunk runs full causal "
                  "attention, so outputs diverge from HF beyond the window")
@@ -556,6 +556,105 @@ def _bloom_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     }
 
 
+
+# ------------------------------------------------------------- family: qwen2
+def _qwen2_config(hf: dict) -> TransformerConfig:
+    cfg = _llama_config(hf)
+    # Qwen2 = llama trunk + attention-projection biases (q/k/v only; the
+    # remaining bias slots import as zeros)
+    import dataclasses as _dc
+
+    return _dc.replace(cfg, use_bias=True)
+
+
+def _qwen2_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Llama layout + q/k/v biases (RoPE basis permutation applies to the
+    bias vectors exactly as to the projection columns)."""
+    params = _llama_convert(sd, cfg)
+    hd = cfg.head_dim
+    q_perm = _rope_interleave_perm(cfg.n_head, hd)
+    kv_perm = _rope_interleave_perm(cfg.kv_heads, hd)
+    d, f = cfg.d_model, cfg.ffn_dim
+    L = cfg.n_layer
+    zeros = {
+        "ln1_bias": np.zeros((L, d), np.float32),
+        "ln2_bias": np.zeros((L, d), np.float32),
+        "bo": np.zeros((L, d), np.float32),
+        "b_in": np.zeros((L, f), np.float32),
+        "b_out": np.zeros((L, d), np.float32),
+    }
+    bq = np.stack([sd.take(f"layers.{i}.self_attn.q_proj.bias")[q_perm]
+                   for i in range(L)])
+    bk = np.stack([sd.take(f"layers.{i}.self_attn.k_proj.bias")[kv_perm]
+                   for i in range(L)])
+    bv = np.stack([sd.take(f"layers.{i}.self_attn.v_proj.bias")
+                   for i in range(L)])
+    params["layers"].update({"bq": bq, "bk": bk, "bv": bv, **zeros})
+    params["lnf_bias"] = np.zeros((d,), np.float32)
+    return params
+
+
+# --------------------------------------------------------------- family: phi
+def _phi_config(hf: dict) -> TransformerConfig:
+    if hf.get("qk_layernorm"):
+        raise ValueError(
+            "phi with qk_layernorm=True: the trunk has no per-head Q/K "
+            "normalization — importing would silently change attention. "
+            "Unsupported.")
+    hd = hf["hidden_size"] // hf["num_attention_heads"]
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_head=hf.get("num_key_value_heads") or hf["num_attention_heads"],
+        d_model=hf["hidden_size"],
+        d_ff=hf["intermediate_size"],
+        max_seq=hf.get("max_position_embeddings", 2048),
+        pos_embedding="rope",
+        rotary_dim=int(hd * hf.get("partial_rotary_factor", 0.5)),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        norm="layernorm", activation="gelu",   # gelu_new = tanh approx
+        use_bias=True, tie_embeddings=False, lm_head_bias=True,
+        parallel_residual=True, parallel_shared_ln=True,
+        norm_eps=hf.get("layer_norm_eps", 1e-5),
+    )
+
+
+def _phi_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Phi: parallel residual with ONE layernorm, separate biased q/k/v,
+    partial rotate-half rotary → permuted rotary columns + bias entries."""
+    hd = cfg.head_dim
+    q_perm = _rope_interleave_perm(cfg.n_head, hd, cfg.rotary_dim)
+    kv_perm = _rope_interleave_perm(cfg.kv_heads, hd, cfg.rotary_dim)
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"layers.{i}."
+        per_layer.append({
+            "ln1_scale": sd.take(h + "input_layernorm.weight"),
+            "ln1_bias": sd.take(h + "input_layernorm.bias"),
+            "wq": sd.take(h + "self_attn.q_proj.weight").T[:, q_perm],
+            "bq": sd.take(h + "self_attn.q_proj.bias")[q_perm],
+            "wk": sd.take(h + "self_attn.k_proj.weight").T[:, kv_perm],
+            "bk": sd.take(h + "self_attn.k_proj.bias")[kv_perm],
+            "wv": sd.take(h + "self_attn.v_proj.weight").T,
+            "bv": sd.take(h + "self_attn.v_proj.bias"),
+            "wo": sd.take(h + "self_attn.dense.weight").T,
+            "bo": sd.take(h + "self_attn.dense.bias"),
+            "w_in": sd.take(h + "mlp.fc1.weight").T,
+            "b_in": sd.take(h + "mlp.fc1.bias"),
+            "w_out": sd.take(h + "mlp.fc2.weight").T,
+            "b_out": sd.take(h + "mlp.fc2.bias"),
+        })
+    return {
+        "tok_embed": sd.take("embed_tokens.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("final_layernorm.weight"),
+        "lnf_bias": sd.take("final_layernorm.bias"),
+        "lm_head": sd.take("lm_head.weight").T,
+        "lm_head_bias": sd.take("lm_head.bias"),
+    }
+
+
 _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
@@ -567,6 +666,8 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "gpt_neox": (_neox_config, _neox_convert, ("gpt_neox.",)),
     "falcon": (_falcon_config, _falcon_convert, ("transformer.",)),
     "bloom": (_bloom_config, _bloom_convert, ("transformer.",)),
+    "qwen2": (_qwen2_config, _qwen2_convert, ("model.",)),
+    "phi": (_phi_config, _phi_convert, ("model.",)),
 }
 
 
@@ -580,6 +681,12 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "opt"
     if any("mlp.fc_in" in k for k in keys):
         return "gptj"
+    if any("self_attn.dense" in k for k in keys) and \
+            any("mlp.fc1" in k for k in keys):
+        return "phi"
+    if any("self_attn.q_proj.bias" in k for k in keys) and \
+            any("mlp.gate_proj" in k for k in keys):
+        return "qwen2"
     if any("gpt_neox" in k or "embed_in" in k for k in keys):
         return "gpt_neox"
     if any("word_embeddings_layernorm" in k for k in keys):

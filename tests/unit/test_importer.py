@@ -210,3 +210,46 @@ def test_new_family_autodetect(tiny_gptj, tiny_neox, tiny_falcon, tiny_bloom):
     assert _detect_family(tiny_neox[0].state_dict()) == "gpt_neox"
     assert _detect_family(tiny_falcon[0].state_dict()) == "falcon"
     assert _detect_family(tiny_bloom[0].state_dict()) == "bloom"
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2():
+    torch.manual_seed(6)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=144,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    return transformers.Qwen2ForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_phi():
+    torch.manual_seed(7)
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5)
+    return transformers.PhiForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+def test_qwen2_logits_match(tiny_qwen2):
+    """Llama trunk + q/k/v biases (permuted with the RoPE basis)."""
+    model, hf_cfg = tiny_qwen2
+    _roundtrip(model, hf_cfg, 6,
+               lambda cfg: cfg.use_bias and cfg.norm == "rmsnorm"
+               and cfg.is_glu)
+
+
+def test_phi_logits_match(tiny_phi):
+    """Parallel residual + shared LN + biased projections + half rotary."""
+    model, hf_cfg = tiny_phi
+    _roundtrip(model, hf_cfg, 7,
+               lambda cfg: cfg.parallel_residual and cfg.parallel_shared_ln
+               and cfg.rotary_dim == 8 and cfg.lm_head_bias)
+
+
+def test_qwen2_phi_autodetect(tiny_qwen2, tiny_phi):
+    from deepspeed_tpu.models.importer import _detect_family
+
+    assert _detect_family(tiny_qwen2[0].state_dict()) == "qwen2"
+    assert _detect_family(tiny_phi[0].state_dict()) == "phi"
