@@ -38,11 +38,17 @@ def build_child_env(base: dict, *, coordinator: str, num_processes: int,
         "DSTPU_NODE_RANK": str(node_rank),
     })
     if slots is not None:
-        # Selected device slots (hostfile :slot filters): the child's
-        # platform layer / user script pins to DSTPU_SLOT_ID (e.g. via
-        # TPU_VISIBLE_CHIPS) — local rank alone would ignore filters.
+        # Selected device slots (hostfile :slot filters). launch_local
+        # enforces len(slots) == nproc, so each child owns exactly ONE
+        # selected chip: pin it via libtpu's env BEFORE the interpreter
+        # starts — the TPU analog of the reference exporting
+        # CUDA_VISIBLE_DEVICES per rank (launcher/launch.py:221). Explicit
+        # user pinning in the parent env wins.
         env["DSTPU_VISIBLE_SLOTS"] = ",".join(str(s) for s in slots)
         env["DSTPU_SLOT_ID"] = str(slots[local_rank])
+        if not base.get("TPU_VISIBLE_CHIPS") and not base.get("TPU_VISIBLE_DEVICES"):
+            env["TPU_VISIBLE_CHIPS"] = str(slots[local_rank])
+            env.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")
     return env
 
 

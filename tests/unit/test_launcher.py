@@ -224,3 +224,27 @@ def test_failed_rank_kills_group(tmp_path):
          "--nproc", "2", "--master_port", str(_free_port()), str(script)],
         env=env, capture_output=True, text=True, timeout=60)
     assert p.returncode != 0
+
+
+def test_visible_slots_pin_tpu_chips():
+    """Hostfile slot filters must reach libtpu IN THE CHILD ENV (the
+    CUDA_VISIBLE_DEVICES analog, set before the interpreter starts): each
+    child pins its own slot; explicit user pinning wins."""
+    from deepspeed_tpu.launcher.launch import build_child_env
+
+    base = {"PATH": "/usr/bin"}
+    env0 = build_child_env(base, coordinator="h:1", num_processes=2,
+                           process_id=0, local_rank=0, node_rank=0,
+                           slots=[0, 2])
+    env1 = build_child_env(base, coordinator="h:1", num_processes=2,
+                           process_id=1, local_rank=1, node_rank=0,
+                           slots=[0, 2])
+    assert env0["TPU_VISIBLE_CHIPS"] == "0" and env1["TPU_VISIBLE_CHIPS"] == "2"
+    assert env0["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert env0["DSTPU_SLOT_ID"] == "0" and env1["DSTPU_SLOT_ID"] == "2"
+
+    # explicit user pinning wins over the hostfile filter
+    pinned = build_child_env({"TPU_VISIBLE_CHIPS": "3"}, coordinator="h:1",
+                             num_processes=1, process_id=0, local_rank=0,
+                             node_rank=0, slots=[1])
+    assert pinned["TPU_VISIBLE_CHIPS"] == "3"
