@@ -152,13 +152,16 @@ def _gpt2_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
             "w_out": sd.take(h + "mlp.c_proj.weight"),
             "b_out": sd.take(h + "mlp.c_proj.bias"),
         })
-    return {
+    params = {
         "tok_embed": sd.take("wte.weight"),
         "pos_embed": sd.take("wpe.weight"),
         "layers": _stack(per_layer),
         "lnf_scale": sd.take("ln_f.weight"),
         "lnf_bias": sd.take("ln_f.bias"),
     }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd.take("lm_head.weight").T
+    return params
 
 
 # ------------------------------------------------------ family: llama-like
@@ -655,6 +658,101 @@ def _phi_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     }
 
 
+
+# ----------------------------------------------------------- family: codegen
+def _codegen_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """CodeGen = GPT-J block with a TPU-blocked fused qkv: the projection is
+    stored as mp_num=4 blocks, each [q | v | k] over n_head/4 heads
+    (HF ``CodeGenAttention._split_heads``). Rotary is natively interleaved
+    (no basis permutation), like GPT-J."""
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    mp = 4
+    local = h * hd // mp
+    zeros_h = np.zeros((h * hd,), np.float32)
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        w = sd.take(p + "attn.qkv_proj.weight").reshape(mp, 3 * local, d)
+        wq = w[:, :local].reshape(h * hd, d).T
+        wv = w[:, local:2 * local].reshape(h * hd, d).T
+        wk = w[:, 2 * local:].reshape(h * hd, d).T
+        per_layer.append({
+            "ln1_scale": sd.take(p + "ln_1.weight"),
+            "ln1_bias": sd.take(p + "ln_1.bias"),
+            "wq": wq, "wk": wk, "wv": wv,
+            "bq": zeros_h, "bk": zeros_h, "bv": zeros_h,
+            "wo": sd.take(p + "attn.out_proj.weight").T,
+            "bo": np.zeros((d,), np.float32),
+            "w_in": sd.take(p + "mlp.fc_in.weight").T,
+            "b_in": sd.take(p + "mlp.fc_in.bias"),
+            "w_out": sd.take(p + "mlp.fc_out.weight").T,
+            "b_out": sd.take(p + "mlp.fc_out.bias"),
+        })
+    return {
+        "tok_embed": sd.take("wte.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("ln_f.weight"),
+        "lnf_bias": sd.take("ln_f.bias"),
+        "lm_head": sd.take("lm_head.weight").T,
+        "lm_head_bias": sd.take("lm_head.bias"),
+    }
+
+
+# ------------------------------------------------------ family: gpt_bigcode
+def _bigcode_config(hf: dict) -> TransformerConfig:
+    if not hf.get("multi_query", True):
+        raise ValueError("gpt_bigcode with multi_query=False is untested; "
+                         "refusing a silent mis-split of the fused qkv")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["n_layer"],
+        n_head=hf["n_head"],
+        n_kv_head=1,
+        d_model=hf["n_embd"],
+        d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+        max_seq=hf.get("n_positions", 8192),
+        pos_embedding="learned", norm="layernorm", activation="gelu",
+        use_bias=True,
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def _bigcode_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """GPT-BigCode (StarCoder): GPT-2 block shape but torch Linear (out, in)
+    layout and MQA — fused c_attn rows are [d q | hd k | hd v]."""
+    d, hd = cfg.d_model, cfg.head_dim
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        w = sd.take(p + "attn.c_attn.weight")           # (d + 2hd, d)
+        b = sd.take(p + "attn.c_attn.bias")
+        per_layer.append({
+            "ln1_scale": sd.take(p + "ln_1.weight"),
+            "ln1_bias": sd.take(p + "ln_1.bias"),
+            "wq": w[:d].T, "wk": w[d:d + hd].T, "wv": w[d + hd:].T,
+            "bq": b[:d], "bk": b[d:d + hd], "bv": b[d + hd:],
+            "wo": sd.take(p + "attn.c_proj.weight").T,
+            "bo": sd.take(p + "attn.c_proj.bias"),
+            "ln2_scale": sd.take(p + "ln_2.weight"),
+            "ln2_bias": sd.take(p + "ln_2.bias"),
+            "w_in": sd.take(p + "mlp.c_fc.weight").T,
+            "b_in": sd.take(p + "mlp.c_fc.bias"),
+            "w_out": sd.take(p + "mlp.c_proj.weight").T,
+            "b_out": sd.take(p + "mlp.c_proj.bias"),
+        })
+    params = {
+        "tok_embed": sd.take("wte.weight"),
+        "pos_embed": sd.take("wpe.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("ln_f.weight"),
+        "lnf_bias": sd.take("ln_f.bias"),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd.take("lm_head.weight").T
+    return params
+
+
 _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
@@ -668,19 +766,29 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "bloom": (_bloom_config, _bloom_convert, ("transformer.",)),
     "qwen2": (_qwen2_config, _qwen2_convert, ("model.",)),
     "phi": (_phi_config, _phi_convert, ("model.",)),
+    # CodeGen is a GPT-J block family: same config mapping, own qkv split
+    "codegen": (_gptj_config, _codegen_convert, ("transformer.",)),
+    "gpt_bigcode": (_bigcode_config, _bigcode_convert, ("transformer.",)),
 }
 
 
 def _detect_family(state_dict: Dict[str, Any]) -> str:
     keys = state_dict.keys()
-    if any("attn.c_attn" in k for k in keys):
-        return "gpt2"
+    for k in keys:
+        if "attn.c_attn.weight" in k:
+            # gpt2 and gpt_bigcode share every key NAME; only the fused-qkv
+            # shape tells them apart (Conv1D (d, 3d) vs Linear (d+2hd, d))
+            shape = tuple(state_dict[k].shape)
+            return "gpt2" if shape[1] == 3 * shape[0] else "gpt_bigcode"
     if any("block_sparse_moe" in k for k in keys):
         return "mixtral"
     if any("decoder.layers" in k and "fc1" in k for k in keys):
         return "opt"
+    if any("attn.qkv_proj" in k for k in keys):
+        return "codegen"
     if any("mlp.fc_in" in k for k in keys):
         return "gptj"
+
     if any("self_attn.dense" in k for k in keys) and \
             any("mlp.fc1" in k for k in keys):
         return "phi"

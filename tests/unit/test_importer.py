@@ -253,3 +253,46 @@ def test_qwen2_phi_autodetect(tiny_qwen2, tiny_phi):
 
     assert _detect_family(tiny_qwen2[0].state_dict()) == "qwen2"
     assert _detect_family(tiny_phi[0].state_dict()) == "phi"
+
+
+@pytest.fixture(scope="module")
+def tiny_codegen():
+    torch.manual_seed(8)
+    hf_cfg = transformers.CodeGenConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8)
+    return transformers.CodeGenForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_bigcode():
+    torch.manual_seed(9)
+    hf_cfg = transformers.GPTBigCodeConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        multi_query=True)
+    return transformers.GPTBigCodeForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+def test_codegen_logits_match(tiny_codegen):
+    """GPT-J block + mp_num=4-blocked fused qkv in [q|v|k] order."""
+    model, hf_cfg = tiny_codegen
+    _roundtrip(model, hf_cfg, 8,
+               lambda cfg: cfg.parallel_residual and cfg.parallel_shared_ln
+               and cfg.rotary_dim == 8)
+
+
+def test_bigcode_logits_match(tiny_bigcode):
+    """StarCoder: GPT-2 shape, Linear layout, MQA fused qkv."""
+    model, hf_cfg = tiny_bigcode
+    _roundtrip(model, hf_cfg, 9,
+               lambda cfg: cfg.kv_heads == 1 and cfg.tie_embeddings
+               and cfg.pos_embedding == "learned")
+
+
+def test_codegen_bigcode_gpt2_autodetect(tiny_codegen, tiny_bigcode,
+                                         tiny_gpt2):
+    from deepspeed_tpu.models.importer import _detect_family
+
+    assert _detect_family(tiny_codegen[0].state_dict()) == "codegen"
+    assert _detect_family(tiny_bigcode[0].state_dict()) == "gpt_bigcode"
+    assert _detect_family(tiny_gpt2[0].state_dict()) == "gpt2"
