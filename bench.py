@@ -31,7 +31,7 @@ import time
 import bench_common as bc
 
 _CHILD_MARK = "_DSTPU_BENCH_CHILD"
-_CHILD_TIMEOUT_S = 1200
+_CHILD_TIMEOUT_S = 1800   # up to 3 candidate compiles over the tunnel
 _TPU_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 40 * 60))
 _CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_TPU_CACHE.json")
@@ -66,10 +66,10 @@ def _run_workload():
 
     if on_tpu:
         # Candidate (size, micro) pairs, best-first: larger d_model keeps
-        # the MXU fuller (125M's 768-wide matmuls cap out well below peak);
-        # fall through on OOM/divergence. seq=512 + remat from the round-2
-        # sweep.
-        candidates = [("350m", 8), ("125m", 16)]
+        # the MXU fuller (125M's 768-wide matmuls cap out well below peak)
+        # and larger micro amortizes per-step overhead; fall through on
+        # OOM/divergence. seq=512 + remat from the round-2 sweep.
+        candidates = [("350m", 16), ("350m", 8), ("125m", 16)]
         seq, n_steps = 512, 10
     else:
         # CPU fallback: tiny shapes so a 1-core box finishes in minutes.
@@ -82,10 +82,17 @@ def _run_workload():
             _measure(size, micro, seq, n_steps, devices, on_tpu)
             return
         except Exception as e:       # RESOURCE_EXHAUSTED, divergence, ...
-            last_err = e
-            print(f"[bench-child] {size} failed ({type(e).__name__}: "
-                  f"{str(e)[:200]}); trying next size", file=sys.stderr,
-                  flush=True)
+            # keep only the message: the live traceback would pin the OOMed
+            # engine's device buffers and cascade-OOM the smaller fallbacks
+            last_err = RuntimeError(f"{type(e).__name__}: {str(e)[:300]}")
+            print(f"[bench-child] {size}/mbs{micro} failed ({last_err}); "
+                  "trying next candidate", file=sys.stderr, flush=True)
+            import gc
+
+            import jax as _jax
+
+            gc.collect()
+            _jax.clear_caches()
     raise last_err
 
 
