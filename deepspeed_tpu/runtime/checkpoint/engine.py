@@ -45,6 +45,28 @@ def _checkpointer(engine=None):
     return ck, True
 
 
+def _model_config_dict(model):
+    """JSON-safe dump of the model's TransformerConfig (None if absent)."""
+    import dataclasses
+
+    cfg = getattr(model, "cfg", None)
+    if cfg is None or not dataclasses.is_dataclass(cfg):
+        return None
+    out = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if f.name == "dtype":
+            v = getattr(v, "__name__", str(v))
+        try:
+            json.dumps(v)
+        except (TypeError, ValueError):
+            # custom-dataclass fields (callables, enums, ...) must never
+            # break save_checkpoint itself; drop them from the meta dump
+            continue
+        out[f.name] = v
+    return out
+
+
 def _validate_tag(engine, tag: str) -> None:
     """Cross-process tag consistency (reference ``engine.py:2965``
     ``checkpoint_tag_validation``). Uses an allgather so EVERY rank sees the
@@ -107,6 +129,11 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
             "config": engine.config.to_dict(),
             "param_count": engine.param_count,
             "mesh": dict(engine.mesh.shape),
+            # model architecture, when the model exposes a TransformerConfig:
+            # lets the standalone dstpu_to_fp32 converter rebuild the HF
+            # export without the engine (reference utils/zero_to_fp32.py,
+            # which ships INSIDE every checkpoint for the same reason)
+            "model_config": _model_config_dict(engine.model),
             # state layout on disk: "host" = offload engine's numpy trees,
             # "device" = TrainState. load_checkpoint converts across layouts
             # so offload <-> device restores work in both directions.

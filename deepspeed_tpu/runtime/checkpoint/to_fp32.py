@@ -1,0 +1,141 @@
+"""Standalone checkpoint → fp32 converter (``dstpu_to_fp32`` CLI).
+
+Analog of the reference's ``utils/zero_to_fp32.py`` (587 LoC, shipped inside
+every checkpoint dir) which stitches per-rank ZeRO shard files back into one
+fp32 state dict. Here the store is already one logical sharded checkpoint,
+so "conversion" is a plain restore — no engine, no mesh, no live model — and
+the output is either raw fp32 ``.safetensors`` (native param tree) or a full
+HF checkpoint when the architecture maps to an exporter family.
+
+    dstpu_to_fp32 /ckpts/run latest out/fp32 --format hf
+
+Reads ``meta.json``'s ``model_config`` (written at save time) to rebuild the
+:class:`TransformerConfig`; both on-disk layouts keep the master under the
+same top-level key, so one restore path serves host and device checkpoints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+
+def _load_master(ckpt_path: Path):
+    """(master_params fp32 numpy tree, meta dict) from a tag directory.
+
+    Both on-disk layouts (host numpy trees / device TrainState) keep the
+    master under the top-level ``master_params`` key; only that subtree is
+    restored — moments are master-sized, so a full restore would read ~3x
+    the necessary bytes."""
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    meta_file = ckpt_path / "meta.json"
+    meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        skeleton = ckptr.metadata(ckpt_path / "state")
+        item = {"master_params": skeleton["master_params"]}
+        restored = ckptr.restore(ckpt_path / "state", item=item)
+        master = restored["master_params"]
+    except Exception:
+        restored = ckptr.restore(ckpt_path / "state")
+        master = restored["master_params"]
+    del restored
+    return jax.tree.map(lambda a: np.asarray(a, np.float32), master), meta
+
+
+def model_config_from_meta(meta: dict):
+    """Rebuild the TransformerConfig stored by ``save_checkpoint`` (None if
+    the checkpointed model had no dataclass config)."""
+    mc = meta.get("model_config")
+    if not mc:
+        return None
+    import jax.numpy as jnp
+
+    from ...models.transformer import TransformerConfig
+
+    mc = dict(mc)
+    dtype = mc.get("dtype")
+    if isinstance(dtype, str):
+        mc["dtype"] = getattr(jnp, dtype, jnp.bfloat16)
+    return TransformerConfig(**mc)
+
+
+def convert(ckpt_dir: str, tag: str | None = None, out_dir: str = "fp32_out",
+            fmt: str = "auto") -> str:
+    """Restore the fp32 master tree and write it out.
+
+    ``fmt``: "hf" (config.json + model.safetensors via the exporter),
+    "safetensors" (flat native tree), or "auto" (hf when the architecture
+    maps to an exporter family, else safetensors).
+    """
+    base = Path(ckpt_dir).absolute()
+    if tag in (None, "latest"):
+        latest = base / "latest"
+        if not latest.exists():
+            raise FileNotFoundError(f"no 'latest' tag file in {base}")
+        tag = latest.read_text().strip()
+    master, meta = _load_master(base / tag)
+    cfg = model_config_from_meta(meta)
+    if fmt == "hf" and cfg is None:
+        raise ValueError(
+            "--format hf requires a checkpoint whose meta.json carries "
+            "model_config (written by save_checkpoint for TransformerConfig "
+            "models); this checkpoint has none — use --format safetensors")
+    os.makedirs(out_dir, exist_ok=True)
+
+    if fmt in ("hf", "auto") and cfg is not None:
+        try:
+            from ...models.exporter import export_hf_checkpoint
+
+            export_hf_checkpoint(master, cfg, out_dir)
+            return out_dir
+        except Exception:
+            if fmt == "hf":
+                raise
+            # auto: clear any half-written HF files before the fallback so
+            # the out_dir never looks like a broken HF checkpoint
+            for name in ("config.json", "model.safetensors"):
+                try:
+                    os.unlink(os.path.join(out_dir, name))
+                except OSError:
+                    pass
+
+    # native flat safetensors: /-joined tree paths -> fp32 tensors
+    import jax
+    from safetensors.numpy import save_file
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(master)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = leaf
+    save_file(flat, os.path.join(out_dir, "model_fp32.safetensors"))
+    if cfg is not None:
+        (Path(out_dir) / "native_config.json").write_text(
+            json.dumps(meta.get("model_config"), indent=2))
+    return out_dir
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="dstpu_to_fp32",
+        description="checkpoint -> consolidated fp32 weights "
+                    "(reference utils/zero_to_fp32.py analog)")
+    p.add_argument("ckpt_dir", help="directory holding tags + 'latest'")
+    p.add_argument("tag", nargs="?", default=None)
+    p.add_argument("out_dir", nargs="?", default="fp32_out")
+    p.add_argument("--format", choices=("auto", "hf", "safetensors"),
+                   default="auto")
+    args = p.parse_args(argv)
+    out = convert(args.ckpt_dir, args.tag, args.out_dir, args.format)
+    print(f"wrote consolidated fp32 weights to {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -138,3 +138,62 @@ def test_restore_pipeline_ckpt_onto_dense_engine(tmp_path):
         model_a=PipelinedTransformerLM(cfg, n_stages=4, num_micro=4,
                                        schedule="1f1b"),
         model_b=TransformerLM(cfg))
+
+
+# ------------------------------------------------- standalone fp32 converter
+def test_standalone_to_fp32_hf_roundtrip(tmp_path):
+    """dstpu_to_fp32 (reference utils/zero_to_fp32.py analog): convert a
+    checkpoint dir WITHOUT an engine; the HF export must reload through the
+    importer with identical fp32 masters."""
+    import jax
+
+    from deepspeed_tpu.models import build_model, gpt2, import_state_dict
+    from deepspeed_tpu.runtime.checkpoint.to_fp32 import convert
+
+    model = build_model(gpt2("125m", n_layer=2, d_model=64, n_head=4,
+                             vocab_size=256, max_seq=64))
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 2},
+    }, model)
+    data = random_token_dataset(8, seq_len=32, vocab_size=256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data)
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    want = engine.fp32_params()
+
+    out = convert(str(tmp_path / "ckpt"), "latest", str(tmp_path / "hf"),
+                  fmt="hf")
+    import json as _json
+    import os as _os
+
+    assert _os.path.exists(_os.path.join(out, "model.safetensors"))
+    cfg2, params2 = import_state_dict(
+        __import__("safetensors.numpy", fromlist=["load_file"]).load_file(
+            _os.path.join(out, "model.safetensors")),
+        hf_config=_json.loads(open(_os.path.join(out, "config.json")).read()))
+    for (kw, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(want)[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(lambda x: np.asarray(x, np.float32), params2))[0]):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-5, atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(kw))
+
+
+def test_standalone_to_fp32_native_safetensors(tmp_path):
+    """Offload-engine checkpoint -> flat native fp32 safetensors, no engine."""
+    from safetensors.numpy import load_file
+
+    from deepspeed_tpu.runtime.checkpoint.to_fp32 import convert
+
+    eng, batch = _make(_cfg(stage=1, offload="cpu"))
+    eng.train_batch(batch)
+    eng.save_checkpoint(str(tmp_path / "ckpt"))
+    out = convert(str(tmp_path / "ckpt"), None, str(tmp_path / "flat"),
+                  fmt="safetensors")
+    flat = load_file(str(tmp_path / "flat" / "model_fp32.safetensors"))
+    want = eng.fp32_params()
+    np.testing.assert_allclose(flat["tok_embed"], want["tok_embed"],
+                               rtol=1e-6, atol=0)
+    assert any(k.startswith("layers/") for k in flat)
