@@ -1,0 +1,44 @@
+"""The test harness's own armor (round-2 verdict, Weak #5 'Done' criterion):
+the suite must run green — with visible output — under a deliberately
+wedged/poisoned axon relay environment.
+
+The ambient sitecustomize registers the TPU-relay PJRT plugin whenever
+``PALLAS_AXON_POOL_IPS`` is set, which (a) breaks pytest's fd capture and
+(b) makes any jax backend init dial the relay. conftest.py must detect this
+and re-exec pytest in a scrubbed env; this test proves it end to end by
+running a child pytest with the poison applied.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_suite_runs_under_poisoned_relay_env():
+    if not os.path.isdir("/root/.axon_site"):
+        pytest.skip("ambient axon sitecustomize not present; poison would "
+                    "be inert and the test vacuous")
+    env = dict(os.environ)
+    env.update({
+        # poisoned relay registration: JAX_PLATFORMS=axon means any backend
+        # init in the child MUST fail/hang unless conftest's re-exec armor
+        # scrubbed the env first
+        "PALLAS_AXON_POOL_IPS": "10.255.255.1",
+        "JAX_PLATFORMS": "axon",
+        "PYTHONPATH": "/root/.axon_site" + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    # test_mesh.py initializes the jax backend (builds meshes over
+    # jax.devices()), so the backend-dial leg is genuinely exercised —
+    # without the scrub the child would sit on the axon backend, not cpu
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(_ROOT, "tests", "unit", "test_mesh.py"), "-q"],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-1500:])
+    # output must be VISIBLE (the broken-capture failure mode printed nothing)
+    assert "passed" in p.stdout, (p.stdout[-500:], p.stderr[-500:])
