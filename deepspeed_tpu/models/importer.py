@@ -753,6 +753,136 @@ def _bigcode_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     return params
 
 
+
+# -------------------------------------------------------------- family: bert
+_HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
+           "gelu_pytorch_tanh": "gelu", "relu": "relu", "silu": "silu",
+           "swish": "silu"}
+
+
+def _bert_config(hf: dict) -> TransformerConfig:
+    act = hf.get("hidden_act", "gelu")
+    if act not in _HF_ACT:
+        raise ValueError(f"bert hidden_act {act!r} has no native mapping")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        d_model=hf["hidden_size"],
+        d_ff=hf["intermediate_size"],
+        max_seq=hf.get("max_position_embeddings", 512),
+        pos_embedding="learned", norm="layernorm",
+        activation=_HF_ACT[act],
+        use_bias=True, tie_embeddings=True, lm_head_bias=True,
+        causal=False, objective="mlm",
+        post_ln=True, embed_norm=True, mlm_transform=True,
+        norm_eps=hf.get("layer_norm_eps", 1e-12),
+    )
+
+
+def _bert_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """BERT encoder (post-LN, embedding LayerNorm, MLM transform head).
+
+    ``token_type_embeddings``: only segment A (type 0) is representable —
+    its row folds into every position embedding (x = tok + pos + type[0]);
+    the converter refuses checkpoints only through the unused-keys log,
+    since all public MLM usage with a single segment passes type 0.
+    """
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"encoder.layer.{i}."
+        per_layer.append({
+            "wq": sd.take(h + "attention.self.query.weight").T,
+            "bq": sd.take(h + "attention.self.query.bias"),
+            "wk": sd.take(h + "attention.self.key.weight").T,
+            "bk": sd.take(h + "attention.self.key.bias"),
+            "wv": sd.take(h + "attention.self.value.weight").T,
+            "bv": sd.take(h + "attention.self.value.bias"),
+            "wo": sd.take(h + "attention.output.dense.weight").T,
+            "bo": sd.take(h + "attention.output.dense.bias"),
+            "ln1_scale": sd.take(h + "attention.output.LayerNorm.weight"),
+            "ln1_bias": sd.take(h + "attention.output.LayerNorm.bias"),
+            "w_in": sd.take(h + "intermediate.dense.weight").T,
+            "b_in": sd.take(h + "intermediate.dense.bias"),
+            "w_out": sd.take(h + "output.dense.weight").T,
+            "b_out": sd.take(h + "output.dense.bias"),
+            "ln2_scale": sd.take(h + "output.LayerNorm.weight"),
+            "ln2_bias": sd.take(h + "output.LayerNorm.bias"),
+        })
+    pos = sd.take("embeddings.position_embeddings.weight")
+    type0 = sd.take("embeddings.token_type_embeddings.weight")[0]
+    return {
+        "tok_embed": sd.take("embeddings.word_embeddings.weight"),
+        "pos_embed": pos + type0[None, :],    # segment-A fold
+        "embed_ln_scale": sd.take("embeddings.LayerNorm.weight"),
+        "embed_ln_bias": sd.take("embeddings.LayerNorm.bias"),
+        "layers": _stack(per_layer),
+        "mlm_dense_w": sd.take("cls.predictions.transform.dense.weight").T,
+        "mlm_dense_b": sd.take("cls.predictions.transform.dense.bias"),
+        "mlm_ln_scale": sd.take("cls.predictions.transform.LayerNorm.weight"),
+        "mlm_ln_bias": sd.take("cls.predictions.transform.LayerNorm.bias"),
+        "lm_head_bias": sd.take("cls.predictions.bias"),
+    }
+
+
+# -------------------------------------------------------- family: distilbert
+def _distilbert_config(hf: dict) -> TransformerConfig:
+    act = hf.get("activation", "gelu")
+    if act not in _HF_ACT:
+        raise ValueError(f"distilbert activation {act!r} has no native mapping")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["n_layers"],
+        n_head=hf["n_heads"],
+        d_model=hf["dim"],
+        d_ff=hf["hidden_dim"],
+        max_seq=hf.get("max_position_embeddings", 512),
+        pos_embedding="learned", norm="layernorm",
+        activation=_HF_ACT[act],
+        use_bias=True, tie_embeddings=True, lm_head_bias=True,
+        causal=False, objective="mlm",
+        post_ln=True, embed_norm=True, mlm_transform=True,
+        norm_eps=1e-12,
+    )
+
+
+def _distilbert_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """DistilBERT: BERT block without token types, flat layer names."""
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"transformer.layer.{i}."
+        per_layer.append({
+            "wq": sd.take(h + "attention.q_lin.weight").T,
+            "bq": sd.take(h + "attention.q_lin.bias"),
+            "wk": sd.take(h + "attention.k_lin.weight").T,
+            "bk": sd.take(h + "attention.k_lin.bias"),
+            "wv": sd.take(h + "attention.v_lin.weight").T,
+            "bv": sd.take(h + "attention.v_lin.bias"),
+            "wo": sd.take(h + "attention.out_lin.weight").T,
+            "bo": sd.take(h + "attention.out_lin.bias"),
+            "ln1_scale": sd.take(h + "sa_layer_norm.weight"),
+            "ln1_bias": sd.take(h + "sa_layer_norm.bias"),
+            "w_in": sd.take(h + "ffn.lin1.weight").T,
+            "b_in": sd.take(h + "ffn.lin1.bias"),
+            "w_out": sd.take(h + "ffn.lin2.weight").T,
+            "b_out": sd.take(h + "ffn.lin2.bias"),
+            "ln2_scale": sd.take(h + "output_layer_norm.weight"),
+            "ln2_bias": sd.take(h + "output_layer_norm.bias"),
+        })
+    return {
+        "tok_embed": sd.take("embeddings.word_embeddings.weight"),
+        "pos_embed": sd.take("embeddings.position_embeddings.weight"),
+        "embed_ln_scale": sd.take("embeddings.LayerNorm.weight"),
+        "embed_ln_bias": sd.take("embeddings.LayerNorm.bias"),
+        "layers": _stack(per_layer),
+        "mlm_dense_w": sd.take("vocab_transform.weight").T,
+        "mlm_dense_b": sd.take("vocab_transform.bias"),
+        "mlm_ln_scale": sd.take("vocab_layer_norm.weight"),
+        "mlm_ln_bias": sd.take("vocab_layer_norm.bias"),
+        "lm_head_bias": sd.take("vocab_projector.bias"),
+    }
+
+
 _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
@@ -769,6 +899,9 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # CodeGen is a GPT-J block family: same config mapping, own qkv split
     "codegen": (_gptj_config, _codegen_convert, ("transformer.",)),
     "gpt_bigcode": (_bigcode_config, _bigcode_convert, ("transformer.",)),
+    "bert": (_bert_config, _bert_convert, ("bert.",)),
+    "distilbert": (_distilbert_config, _distilbert_convert,
+                   ("distilbert.",)),
 }
 
 
@@ -801,6 +934,10 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "bloom"
     if any("self_attention.query_key_value" in k for k in keys):
         return "falcon"
+    if any("attention.self.query" in k for k in keys):
+        return "bert"
+    if any("attention.q_lin" in k for k in keys):
+        return "distilbert"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
     raise ValueError("cannot detect model family from checkpoint keys; "
@@ -849,8 +986,14 @@ def import_state_dict(state_dict: Dict[str, Any],
             f"position table ({params['pos_embed'].shape[0]} rows); "
             "positions past the table would silently clamp")
     leftovers = [k for k in sd.unused()
-                 if not k.endswith(("rotary_emb.inv_freq", "attn.bias",
-                                    "attn.masked_bias", "lm_head.weight"))]
+                 if not k.endswith((
+                     "rotary_emb.inv_freq", "attn.bias", "attn.masked_bias",
+                     "lm_head.weight",
+                     # tied-decoder duplicates + buffers (BERT/DistilBERT)
+                     "cls.predictions.decoder.weight",
+                     "cls.predictions.decoder.bias",
+                     "vocab_projector.weight", "vocab_projector.bias",
+                     "embeddings.position_ids"))]
     if leftovers:
         log_dist(f"importer: {len(leftovers)} unused checkpoint keys "
                  f"(first 5: {leftovers[:5]})")

@@ -141,6 +141,13 @@ class _PipelinedLMBase:
         Wpad = jnp.pad(W, ((0, 0), (0, Pn * Vp - V)))
         Wl = lax.dynamic_slice_in_dim(Wpad, p * Vp, Vp, axis=1)  # (d, Vp)
         v0 = p * Vp
+        if cfg.lm_head_bias:
+            # head bias slices with the vocab shard (GPT-J/CodeGen/Phi)
+            bpad = jnp.pad(prm["lm_head_bias"].astype(jnp.float32),
+                           (0, Pn * Vp - V))
+            bias_l = lax.dynamic_slice_in_dim(bpad, p * Vp, Vp)
+        else:
+            bias_l = None
 
         def micro_loss(y, d_i):
             """CE of one drained microbatch; y is last-stage output,
@@ -148,6 +155,8 @@ class _PipelinedLMBase:
             y_bc = lax.psum(jnp.where(is_last, y, jnp.zeros_like(y)), "pipe")
             z = self._head_norm(prm, y_bc)
             logits_l = (z @ Wl).astype(jnp.float32)   # (Bm, S, Vp)
+            if bias_l is not None:
+                logits_l = logits_l + bias_l
             # padded vocab tail must not win the max / contribute to sum-exp
             col = jnp.arange(Vp) + v0
             logits_l = jnp.where(col[None, None, :] < V, logits_l,

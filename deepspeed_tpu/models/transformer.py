@@ -66,6 +66,13 @@ class TransformerConfig:
     parallel_shared_ln: bool = False
     embed_norm: bool = False              # Bloom word_embeddings_layernorm
     lm_head_bias: bool = False            # GPT-J lm_head has a bias
+    # post-LN block (BERT family): x = LN(x + attn(x)); x = LN(x + mlp(x)).
+    # The norm params keep their pre-LN names: ln1 = post-attention LN,
+    # ln2 = post-FFN LN; no final lnf exists.
+    post_ln: bool = False
+    # BERT MLM head transform: LN(gelu(x @ W + b)) before the tied decoder
+    # (+ output bias). Only meaningful with objective="mlm".
+    mlm_transform: bool = False
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16             # compute dtype
     # MoE (dense when num_experts == 1); see models/moe.py
@@ -162,6 +169,20 @@ def _rope(q, k, positions, theta: float, rotary_dim: int | None = None):
 
     return (rot(q.astype(jnp.float32)).astype(q.dtype),
             rot(k.astype(jnp.float32)).astype(k.dtype))
+
+
+def _activation(u, name: str):
+    """Named activation; unknown names fail loudly (a silent silu fallback
+    once imported gelu_new checkpoints with the wrong nonlinearity)."""
+    if name == "gelu":
+        return jax.nn.gelu(u)                      # tanh approx (gelu_new)
+    if name == "gelu_exact":
+        return jax.nn.gelu(u, approximate=False)   # erf gelu
+    if name == "relu":
+        return jax.nn.relu(u)
+    if name in ("silu", "swish"):
+        return jax.nn.silu(u)
+    raise ValueError(f"unknown activation {name!r}")
 
 
 def alibi_slopes(n_head: int) -> jnp.ndarray:
@@ -269,13 +290,19 @@ class TransformerLM:
         params = {
             "tok_embed": jax.random.normal(next(k), (cfg.vocab_size, d), jnp.float32) * 0.02,
             "layers": layers,
-            "lnf_scale": jnp.ones((d,), jnp.float32),
         }
+        if not cfg.post_ln:
+            params["lnf_scale"] = jnp.ones((d,), jnp.float32)
         if cfg.pos_embedding == "learned":
             params["pos_embed"] = jax.random.normal(next(k), (cfg.max_seq, d),
                                                     jnp.float32) * 0.02
-        if cfg.use_bias:
+        if cfg.use_bias and not cfg.post_ln:
             params["lnf_bias"] = jnp.zeros((d,), jnp.float32)
+        if cfg.mlm_transform:
+            params["mlm_dense_w"] = dense(next(k), (d, d))
+            params["mlm_dense_b"] = jnp.zeros((d,), jnp.float32)
+            params["mlm_ln_scale"] = jnp.ones((d,), jnp.float32)
+            params["mlm_ln_bias"] = jnp.zeros((d,), jnp.float32)
         if cfg.embed_norm:
             params["embed_ln_scale"] = jnp.ones((d,), jnp.float32)
             if cfg.use_bias:
@@ -321,12 +348,18 @@ class TransformerLM:
         specs = {
             "tok_embed": P("model", None),
             "layers": layers,
-            "lnf_scale": P(None),
         }
+        if not cfg.post_ln:
+            specs["lnf_scale"] = P(None)
         if cfg.pos_embedding == "learned":
             specs["pos_embed"] = P(None, None)
-        if cfg.use_bias:
+        if cfg.use_bias and not cfg.post_ln:
             specs["lnf_bias"] = P(None)
+        if cfg.mlm_transform:
+            specs["mlm_dense_w"] = P(None, None)
+            specs["mlm_dense_b"] = P(None)
+            specs["mlm_ln_scale"] = P(None)
+            specs["mlm_ln_bias"] = P(None)
         if cfg.embed_norm:
             specs["embed_ln_scale"] = P(None)
             if cfg.use_bias:
@@ -355,7 +388,8 @@ class TransformerLM:
         cfg = self.cfg
         B, S, d = x.shape
         h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
-        y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        y = x if cfg.post_ln else _norm(x, p["ln1_scale"], p.get("ln1_bias"),
+                                        cfg.norm, cfg.norm_eps)
         q = self._maybe_bias(y @ p["wq"].astype(y.dtype), p, "bq").reshape(B, S, h, hd)
         kk = self._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, S, kv, hd)
         vv = self._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, S, kv, hd)
@@ -392,14 +426,8 @@ class TransformerLM:
         u = self._maybe_bias(y @ p["w_in"].astype(y.dtype), p, "b_in")
         if cfg.is_glu:
             u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
-        elif cfg.activation == "gelu":
-            u = jax.nn.gelu(u)                      # tanh approx (gelu_new)
-        elif cfg.activation == "gelu_exact":
-            u = jax.nn.gelu(u, approximate=False)   # erf gelu (NeoX/Falcon)
-        elif cfg.activation == "relu":
-            u = jax.nn.relu(u)
         else:
-            u = jax.nn.silu(u)
+            u = _activation(u, cfg.activation)
         u = constrain(u, P(B_AXES, "seq", "model"))
         out = self._maybe_bias(u @ p["w_out"].astype(y.dtype), p, "b_out")
         return out, jnp.float32(0.0)
@@ -408,6 +436,15 @@ class TransformerLM:
         cfg = self.cfg
         p = layer_params
         o = self._attention_block(x, p, positions, attn_mask)
+        if cfg.post_ln:
+            # BERT block: norms AFTER each residual; FFN input is the
+            # post-attention-LN output directly
+            x = _norm(x + o, p["ln1_scale"], p.get("ln1_bias"),
+                      cfg.norm, cfg.norm_eps)
+            out, aux = self._mlp_block(x, p)
+            x = _norm(x + out, p["ln2_scale"], p.get("ln2_bias"),
+                      cfg.norm, cfg.norm_eps)
+            return constrain(x, P(B_AXES, "seq", None)), aux
         if cfg.parallel_residual:
             # x + attn(n1(x)) + mlp(n(x)) — GPT-J/NeoX/Falcon block shape;
             # shared_ln reuses n1 (XLA CSEs the recompute with the one
@@ -517,7 +554,10 @@ class TransformerLM:
 
     def _head_norm(self, params, x):
         """Final layernorm only (the pipeline's vocab-sharded head applies
-        its own unembedding slice)."""
+        its own unembedding slice). Post-LN trunks have no final norm —
+        each block already ends normalized."""
+        if self.cfg.post_ln:
+            return x
         return _norm(x, params["lnf_scale"], params.get("lnf_bias"),
                      self.cfg.norm, self.cfg.norm_eps)
 
@@ -525,6 +565,15 @@ class TransformerLM:
         """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
         cfg = self.cfg
         x = self._head_norm(params, x)
+        if cfg.mlm_transform:
+            # BERT cls.predictions.transform: dense + hidden_act + LN before
+            # the tied decoder (HF uses config.hidden_act here too); output
+            # bias added below via lm_head_bias
+            x = _activation(x @ params["mlm_dense_w"].astype(x.dtype)
+                            + params["mlm_dense_b"].astype(x.dtype),
+                            cfg.activation)
+            x = _norm(x, params["mlm_ln_scale"], params.get("mlm_ln_bias"),
+                      cfg.norm, cfg.norm_eps)
         if cfg.tie_embeddings:
             logits = x @ params["tok_embed"].astype(x.dtype).T
         else:

@@ -296,3 +296,58 @@ def test_codegen_bigcode_gpt2_autodetect(tiny_codegen, tiny_bigcode,
     assert _detect_family(tiny_codegen[0].state_dict()) == "codegen"
     assert _detect_family(tiny_bigcode[0].state_dict()) == "gpt_bigcode"
     assert _detect_family(tiny_gpt2[0].state_dict()) == "gpt2"
+
+
+# -------------------------------------------------- encoder (MLM) families
+def _mlm_logits_native(cfg, params, ids):
+    cfg = TransformerConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+    model = build_model(cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    return np.asarray(model.apply(params, jnp.asarray(ids)))
+
+
+def test_bert_mlm_logits_match():
+    """Post-LN encoder + embedding LN + segment-A fold + MLM transform."""
+    torch.manual_seed(10)
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    cfg, params = import_state_dict(hf.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    assert cfg.post_ln and cfg.embed_norm and cfg.mlm_transform \
+        and not cfg.causal
+    ids = np.random.default_rng(10).integers(0, 128, (2, 16), dtype=np.int64)
+    got = _mlm_logits_native(cfg, params, ids.astype(np.int32))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_distilbert_mlm_logits_match():
+    torch.manual_seed(11)
+    hf_cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+        max_position_embeddings=64)
+    hf = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+    cfg, params = import_state_dict(hf.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    ids = np.random.default_rng(11).integers(0, 128, (2, 16), dtype=np.int64)
+    got = _mlm_logits_native(cfg, params, ids.astype(np.int32))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_encoder_autodetect():
+    from deepspeed_tpu.models.importer import _detect_family
+
+    torch.manual_seed(10)
+    b = transformers.BertForMaskedLM(transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64))
+    d = transformers.DistilBertForMaskedLM(transformers.DistilBertConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, hidden_dim=64))
+    assert _detect_family(b.state_dict()) == "bert"
+    assert _detect_family(d.state_dict()) == "distilbert"

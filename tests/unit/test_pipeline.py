@@ -205,3 +205,22 @@ def test_moe_pipeline_trains(devices):
     batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data[:8])
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
     assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_head_bias_matches_dense(devices):
+    """lm_head_bias (GPT-J/CodeGen/Phi) must slice with the vocab-sharded
+    head — the pipelined loss previously dropped it silently."""
+    cfg = tiny_test(n_layer=4, max_seq=32, tie_embeddings=False,
+                    lm_head_bias=True, dtype=jnp.float32)
+    dense = TransformerLM(cfg)
+    piped = PipelinedTransformerLM(cfg, n_stages=4, num_micro=4)
+    params = dense.init(jax.random.PRNGKey(3))
+    params["lm_head_bias"] = jnp.asarray(
+        np.random.default_rng(3).normal(size=(cfg.vocab_size,)), jnp.float32)
+    batch = {"input_ids": jnp.asarray(np.random.default_rng(4).integers(
+        0, cfg.vocab_size, (8, 32)), jnp.int32)}
+    want = float(dense.loss(params, batch))
+    mesh = build_mesh(MeshSpec(data=2, pipe=4))
+    with mesh:
+        got = float(jax.jit(lambda p, b: piped.loss(p, b))(params, batch))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
