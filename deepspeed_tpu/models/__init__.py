@@ -3,9 +3,11 @@ from .importer import config_from_hf, import_state_dict, load_hf_checkpoint
 from .pipeline import PipelinedTransformerLM, build_pipeline_model
 from .presets import (bert, bloom, build_model, gpt2, llama2, mixtral, opt,
                       tiny_test)
+from .t5 import T5Config, T5Model, t5
 from .transformer import TransformerConfig, TransformerLM
 
 __all__ = ["TransformerConfig", "TransformerLM", "PipelinedTransformerLM",
+           "T5Config", "T5Model", "t5",
            "build_model", "build_pipeline_model", "gpt2", "llama2", "mixtral",
            "bert", "opt", "bloom", "tiny_test", "load_hf_checkpoint",
            "import_state_dict", "config_from_hf", "export_state_dict",

@@ -883,6 +883,87 @@ def _distilbert_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     }
 
 
+
+# ---------------------------------------------------------------- family: t5
+def _t5_config(hf: dict):
+    from .t5 import T5Config
+
+    proj = hf.get("feed_forward_proj", "relu")
+    if proj not in ("relu", "gated-gelu"):
+        raise ValueError(f"t5 feed_forward_proj {proj!r} unsupported")
+    return T5Config(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["d_model"],
+        d_kv=hf["d_kv"],
+        d_ff=hf["d_ff"],
+        n_layer=hf["num_layers"],
+        n_dec_layer=hf.get("num_decoder_layers") or hf["num_layers"],
+        n_head=hf["num_heads"],
+        rel_buckets=hf.get("relative_attention_num_buckets", 32),
+        rel_max_distance=hf.get("relative_attention_max_distance", 128),
+        gated_ffn=proj == "gated-gelu",
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        pad_token_id=hf.get("pad_token_id", 0),
+        norm_eps=hf.get("layer_norm_epsilon", 1e-6),
+    )
+
+
+def _t5_convert(sd: _SDict, cfg) -> dict:
+    """T5 encoder-decoder: relative-bias tables live on block 0 only;
+    DenseReluDense wi/wo (or wi_0/wi_1 gated); all torch Linear (out, in)."""
+    def stack(prefix, n, cross):
+        per = []
+        for i in range(n):
+            b = f"{prefix}.block.{i}."
+            ff = 2 if cross else 1
+            lyr = {
+                "ln1": sd.take(b + "layer.0.layer_norm.weight"),
+                "wq": sd.take(b + "layer.0.SelfAttention.q.weight").T,
+                "wk": sd.take(b + "layer.0.SelfAttention.k.weight").T,
+                "wv": sd.take(b + "layer.0.SelfAttention.v.weight").T,
+                "wo": sd.take(b + "layer.0.SelfAttention.o.weight").T,
+                "ln_ffn": sd.take(b + f"layer.{ff}.layer_norm.weight"),
+                "w_out": sd.take(b + f"layer.{ff}.DenseReluDense.wo.weight").T,
+            }
+            if cfg.gated_ffn:
+                lyr["w_gate"] = sd.take(
+                    b + f"layer.{ff}.DenseReluDense.wi_0.weight").T
+                lyr["w_in"] = sd.take(
+                    b + f"layer.{ff}.DenseReluDense.wi_1.weight").T
+            else:
+                lyr["w_in"] = sd.take(
+                    b + f"layer.{ff}.DenseReluDense.wi.weight").T
+            if cross:
+                lyr.update({
+                    "ln_cross": sd.take(b + "layer.1.layer_norm.weight"),
+                    "cq": sd.take(b + "layer.1.EncDecAttention.q.weight").T,
+                    "ck": sd.take(b + "layer.1.EncDecAttention.k.weight").T,
+                    "cv": sd.take(b + "layer.1.EncDecAttention.v.weight").T,
+                    "co": sd.take(b + "layer.1.EncDecAttention.o.weight").T,
+                })
+            per.append(lyr)
+        return _stack(per)
+
+    params = {
+        "shared": sd.take("shared.weight"),
+        "enc": {
+            "layers": stack("encoder", cfg.n_layer, cross=False),
+            "rel_bias": sd.take("encoder.block.0.layer.0.SelfAttention."
+                                "relative_attention_bias.weight"),
+            "final_ln": sd.take("encoder.final_layer_norm.weight"),
+        },
+        "dec": {
+            "layers": stack("decoder", cfg.n_dec_layer, cross=True),
+            "rel_bias": sd.take("decoder.block.0.layer.0.SelfAttention."
+                                "relative_attention_bias.weight"),
+            "final_ln": sd.take("decoder.final_layer_norm.weight"),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd.take("lm_head.weight").T
+    return params
+
+
 _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
@@ -902,6 +983,7 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "bert": (_bert_config, _bert_convert, ("bert.",)),
     "distilbert": (_distilbert_config, _distilbert_convert,
                    ("distilbert.",)),
+    "t5": (_t5_config, _t5_convert, ()),
 }
 
 
@@ -934,6 +1016,8 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "bloom"
     if any("self_attention.query_key_value" in k for k in keys):
         return "falcon"
+    if any("EncDecAttention" in k for k in keys):
+        return "t5"
     if any("attention.self.query" in k for k in keys):
         return "bert"
     if any("attention.q_lin" in k for k in keys):
@@ -979,7 +1063,7 @@ def import_state_dict(state_dict: Dict[str, Any],
         config = config_fn(hf_config)
     sd = _SDict(state_dict, strip=strip)
     params = convert_fn(sd, config)
-    if (config.pos_embedding == "learned"
+    if (getattr(config, "pos_embedding", None) == "learned"
             and config.max_seq > params["pos_embed"].shape[0]):
         raise ValueError(
             f"max_seq={config.max_seq} exceeds the checkpoint's learned "
@@ -993,7 +1077,10 @@ def import_state_dict(state_dict: Dict[str, Any],
                      "cls.predictions.decoder.weight",
                      "cls.predictions.decoder.bias",
                      "vocab_projector.weight", "vocab_projector.bias",
-                     "embeddings.position_ids"))]
+                     "embeddings.position_ids",
+                     # T5 per-stack duplicates of shared.weight
+                     "encoder.embed_tokens.weight",
+                     "decoder.embed_tokens.weight"))]
     if leftovers:
         log_dist(f"importer: {len(leftovers)} unused checkpoint keys "
                  f"(first 5: {leftovers[:5]})")
@@ -1056,8 +1143,9 @@ def load_hf_checkpoint(path: str,
     sd = _load_files(path)
     cfg, params = import_state_dict(sd, config=config, hf_config=hf_config)
     if overrides:
-        cfg = TransformerConfig(**{**cfg.__dict__, **overrides})
-        if (cfg.pos_embedding == "learned"
+        # type(cfg): works for TransformerConfig AND T5Config alike
+        cfg = type(cfg)(**{**cfg.__dict__, **overrides})
+        if (getattr(cfg, "pos_embedding", None) == "learned"
                 and cfg.max_seq > params["pos_embed"].shape[0]):
             # same guard as import_state_dict, re-checked post-override
             raise ValueError(

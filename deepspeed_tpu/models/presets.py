@@ -92,9 +92,8 @@ def opt(size: str = "125m", **overrides) -> TransformerConfig:
 
 def bloom(size: str = "560m", **overrides) -> TransformerConfig:
     """Bloom family (reference container ``containers/bloom.py``): ALiBi
-    position bias, no positional table. Native trunk only — the importer
-    does not map Bloom checkpoints (fused per-head qkv + embedding
-    layernorm differ structurally)."""
+    position bias, no positional table. HF checkpoints import via the
+    ``bloom`` family (fused per-head qkv split + embedding layernorm)."""
     table = {
         "tiny": dict(n_layer=2, n_head=4, d_model=64, d_ff=256, max_seq=64),
         "560m": dict(n_layer=24, n_head=16, d_model=1024),
@@ -117,7 +116,12 @@ def tiny_test(**overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
-def build_model(cfg: TransformerConfig, attention_fn=None) -> TransformerLM:
+def build_model(cfg, attention_fn=None):
+    from .t5 import T5Config, T5Model
+
+    if isinstance(cfg, T5Config):
+        assert attention_fn is None, "T5 uses its own unscaled attention"
+        return T5Model(cfg)
     if cfg.num_experts > 1:
         from .moe import MoETransformerLM
 

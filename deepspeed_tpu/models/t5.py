@@ -1,0 +1,351 @@
+"""T5 encoder-decoder (the reference registry's seq2seq family).
+
+Reference: ``module_inject/replace_policy.py`` carries a T5 injection policy
+among its ~20 architectures; here the family is a native trunk — fully
+additive beside :class:`TransformerLM` (decoder-only) and sharing its
+TPU-first shape: stacked ``(L, ...)`` weights scanned per stack, sharding
+as ``param_specs``, one pure ``loss``.
+
+T5-specific semantics implemented exactly (t5-v1.0, e.g. ``t5-small``):
+- RMSNorm (no bias), pre-norm blocks, relu FFN, no linear biases;
+- UNSCALED attention (no 1/sqrt(d_k) — absorbed into init by T5);
+- bucketed relative position bias, parameters living on block 0 and
+  applied in every layer (bidirectional buckets in the encoder, causal
+  buckets in the decoder self-attention; none on cross-attention);
+- tied shared embedding; when tied, decoder output scales by d_model^-0.5
+  before the unembedding matmul;
+- ``decoder_input_ids`` default to labels shifted right with the pad id.
+
+Generation (autoregressive decode with cross-attention cache) is not wired
+into the inference engine; the family covers import + training/eval.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..platform.mesh import BATCH_AXES, constrain
+from .transformer import _norm, vocab_parallel_lookup
+
+B_AXES = BATCH_AXES
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    n_layer: int = 6              # encoder layers
+    n_dec_layer: int = 6
+    n_head: int = 8
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    gated_ffn: bool = False       # v1.1 "gated-gelu"; v1.0 = relu
+    tie_embeddings: bool = True
+    pad_token_id: int = 0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def inner_dim(self) -> int:
+        return self.n_head * self.d_kv
+
+    def flops_per_token(self) -> float:
+        n = self.param_count(non_embedding=True)
+        return 6 * n
+
+    def param_count(self, non_embedding: bool = False) -> int:
+        d, inner, ff = self.d_model, self.inner_dim, self.d_ff
+        attn = 3 * d * inner + inner * d
+        ffn = d * ff * (3 if self.gated_ffn else 2)
+        enc = self.n_layer * (attn + ffn)
+        dec = self.n_dec_layer * (2 * attn + ffn)
+        emb = 0 if non_embedding else self.vocab_size * d
+        return enc + dec + emb
+
+
+def _rel_bucket(rel_pos, *, bidirectional: bool, num_buckets: int,
+                max_distance: int):
+    """HF ``T5Attention._relative_position_bucket``, vectorized."""
+    ret = jnp.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / math.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _position_bias(rel_table, q_len: int, k_len: int, *, bidirectional: bool,
+                   num_buckets: int, max_distance: int):
+    """(H, q_len, k_len) additive score bias from the (buckets, H) table."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _rel_bucket(mem - ctx, bidirectional=bidirectional,
+                          num_buckets=num_buckets, max_distance=max_distance)
+    return jnp.transpose(rel_table[buckets], (2, 0, 1)).astype(jnp.float32)
+
+
+def _t5_attention(q, k, v, *, bias=None, causal: bool = False, mask=None):
+    """UNSCALED attention. q:(B,Sq,H,dk) k/v:(B,Sk,H,dk); bias (H,Sq,Sk)."""
+    B, Sq, H, dk = q.shape
+    Sk = k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias[None]
+    big_neg = jnp.finfo(jnp.float32).min
+    if causal:
+        keep = jnp.tril(jnp.ones((Sq, Sk), bool))
+        scores = jnp.where(keep[None, None], scores, big_neg)
+    if mask is not None:   # (B, Sk) key padding mask
+        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, big_neg)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class T5Model:
+    """init / loss / param_specs over :class:`T5Config` (engine protocol)."""
+
+    def __init__(self, config: T5Config):
+        self.cfg = config
+        self._remat_policy = None
+
+    # ----------------------------------------------------------------- init
+    def _stack(self, key, n, cross: bool):
+        cfg = self.cfg
+        d, inner, ff = cfg.d_model, cfg.inner_dim, cfg.d_ff
+        k = iter(jax.random.split(key, 16))
+
+        def w(shape, scale):
+            return jax.random.normal(next(k), shape, jnp.float32) * scale
+
+        layers = {
+            "ln1": jnp.ones((n, d), jnp.float32),
+            "wq": w((n, d, inner), (d * cfg.d_kv) ** -0.5),
+            "wk": w((n, d, inner), d ** -0.5),
+            "wv": w((n, d, inner), d ** -0.5),
+            "wo": w((n, inner, d), inner ** -0.5),
+            "ln_ffn": jnp.ones((n, d), jnp.float32),
+            "w_in": w((n, d, ff), d ** -0.5),
+            "w_out": w((n, ff, d), ff ** -0.5),
+        }
+        if cfg.gated_ffn:
+            layers["w_gate"] = w((n, d, ff), d ** -0.5)
+        if cross:
+            layers.update({
+                "ln_cross": jnp.ones((n, d), jnp.float32),
+                "cq": w((n, d, inner), (d * cfg.d_kv) ** -0.5),
+                "ck": w((n, d, inner), d ** -0.5),
+                "cv": w((n, d, inner), d ** -0.5),
+                "co": w((n, inner, d), inner ** -0.5),
+            })
+        return layers
+
+    def init(self, rng) -> dict:
+        cfg = self.cfg
+        ke, kd, ks, keb, kdb, kh = jax.random.split(rng, 6)
+        params = {
+            "shared": jax.random.normal(
+                ks, (cfg.vocab_size, cfg.d_model), jnp.float32),
+            "enc": {
+                "layers": self._stack(ke, cfg.n_layer, cross=False),
+                "rel_bias": jax.random.normal(
+                    keb, (cfg.rel_buckets, cfg.n_head), jnp.float32) * 0.1,
+                "final_ln": jnp.ones((cfg.d_model,), jnp.float32),
+            },
+            "dec": {
+                "layers": self._stack(kd, cfg.n_dec_layer, cross=True),
+                "rel_bias": jax.random.normal(
+                    kdb, (cfg.rel_buckets, cfg.n_head), jnp.float32) * 0.1,
+                "final_ln": jnp.ones((cfg.d_model,), jnp.float32),
+            },
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = jax.random.normal(
+                kh, (cfg.d_model, cfg.vocab_size), jnp.float32)
+        return params
+
+    # ----------------------------------------------------------------- specs
+    def param_specs(self) -> dict:
+        def stack_specs(cross: bool):
+            s = {
+                "ln1": P(None, None),
+                "wq": P(None, None, "model"), "wk": P(None, None, "model"),
+                "wv": P(None, None, "model"), "wo": P(None, "model", None),
+                "ln_ffn": P(None, None),
+                "w_in": P(None, None, "model"), "w_out": P(None, "model", None),
+            }
+            if self.cfg.gated_ffn:
+                s["w_gate"] = P(None, None, "model")
+            if cross:
+                s.update({"ln_cross": P(None, None),
+                          "cq": P(None, None, "model"),
+                          "ck": P(None, None, "model"),
+                          "cv": P(None, None, "model"),
+                          "co": P(None, "model", None)})
+            return s
+
+        specs = {
+            "shared": P("model", None),   # vocab-sharded, like every trunk
+            "enc": {"layers": stack_specs(False), "rel_bias": P(None, None),
+                    "final_ln": P(None)},
+            "dec": {"layers": stack_specs(True), "rel_bias": P(None, None),
+                    "final_ln": P(None)},
+        }
+        if not self.cfg.tie_embeddings:
+            specs["lm_head"] = P(None, "model")
+        return specs
+
+    def stacked_fn(self):
+        cfg = self.cfg
+        sizes = {cfg.n_layer, cfg.n_dec_layer}
+        rel_shape = (cfg.rel_buckets, cfg.n_head)
+
+        def is_stacked(shape) -> bool:
+            # rel_bias (buckets, H) is NOT layer-stacked even when a stack
+            # depth equals rel_buckets (e.g. 32-layer models)
+            if tuple(shape) == rel_shape:
+                return False
+            return len(shape) >= 2 and shape[0] in sizes
+
+        return is_stacked
+
+    # ------------------------------------------------------------------ body
+    def _heads(self, x, w):
+        B, S, _ = x.shape
+        return (x @ w.astype(x.dtype)).reshape(
+            B, S, self.cfg.n_head, self.cfg.d_kv)
+
+    def _ffn(self, y, p):
+        cfg = self.cfg
+        u = y @ p["w_in"].astype(y.dtype)
+        if cfg.gated_ffn:
+            u = jax.nn.gelu(y @ p["w_gate"].astype(y.dtype)) * u
+        else:
+            u = jax.nn.relu(u)
+        u = constrain(u, P(B_AXES, None, "model"))
+        return u @ p["w_out"].astype(y.dtype)
+
+    def _encode(self, params, ids, mask):
+        cfg = self.cfg
+        x = vocab_parallel_lookup(params["shared"].astype(cfg.dtype), ids)
+        S = ids.shape[1]
+        bias = _position_bias(params["enc"]["rel_bias"], S, S,
+                              bidirectional=True, num_buckets=cfg.rel_buckets,
+                              max_distance=cfg.rel_max_distance)
+
+        def layer(x, p):
+            y = _norm(x, p["ln1"], None, "rmsnorm", cfg.norm_eps)
+            o = _t5_attention(self._heads(y, p["wq"]), self._heads(y, p["wk"]),
+                              self._heads(y, p["wv"]), bias=bias, mask=mask)
+            x = x + (o.reshape(*o.shape[:2], -1) @ p["wo"].astype(x.dtype))
+            y = _norm(x, p["ln_ffn"], None, "rmsnorm", cfg.norm_eps)
+            x = x + self._ffn(y, p)
+            return constrain(x, P(B_AXES, None, None)), None
+
+        if self._remat_policy is not None:
+            layer = jax.checkpoint(layer, policy=self._remat_policy,
+                                   prevent_cse=False)
+        x, _ = lax.scan(layer, x, params["enc"]["layers"])
+        return _norm(x, params["enc"]["final_ln"], None, "rmsnorm",
+                     cfg.norm_eps)
+
+    def _decode(self, params, dec_ids, enc_out, enc_mask):
+        cfg = self.cfg
+        x = vocab_parallel_lookup(params["shared"].astype(cfg.dtype), dec_ids)
+        S = dec_ids.shape[1]
+        bias = _position_bias(params["dec"]["rel_bias"], S, S,
+                              bidirectional=False,
+                              num_buckets=cfg.rel_buckets,
+                              max_distance=cfg.rel_max_distance)
+
+        def layer(x, p):
+            y = _norm(x, p["ln1"], None, "rmsnorm", cfg.norm_eps)
+            o = _t5_attention(self._heads(y, p["wq"]), self._heads(y, p["wk"]),
+                              self._heads(y, p["wv"]), bias=bias, causal=True)
+            x = x + (o.reshape(*o.shape[:2], -1) @ p["wo"].astype(x.dtype))
+            y = _norm(x, p["ln_cross"], None, "rmsnorm", cfg.norm_eps)
+            o = _t5_attention(self._heads(y, p["cq"]),
+                              self._heads(enc_out, p["ck"]),
+                              self._heads(enc_out, p["cv"]), mask=enc_mask)
+            x = x + (o.reshape(*o.shape[:2], -1) @ p["co"].astype(x.dtype))
+            y = _norm(x, p["ln_ffn"], None, "rmsnorm", cfg.norm_eps)
+            x = x + self._ffn(y, p)
+            return constrain(x, P(B_AXES, None, None)), None
+
+        if self._remat_policy is not None:
+            layer = jax.checkpoint(layer, policy=self._remat_policy,
+                                   prevent_cse=False)
+        x, _ = lax.scan(layer, x, params["dec"]["layers"])
+        return _norm(x, params["dec"]["final_ln"], None, "rmsnorm",
+                     cfg.norm_eps)
+
+    # ------------------------------------------------------------------ api
+    def apply(self, params, input_ids, decoder_input_ids, *,
+              attention_mask=None, remat_policy=None, return_aux=False):
+        """((B,Se), (B,Sd)) → (B, Sd, V) logits."""
+        cfg = self.cfg
+        self._remat_policy = remat_policy
+        enc_out = self._encode(params, input_ids, attention_mask)
+        x = self._decode(params, decoder_input_ids, enc_out, attention_mask)
+        if cfg.tie_embeddings:
+            x = x * (cfg.d_model ** -0.5)     # HF T5: rescale when tied
+            logits = x @ params["shared"].astype(x.dtype).T
+        else:
+            logits = x @ params["lm_head"].astype(x.dtype)
+        logits = constrain(logits, P(B_AXES, None, "model"))
+        return (logits, jnp.float32(0.0)) if return_aux else logits
+
+    def _shift_right(self, labels):
+        start = jnp.full((labels.shape[0], 1), self.cfg.pad_token_id,
+                         labels.dtype)
+        shifted = jnp.concatenate([start, labels[:, :-1]], axis=1)
+        return jnp.where(shifted == -100, self.cfg.pad_token_id, shifted)
+
+    def loss(self, params, batch, *, remat_policy=None):
+        labels = batch["labels"]
+        dec_ids = batch.get("decoder_input_ids")
+        if dec_ids is None:
+            dec_ids = self._shift_right(labels)
+        logits = self.apply(params, batch["input_ids"], dec_ids,
+                            attention_mask=batch.get("attention_mask"),
+                            remat_policy=remat_policy)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        safe = jnp.maximum(labels, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        w = (mask.astype(jnp.float32) if mask is not None
+             else (labels != -100).astype(jnp.float32))
+        return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def t5(size: str = "small", **overrides) -> T5Config:
+    table = {
+        "small": dict(d_model=512, d_kv=64, d_ff=2048, n_layer=6,
+                      n_dec_layer=6, n_head=8),
+        "base": dict(d_model=768, d_kv=64, d_ff=3072, n_layer=12,
+                     n_dec_layer=12, n_head=12),
+        "large": dict(d_model=1024, d_kv=64, d_ff=4096, n_layer=24,
+                      n_dec_layer=24, n_head=16),
+    }
+    base = dict(vocab_size=32128)
+    base.update(table[size])
+    base.update(overrides)
+    return T5Config(**base)

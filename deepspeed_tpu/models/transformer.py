@@ -185,6 +185,43 @@ def _activation(u, name: str):
     raise ValueError(f"unknown activation {name!r}")
 
 
+def vocab_parallel_lookup(table, ids):
+    """Vocab-parallel embedding lookup (shared by every trunk).
+
+    Embedding tables are vocab-sharded over ``model`` (``param_specs``); a
+    plain gather there makes GSPMD replicate the whole table
+    ("involuntary full rematerialization", ``spmd_partitioner.cc:652`` —
+    the round-2 dryrun regression). The TPU-native fix is Megatron's
+    vocab-parallel lookup: each shard gathers its own vocab range, masks
+    foreign ids to zero, and one psum over ``model`` assembles the rows —
+    activation-sized traffic instead of table-sized.
+    """
+    from ..platform.mesh import current_mesh
+
+    ctx = current_mesh()
+    manual = getattr(ctx, "manual_axes", frozenset()) if ctx is not None \
+        else frozenset()
+    if (ctx is None or "model" not in getattr(ctx, "axis_names", ())
+            or ctx.shape["model"] == 1 or manual):
+        return table[ids]
+
+    def lookup(tbl, idx):
+        v_local = tbl.shape[0]
+        local = idx - lax.axis_index("model") * v_local
+        ok = (local >= 0) & (local < v_local)
+        rows = tbl[jnp.clip(local, 0, v_local - 1)]
+        rows = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
+        return lax.psum(rows, "model")
+
+    # Fully-manual region (partial-manual psum trips an XLA partitioner
+    # CHECK on composed meshes): batch/seq stay sharded as in the trunk,
+    # the table enters model-sharded on vocab with full embedding rows.
+    fn = jax.shard_map(lookup, mesh=ctx,
+                       in_specs=(P("model", None), P(B_AXES, "seq")),
+                       out_specs=P(B_AXES, "seq", None))
+    return fn(table, ids)
+
+
 def alibi_slopes(n_head: int) -> jnp.ndarray:
     """Standard ALiBi per-head slopes (Bloom; geometric in 2^(-8/n))."""
     def pow2_slopes(n):
@@ -463,42 +500,7 @@ class TransformerLM:
         return constrain(x, P(B_AXES, "seq", None)), aux
 
     def _tok_lookup(self, table, ids):
-        """Vocab-parallel embedding lookup.
-
-        ``tok_embed`` is vocab-sharded over ``model`` (``param_specs``); a
-        plain gather there makes GSPMD replicate the whole table
-        ("involuntary full rematerialization", ``spmd_partitioner.cc:652`` —
-        the round-2 dryrun regression). The TPU-native fix is Megatron's
-        vocab-parallel lookup: each shard gathers its own vocab range, masks
-        foreign ids to zero, and one psum over ``model`` assembles the rows —
-        activation-sized traffic instead of table-sized. Runs as a
-        partial-manual shard_map (manual only on ``model``), so ZeRO-3's
-        ``data`` sharding of the embedding dim stays GSPMD-managed inside.
-        """
-        from ..platform.mesh import current_mesh
-
-        ctx = current_mesh()
-        manual = getattr(ctx, "manual_axes", frozenset()) if ctx is not None \
-            else frozenset()
-        if (ctx is None or "model" not in getattr(ctx, "axis_names", ())
-                or ctx.shape["model"] == 1 or manual):
-            return table[ids]
-
-        def lookup(tbl, idx):
-            v_local = tbl.shape[0]
-            local = idx - lax.axis_index("model") * v_local
-            ok = (local >= 0) & (local < v_local)
-            rows = tbl[jnp.clip(local, 0, v_local - 1)]
-            rows = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
-            return lax.psum(rows, "model")
-
-        # Fully-manual region (partial-manual psum trips an XLA partitioner
-        # CHECK on composed meshes): batch/seq stay sharded as in the trunk,
-        # the table enters model-sharded on vocab with full embedding rows.
-        fn = jax.shard_map(lookup, mesh=ctx,
-                           in_specs=(P("model", None), P(B_AXES, "seq")),
-                           out_specs=P(B_AXES, "seq", None))
-        return fn(table, ids)
+        return vocab_parallel_lookup(table, ids)
 
     @staticmethod
     def _positions(B: int, S: int):
