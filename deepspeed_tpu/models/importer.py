@@ -21,6 +21,7 @@ to matmul ``(in, out)``), and the RoPE basis permutation (HF "rotate-half"
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import Any, Callable, Dict, Tuple
 
@@ -335,6 +336,71 @@ def _gptj_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
         "lnf_bias": sd.take("ln_f.bias"),
         "lm_head": sd.take("lm_head.weight").T,
         "lm_head_bias": sd.take("lm_head.bias"),
+    }
+
+
+# ---------------------------------------------------------- family: gpt_neo
+def _gptneo_config(hf: dict) -> TransformerConfig:
+    """EleutherAI GPT-Neo (reference ``module_inject/containers/gptneo.py``).
+
+    HF alternates global/local attention per layer (``attention_types``);
+    the native trunk runs full causal attention everywhere, which is exact
+    for sequences up to ``window_size`` (default 256) and diverges beyond it
+    on the local layers — same policy as the Mistral sliding-window import.
+    """
+    att = hf.get("attention_types") or []
+    if any("local" in str(block).lower() for block in att):
+        log_dist("importer: gpt_neo declares local-attention layers "
+                 f"(window_size={hf.get('window_size', 256)}) — the native "
+                 "trunk runs full causal attention, so outputs diverge from "
+                 "HF beyond the window on those layers")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        n_layer=hf["num_layers"],
+        n_head=hf["num_heads"],
+        d_model=hf["hidden_size"],
+        d_ff=hf.get("intermediate_size") or 4 * hf["hidden_size"],
+        max_seq=hf.get("max_position_embeddings", 2048),
+        pos_embedding="learned", norm="layernorm", activation="gelu",
+        use_bias=True, tie_embeddings=True,
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def _gptneo_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """GPT-Neo: torch Linear (out, in) → transpose; q/k/v carry no bias
+    (zeros, GPT-J pattern) but out_proj and the MLP do.  GPT-Neo applies NO
+    1/sqrt(head_dim) attention scale (trained that way) — fold sqrt(hd) into
+    wq to cancel the trunk's scaling exactly."""
+    hh = cfg.n_head * cfg.head_dim
+    q_scale = math.sqrt(cfg.head_dim)
+    zeros_h = np.zeros((hh,), np.float32)
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"h.{i}."
+        a = h + "attn.attention."
+        per_layer.append({
+            "ln1_scale": sd.take(h + "ln_1.weight"),
+            "ln1_bias": sd.take(h + "ln_1.bias"),
+            "wq": sd.take(a + "q_proj.weight").T * q_scale,
+            "wk": sd.take(a + "k_proj.weight").T,
+            "wv": sd.take(a + "v_proj.weight").T,
+            "bq": zeros_h, "bk": zeros_h, "bv": zeros_h,
+            "wo": sd.take(a + "out_proj.weight").T,
+            "bo": sd.take(a + "out_proj.bias"),
+            "ln2_scale": sd.take(h + "ln_2.weight"),
+            "ln2_bias": sd.take(h + "ln_2.bias"),
+            "w_in": sd.take(h + "mlp.c_fc.weight").T,
+            "b_in": sd.take(h + "mlp.c_fc.bias"),
+            "w_out": sd.take(h + "mlp.c_proj.weight").T,
+            "b_out": sd.take(h + "mlp.c_proj.bias"),
+        })
+    return {
+        "tok_embed": sd.take("wte.weight"),
+        "pos_embed": sd.take("wpe.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("ln_f.weight"),
+        "lnf_bias": sd.take("ln_f.bias"),
     }
 
 
@@ -972,6 +1038,7 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "mixtral": (_llama_config, _llama_convert, ("model.",)),
     "opt": (_opt_config, _opt_convert, ("model.decoder.", "decoder.")),
     "gptj": (_gptj_config, _gptj_convert, ("transformer.",)),
+    "gpt_neo": (_gptneo_config, _gptneo_convert, ("transformer.",)),
     "gpt_neox": (_neox_config, _neox_convert, ("gpt_neox.",)),
     "falcon": (_falcon_config, _falcon_convert, ("transformer.",)),
     "bloom": (_bloom_config, _bloom_convert, ("transformer.",)),
@@ -1001,6 +1068,8 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "opt"
     if any("attn.qkv_proj" in k for k in keys):
         return "codegen"
+    if any("attn.attention.q_proj" in k for k in keys):
+        return "gpt_neo"
     if any("mlp.fc_in" in k for k in keys):
         return "gptj"
 
@@ -1072,6 +1141,8 @@ def import_state_dict(state_dict: Dict[str, Any],
     leftovers = [k for k in sd.unused()
                  if not k.endswith((
                      "rotary_emb.inv_freq", "attn.bias", "attn.masked_bias",
+                     # GPT-Neo nests the causal-mask buffers one level deeper
+                     "attention.bias", "attention.masked_bias",
                      "lm_head.weight",
                      # tied-decoder duplicates + buffers (BERT/DistilBERT)
                      "cls.predictions.decoder.weight",

@@ -298,6 +298,34 @@ def test_codegen_bigcode_gpt2_autodetect(tiny_codegen, tiny_bigcode,
     assert _detect_family(tiny_gpt2[0].state_dict()) == "gpt2"
 
 
+@pytest.fixture(scope="module")
+def tiny_gptneo():
+    torch.manual_seed(10)
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, max_position_embeddings=64, hidden_size=64,
+        num_layers=2, num_heads=4, intermediate_size=256,
+        attention_types=[[["global", "local"], 1]], window_size=32)
+    return transformers.GPTNeoForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+def test_gptneo_logits_match(tiny_gptneo):
+    """Sequential block, learned positions, unbiased q/k/v, biased out/MLP.
+
+    seq=16 < window_size=32, so the local-attention layer is exact under
+    the full-causal trunk (the import logs the divergence caveat).
+    """
+    model, hf_cfg = tiny_gptneo
+    _roundtrip(model, hf_cfg, 10,
+               lambda cfg: cfg.pos_embedding == "learned"
+               and not cfg.parallel_residual and cfg.tie_embeddings)
+
+
+def test_gptneo_autodetect(tiny_gptneo):
+    from deepspeed_tpu.models.importer import _detect_family
+
+    assert _detect_family(tiny_gptneo[0].state_dict()) == "gpt_neo"
+
+
 # -------------------------------------------------- encoder (MLM) families
 def _mlm_logits_native(cfg, params, ids):
     cfg = TransformerConfig(**{**cfg.__dict__, "dtype": jnp.float32})
