@@ -950,6 +950,62 @@ def _distilbert_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
 
 
 
+# -------------------------------------------------------------- family: clip
+def _clip_config(hf: dict) -> TransformerConfig:
+    """CLIP text tower (reference ``module_inject/containers/clip.py`` —
+    the Stable-Diffusion text conditioner).  Accepts a full CLIPConfig
+    (nested ``text_config``) or a standalone CLIPTextConfig.  The tower is
+    a pre-LN *causal* encoder whose product is final-norm hidden states,
+    so it imports as ``objective='feature'`` (no unembedding)."""
+    txt = hf.get("text_config") or hf
+    return TransformerConfig(
+        vocab_size=txt["vocab_size"],
+        n_layer=txt["num_hidden_layers"],
+        n_head=txt["num_attention_heads"],
+        d_model=txt["hidden_size"],
+        d_ff=txt["intermediate_size"],
+        max_seq=txt.get("max_position_embeddings", 77),
+        pos_embedding="learned", norm="layernorm",
+        activation=txt.get("hidden_act", "quick_gelu"),
+        use_bias=True, tie_embeddings=False, causal=True,
+        objective="feature",
+        norm_eps=txt.get("layer_norm_eps", 1e-5),
+    )
+
+
+def _clip_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """CLIP text encoder: torch Linear (out, in) → transpose; all
+    projections biased; learned positions; final layernorm, no head."""
+    per_layer = []
+    for i in range(cfg.n_layer):
+        h = f"encoder.layers.{i}."
+        per_layer.append({
+            "ln1_scale": sd.take(h + "layer_norm1.weight"),
+            "ln1_bias": sd.take(h + "layer_norm1.bias"),
+            "wq": sd.take(h + "self_attn.q_proj.weight").T,
+            "bq": sd.take(h + "self_attn.q_proj.bias"),
+            "wk": sd.take(h + "self_attn.k_proj.weight").T,
+            "bk": sd.take(h + "self_attn.k_proj.bias"),
+            "wv": sd.take(h + "self_attn.v_proj.weight").T,
+            "bv": sd.take(h + "self_attn.v_proj.bias"),
+            "wo": sd.take(h + "self_attn.out_proj.weight").T,
+            "bo": sd.take(h + "self_attn.out_proj.bias"),
+            "ln2_scale": sd.take(h + "layer_norm2.weight"),
+            "ln2_bias": sd.take(h + "layer_norm2.bias"),
+            "w_in": sd.take(h + "mlp.fc1.weight").T,
+            "b_in": sd.take(h + "mlp.fc1.bias"),
+            "w_out": sd.take(h + "mlp.fc2.weight").T,
+            "b_out": sd.take(h + "mlp.fc2.bias"),
+        })
+    return {
+        "tok_embed": sd.take("embeddings.token_embedding.weight"),
+        "pos_embed": sd.take("embeddings.position_embedding.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("final_layer_norm.weight"),
+        "lnf_bias": sd.take("final_layer_norm.bias"),
+    }
+
+
 # ---------------------------------------------------------------- family: t5
 def _t5_config(hf: dict):
     from .t5 import T5Config
@@ -1051,6 +1107,8 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "distilbert": (_distilbert_config, _distilbert_convert,
                    ("distilbert.",)),
     "t5": (_t5_config, _t5_convert, ()),
+    "clip": (_clip_config, _clip_convert, ("text_model.",)),
+    "clip_text_model": (_clip_config, _clip_convert, ("text_model.",)),
 }
 
 
@@ -1091,6 +1149,9 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "bert"
     if any("attention.q_lin" in k for k in keys):
         return "distilbert"
+    if any("token_embedding" in k for k in keys) and \
+            any("layer_norm1" in k for k in keys):
+        return "clip_text_model"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
     raise ValueError("cannot detect model family from checkpoint keys; "

@@ -55,6 +55,8 @@ class TransformerConfig:
     tie_embeddings: bool = True
     causal: bool = True                   # False => encoder (BERT family)
     objective: str = "clm"                # "clm" next-token | "mlm" (BERT)
+                                          # | "feature" (CLIP text encoder:
+                                          # apply() returns hidden states)
     rope_theta: float = 10000.0
     rotary_dim: Optional[int] = None      # partial rotary (GPT-J/NeoX):
                                           # rotate only the first N dims/head
@@ -182,6 +184,8 @@ def _activation(u, name: str):
         return jax.nn.relu(u)
     if name in ("silu", "swish"):
         return jax.nn.silu(u)
+    if name == "quick_gelu":
+        return u * jax.nn.sigmoid(1.702 * u)       # CLIP's sigmoid approx
     raise ValueError(f"unknown activation {name!r}")
 
 
@@ -346,7 +350,7 @@ class TransformerLM:
                 params["embed_ln_bias"] = jnp.zeros((d,), jnp.float32)
         if cfg.lm_head_bias:
             params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,), jnp.float32)
-        if not cfg.tie_embeddings:
+        if not cfg.tie_embeddings and cfg.objective != "feature":
             params["lm_head"] = dense(next(k), (d, cfg.vocab_size), scale=0.02)
         return params
 
@@ -401,7 +405,7 @@ class TransformerLM:
             specs["embed_ln_scale"] = P(None)
             if cfg.use_bias:
                 specs["embed_ln_bias"] = P(None)
-        if not cfg.tie_embeddings:
+        if not cfg.tie_embeddings and cfg.objective != "feature":
             specs["lm_head"] = P(None, "model")
         if cfg.lm_head_bias:
             specs["lm_head_bias"] = P("model")
@@ -586,14 +590,20 @@ class TransformerLM:
 
     def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None,
               return_aux: bool = False):
-        """Forward: (B, S) int32 → (B, S, V) logits (compute dtype)."""
+        """Forward: (B, S) int32 → (B, S, V) logits (compute dtype), or
+        (B, S, D) final-norm hidden states for ``objective='feature'``."""
         x, positions = self._embed(params, input_ids)
         x, aux = self._scan_layers(x, params["layers"], positions, attn_mask,
                                    remat_policy)
-        logits = self._head(params, x)
+        if self.cfg.objective == "feature":
+            # Feature extractor (CLIP text tower): no unembedding exists;
+            # the product is the final-norm hidden states (B, S, D).
+            out = self._head_norm(params, x)
+        else:
+            out = self._head(params, x)
         if return_aux:
-            return logits, aux
-        return logits
+            return out, aux
+        return out
 
     # ----------------------------------------------------------------- loss
     def loss(self, params, batch, *, remat_policy=None):
@@ -603,6 +613,10 @@ class TransformerLM:
         ``clm``: next-token over (possibly loss-masked) positions.
         ``mlm`` (encoder / BERT): predict ``batch['labels']`` at the
         positions marked by ``batch['loss_mask']`` — no shift."""
+        if self.cfg.objective == "feature":
+            raise ValueError(
+                "objective='feature' models have no unembedding/LM loss; "
+                "train them under a task head (apply() gives hidden states)")
         ids = batch["input_ids"]
         logits, aux = self.apply(params, ids, attn_mask=batch.get("attention_mask"),
                                  remat_policy=remat_policy, return_aux=True)

@@ -440,3 +440,48 @@ def test_t5_autodetect():
         vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=1,
         num_decoder_layers=1, num_heads=4))
     assert _detect_family(hf.state_dict()) == "t5"
+
+
+# ------------------------------------------------------- feature tower: clip
+def test_clip_text_hidden_states_match():
+    """CLIP text tower: pre-LN causal encoder, quick_gelu, learned
+    positions, objective='feature' (apply() = final-norm hidden states)."""
+    torch.manual_seed(13)
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32)
+    hf = transformers.CLIPTextModel(hf_cfg).eval()
+    cfg, params = import_state_dict(hf.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    assert cfg.objective == "feature" and cfg.activation == "quick_gelu"
+    ids = np.random.default_rng(13).integers(0, 128, (2, 16), dtype=np.int64)
+    model = build_model(TransformerConfig(**{**cfg.__dict__,
+                                             "dtype": jnp.float32}))
+    got = np.asarray(model.apply(jax.tree.map(jnp.asarray, params),
+                                 jnp.asarray(ids, jnp.int32)))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).last_hidden_state.float().numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_clip_autodetect_and_loss_guard():
+    from deepspeed_tpu.models.importer import _detect_family
+
+    torch.manual_seed(13)
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=16)
+    hf = transformers.CLIPTextModel(hf_cfg).eval()
+    assert _detect_family(hf.state_dict()) == "clip_text_model"
+    cfg, params = import_state_dict(hf.state_dict(),
+                                    hf_config=hf_cfg.to_dict())
+    model = build_model(cfg)
+    # spec tree must match the imported param tree (no phantom lm_head —
+    # feature towers have no unembedding, despite tie_embeddings=False)
+    assert "lm_head" not in model.param_specs()
+    assert "lm_head" not in model.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="feature"):
+        model.loss(jax.tree.map(jnp.asarray, params),
+                   {"input_ids": jnp.zeros((2, 8), jnp.int32)})
