@@ -434,6 +434,13 @@ def _split_fused_qkv_per_head(w, n_head, head_dim, d):
     return tuple(w[:, j].reshape(n_head * head_dim, d).T for j in range(3))
 
 
+def _split_fused_qkv_bias_per_head(b, n_head, head_dim):
+    """Bias sibling of :func:`_split_fused_qkv_per_head`: (3*h*hd,) with
+    per-head [q|k|v] interleave → three (h*hd,) bias vectors."""
+    b = b.reshape(n_head, 3, head_dim)
+    return tuple(b[:, j].reshape(-1) for j in range(3))
+
+
 def _neox_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     """GPT-NeoX: parallel residual with TWO layernorms, fused per-head-
     interleaved qkv, partial rotate-half rotary → permute rotary columns."""
@@ -444,8 +451,8 @@ def _neox_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
         p = f"layers.{i}."
         wq, wk, wv = _split_fused_qkv_per_head(
             sd.take(p + "attention.query_key_value.weight"), h, hd, d)
-        bq, bk, bv = (sd.take(p + "attention.query_key_value.bias")
-                      .reshape(h, 3, hd)[:, j].reshape(-1) for j in range(3))
+        bq, bk, bv = _split_fused_qkv_bias_per_head(
+            sd.take(p + "attention.query_key_value.bias"), h, hd)
         per_layer.append({
             "ln1_scale": sd.take(p + "input_layernorm.weight"),
             "ln1_bias": sd.take(p + "input_layernorm.bias"),
@@ -599,8 +606,8 @@ def _bloom_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
         p = f"h.{i}."
         wq, wk, wv = _split_fused_qkv_per_head(
             sd.take(p + "self_attention.query_key_value.weight"), h, hd, d)
-        bq, bk, bv = (sd.take(p + "self_attention.query_key_value.bias")
-                      .reshape(h, 3, hd)[:, j].reshape(-1) for j in range(3))
+        bq, bk, bv = _split_fused_qkv_bias_per_head(
+            sd.take(p + "self_attention.query_key_value.bias"), h, hd)
         per_layer.append({
             "ln1_scale": sd.take(p + "input_layernorm.weight"),
             "ln1_bias": sd.take(p + "input_layernorm.bias"),
@@ -950,6 +957,63 @@ def _distilbert_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
 
 
 
+# ------------------------------------------------------ family: megatron_gpt
+def _megatron_config(hf: dict) -> TransformerConfig:
+    """Megatron-LM GPT checkpoint (reference
+    ``module_inject/containers/megatron_gpt.py``).  Megatron has no HF
+    config.json; callers pass the training args as a dict with
+    ``model_type='megatron_gpt'``.  Default activation is the tanh-approx
+    gelu (Megatron's fused bias-gelu); pass ``activation='gelu_exact'``
+    for checkpoints trained with the unfused erf gelu."""
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"] if "vocab_size" in hf
+        else hf["padded_vocab_size"],
+        n_layer=hf["num_layers"],
+        n_head=hf["num_attention_heads"],
+        d_model=hf["hidden_size"],
+        d_ff=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
+        max_seq=hf.get("max_position_embeddings", 1024),
+        pos_embedding="learned", norm="layernorm",
+        activation=hf.get("activation", "gelu"),
+        use_bias=True, tie_embeddings=True,
+        norm_eps=hf.get("layernorm_epsilon", 1e-5),
+    )
+
+
+def _megatron_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Megatron-LM GPT: sequential block, learned positions, fused
+    per-head-interleaved qkv (the layout NeoX inherited), biased
+    projections, word-embedding-tied head."""
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"encoder.layers.{i}."
+        wq, wk, wv = _split_fused_qkv_per_head(
+            sd.take(p + "self_attention.query_key_value.weight"), h, hd, d)
+        bq, bk, bv = _split_fused_qkv_bias_per_head(
+            sd.take(p + "self_attention.query_key_value.bias"), h, hd)
+        per_layer.append({
+            "ln1_scale": sd.take(p + "input_layernorm.weight"),
+            "ln1_bias": sd.take(p + "input_layernorm.bias"),
+            "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+            "wo": sd.take(p + "self_attention.dense.weight").T,
+            "bo": sd.take(p + "self_attention.dense.bias"),
+            "ln2_scale": sd.take(p + "post_attention_layernorm.weight"),
+            "ln2_bias": sd.take(p + "post_attention_layernorm.bias"),
+            "w_in": sd.take(p + "mlp.dense_h_to_4h.weight").T,
+            "b_in": sd.take(p + "mlp.dense_h_to_4h.bias"),
+            "w_out": sd.take(p + "mlp.dense_4h_to_h.weight").T,
+            "b_out": sd.take(p + "mlp.dense_4h_to_h.bias"),
+        })
+    return {
+        "tok_embed": sd.take("embedding.word_embeddings.weight"),
+        "pos_embed": sd.take("embedding.position_embeddings.weight"),
+        "layers": _stack(per_layer),
+        "lnf_scale": sd.take("encoder.final_layernorm.weight"),
+        "lnf_bias": sd.take("encoder.final_layernorm.bias"),
+    }
+
+
 # -------------------------------------------------------------- family: clip
 def _clip_config(hf: dict) -> TransformerConfig:
     """CLIP text tower (reference ``module_inject/containers/clip.py`` —
@@ -1109,6 +1173,8 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "t5": (_t5_config, _t5_convert, ()),
     "clip": (_clip_config, _clip_convert, ("text_model.",)),
     "clip_text_model": (_clip_config, _clip_convert, ("text_model.",)),
+    "megatron_gpt": (_megatron_config, _megatron_convert,
+                     ("model.language_model.", "language_model.")),
 }
 
 
@@ -1137,6 +1203,11 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
     if any("self_attn.q_proj.bias" in k for k in keys) and \
             any("mlp.gate_proj" in k for k in keys):
         return "qwen2"
+    if any("language_model" in k for k in keys) and \
+            any("self_attention.query_key_value" in k for k in keys):
+        # both anchors: multimodal HF checkpoints (LLaVA-style) also prefix
+        # llama-layout keys with "language_model."
+        return "megatron_gpt"
     if any("gpt_neox" in k or "embed_in" in k for k in keys):
         return "gpt_neox"
     if any("word_embeddings_layernorm" in k for k in keys):

@@ -128,7 +128,8 @@ class TransformerConfig:
         per_layer += self._ffn_params_per_layer(active_only=active_only)
         emb = self.vocab_size * d
         total = L * per_layer + (emb if not non_embedding else 0)
-        if not self.tie_embeddings and not non_embedding:
+        if (not self.tie_embeddings and not non_embedding
+                and self.objective != "feature"):
             total += emb
         return total
 

@@ -79,9 +79,10 @@ def model_flops_tree(cfg, batch: int, seq: int) -> list[dict]:
          L * ffn_params, L * ffn_active),
     ]:
         rows.append({"name": comp, "params": params, "macs": macs_tok * tokens})
-    head_params = 0 if cfg.tie_embeddings else d * V
-    rows.append({"name": "lm_head", "params": head_params,
-                 "macs": d * V * tokens})
+    if getattr(cfg, "objective", "clm") != "feature":   # feature towers
+        head_params = 0 if cfg.tie_embeddings else d * V  # have no unembed
+        rows.append({"name": "lm_head", "params": head_params,
+                     "macs": d * V * tokens})
     return rows
 
 

@@ -485,3 +485,60 @@ def test_clip_autodetect_and_loss_guard():
     with pytest.raises(ValueError, match="feature"):
         model.loss(jax.tree.map(jnp.asarray, params),
                    {"input_ids": jnp.zeros((2, 8), jnp.int32)})
+
+
+# -------------------------------------------------- megatron-lm checkpoints
+def test_megatron_gpt_matches_gpt2_equivalent(tiny_gpt2):
+    """Megatron-LM layout import == GPT-2 import of the same weights.
+
+    Oracle without Megatron itself: rearrange a tiny GPT-2's weights into
+    the Megatron state-dict layout (fused per-head-interleaved qkv,
+    language_model.* keys) and require byte-equivalent logits from the two
+    import paths — any interleave/transpose mistake diverges immediately.
+    """
+    model, hf_cfg = tiny_gpt2
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    d, h = 64, 4
+    hd = d // h
+    meg = {"model.language_model.embedding.word_embeddings.weight":
+           sd["transformer.wte.weight"],
+           "model.language_model.embedding.position_embeddings.weight":
+           sd["transformer.wpe.weight"],
+           "model.language_model.encoder.final_layernorm.weight":
+           sd["transformer.ln_f.weight"],
+           "model.language_model.encoder.final_layernorm.bias":
+           sd["transformer.ln_f.bias"]}
+    for i in range(hf_cfg.n_layer):
+        g = f"transformer.h.{i}."
+        m = f"model.language_model.encoder.layers.{i}."
+        ca_w, ca_b = sd[g + "attn.c_attn.weight"], sd[g + "attn.c_attn.bias"]
+        # gpt2 Conv1D (d, 3d) block-[q|k|v] → megatron (3*h*hd, d) per-head
+        qkv_w = np.stack([ca_w[:, j * d:(j + 1) * d].T.reshape(h, hd, d)
+                          for j in range(3)], axis=1).reshape(3 * d, d)
+        qkv_b = np.stack([ca_b[j * d:(j + 1) * d].reshape(h, hd)
+                          for j in range(3)], axis=1).reshape(3 * d)
+        meg[m + "self_attention.query_key_value.weight"] = qkv_w
+        meg[m + "self_attention.query_key_value.bias"] = qkv_b
+        meg[m + "self_attention.dense.weight"] = sd[g + "attn.c_proj.weight"].T
+        meg[m + "self_attention.dense.bias"] = sd[g + "attn.c_proj.bias"]
+        meg[m + "input_layernorm.weight"] = sd[g + "ln_1.weight"]
+        meg[m + "input_layernorm.bias"] = sd[g + "ln_1.bias"]
+        meg[m + "post_attention_layernorm.weight"] = sd[g + "ln_2.weight"]
+        meg[m + "post_attention_layernorm.bias"] = sd[g + "ln_2.bias"]
+        meg[m + "mlp.dense_h_to_4h.weight"] = sd[g + "mlp.c_fc.weight"].T
+        meg[m + "mlp.dense_h_to_4h.bias"] = sd[g + "mlp.c_fc.bias"]
+        meg[m + "mlp.dense_4h_to_h.weight"] = sd[g + "mlp.c_proj.weight"].T
+        meg[m + "mlp.dense_4h_to_h.bias"] = sd[g + "mlp.c_proj.bias"]
+
+    from deepspeed_tpu.models.importer import _detect_family
+    assert _detect_family(meg) == "megatron_gpt"
+    meg_cfg = {"model_type": "megatron_gpt", "num_layers": hf_cfg.n_layer,
+               "hidden_size": d, "num_attention_heads": h,
+               "vocab_size": 128, "max_position_embeddings": 64}
+    cfg_m, params_m = import_state_dict(meg, hf_config=meg_cfg)
+    cfg_g, params_g = import_state_dict(model.state_dict(),
+                                        hf_config=hf_cfg.to_dict())
+    ids = np.random.default_rng(14).integers(0, 128, (2, 16), dtype=np.int64)
+    got_m = _native_logits(cfg_m, params_m, ids.astype(np.int32))
+    got_g = _native_logits(cfg_g, params_g, ids.astype(np.int32))
+    np.testing.assert_allclose(got_m, got_g, atol=1e-5, rtol=1e-5)
