@@ -1,10 +1,10 @@
 """One-off MFU sweep on the live TPU: find the best bench candidate config.
 
-Runs a grid of (size, micro, seq, remat) in ONE process (the axon tunnel
-admits a single claimant), emitting a JSON line per config to stderr and
-appending to SWEEP_RESULTS.jsonl.  Any config that beats the cached bench
-measurement updates BENCH_TPU_CACHE.json so `bench.py`'s last-known-good
-path reports the best number even if the tunnel wedges later.
+Grid of (size, micro, seq, remat, flash) 5-tuples.  The parent carries the
+same tunnel armor as bench.py (no jax import; probe subprocesses + backoff
+across a window via bench_common); the grid itself runs in ONE fresh child
+(the axon tunnel admits a single claimant), emitting a JSON line per
+config to stderr and appending to SWEEP_RESULTS.jsonl as it goes.
 
 Not part of the test suite — an operator tool for tuning bench.py's
 candidate list (the committed candidates should mirror the winners here).
@@ -25,11 +25,12 @@ def log(msg):
     print(f"[sweep] {msg}", file=sys.stderr, flush=True)
 
 
-def measure(size, micro, seq, remat, n_steps=10):
+def measure(size, micro, seq, remat, flash=False, n_steps=10):
     import jax
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model, gpt2
+    from deepspeed_tpu.ops.flash_attention import make_flash_attention
     from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
     from deepspeed_tpu.utils.timer import peak_flops_for
 
@@ -47,7 +48,8 @@ def measure(size, micro, seq, remat, n_steps=10):
     if remat:
         cfg["remat"] = {"enabled": True, "policy": remat}
     model_cfg = gpt2(size, max_seq=seq)
-    model = build_model(model_cfg)
+    model = build_model(model_cfg,
+                        attention_fn=make_flash_attention() if flash else None)
     engine = ds.initialize(cfg, model)
 
     data = random_token_dataset(engine.train_batch_size * 2, seq_len=seq,
@@ -68,34 +70,38 @@ def measure(size, micro, seq, remat, n_steps=10):
     mfu = tokens_per_sec * model_cfg.flops_per_token() / (
         peak_flops_for(devices[0]) * n_dev)
     return {"size": size, "micro": micro, "seq": seq, "remat": remat or "off",
-            "mfu": round(mfu, 4), "tokens_per_sec": round(tokens_per_sec),
+            "flash": flash, "mfu": round(mfu, 4),
+            "tokens_per_sec": round(tokens_per_sec),
             "step_ms": round(dt * 1000, 1)}
 
 
+# Round-3 sweep learnings: no-remat graphs crash the tunnel's remote
+# compile helper (HTTP 500 on every size tried), so the grid stays on
+# dots_saveable and explores batch/size/seq/flash instead.
 GRID = [
-    ("350m", 16, 512, None),
-    ("350m", 32, 512, None),
-    ("350m", 16, 1024, None),
-    ("774m", 8, 512, None),
-    ("774m", 16, 512, None),
-    ("774m", 8, 1024, None),
-    ("774m", 16, 512, "dots_saveable"),
-    ("1.5b", 4, 512, "dots_saveable"),
+    ("350m", 32, 512, "dots_saveable", False),
+    ("350m", 16, 512, "dots_saveable", True),
+    ("350m", 16, 1024, "dots_saveable", True),
+    ("774m", 16, 512, "dots_saveable", False),
+    ("774m", 8, 1024, "dots_saveable", True),
+    ("1.5b", 4, 512, "dots_saveable", False),
+    ("1.5b", 8, 512, "dots_saveable", True),
 ]
 
 
-def main():
+def _child_main():
     import jax
     if jax.devices()[0].platform != "tpu":
         raise SystemExit("sweep requires the real TPU")
     results = []
-    for size, micro, seq, remat in GRID:
-        log(f"config {size} mbs{micro} seq{seq} remat={remat or 'off'}")
+    for size, micro, seq, remat, flash in GRID:
+        log(f"config {size} mbs{micro} seq{seq} remat={remat or 'off'} "
+            f"flash={flash}")
         try:
-            r = measure(size, micro, seq, remat)
+            r = measure(size, micro, seq, remat, flash)
         except Exception as e:
             r = {"size": size, "micro": micro, "seq": seq,
-                 "remat": remat or "off",
+                 "remat": remat or "off", "flash": flash,
                  "error": f"{type(e).__name__}: {str(e)[:200]}"}
         log(json.dumps(r))
         results.append(r)
@@ -104,10 +110,30 @@ def main():
         gc.collect()
         jax.clear_caches()
     ok = [r for r in results if "mfu" in r]
-    if ok:
-        best = max(ok, key=lambda r: r["mfu"])
-        log(f"BEST: {json.dumps(best)}")
-        print(json.dumps(best), flush=True)
+    best = max(ok, key=lambda r: r["mfu"]) if ok else None
+    log(f"BEST: {json.dumps(best)}")
+    # ALWAYS print a summary line: an empty stdout makes the armored parent
+    # treat the run as a failed claim and re-run the whole grid on a loop.
+    print(json.dumps({"grid_done": len(results), "best": best}), flush=True)
+
+
+def main():
+    """Same tunnel armor as bench.py: the parent never imports jax; it
+    probes from throwaway subprocesses across a window, then runs the grid
+    in a fresh child (results stream to SWEEP_RESULTS.jsonl either way)."""
+    if os.environ.get("_DSTPU_SWEEP_CHILD") == "1":
+        _child_main()
+        return
+    import bench_common as bc
+
+    env = dict(os.environ)
+    env["_DSTPU_SWEEP_CHILD"] = "1"
+    result = bc.run_with_tpu_window(
+        os.path.abspath(__file__), env,
+        window_s=float(os.environ.get("DSTPU_SWEEP_WINDOW_S", 40 * 60)),
+        child_timeout=3600, tag="sweep")
+    if result is not None:
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -165,6 +165,12 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
     schedule/eos logic cannot drift between them. ``sampler(logits, rng)``
     -> (B,) int32.
     """
+    objective = getattr(model.cfg, "objective", "clm")
+    if objective != "clm":
+        raise ValueError(
+            f"generation needs a causal LM head; this model's objective is "
+            f"{objective!r} — use forward() (MLM logits / feature hidden "
+            "states) instead")
     B, S = input_ids.shape
     cache = init_cache(model.cfg, B, S + max_new, cache_dtype or model.cfg.dtype)
     eos = eos_token_id

@@ -118,6 +118,14 @@ class InferenceEngine:
         ``rng`` explicitly for reproducibility). One program is compiled per
         (shape, knobs) tuple and kept in a bounded LRU.
         """
+        # Non-CLM guard lives in generate_tokens (shared with HybridEngine);
+        # re-check here so the error surfaces before a jit trace is built.
+        objective = getattr(getattr(self.model, "cfg", None), "objective", "clm")
+        if objective != "clm":
+            raise ValueError(
+                f"generate() needs a causal LM head; this model's objective "
+                f"is {objective!r} — use forward() (MLM logits / feature "
+                "hidden states) instead")
         input_ids = jnp.asarray(input_ids, jnp.int32)
         max_new = int(max_new_tokens or self.config.max_out_tokens)
         key = (input_ids.shape, max_new, float(temperature), int(top_k),

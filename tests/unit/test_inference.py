@@ -215,3 +215,29 @@ def test_int4_odd_dim_degrades_to_int8():
     assert q.bits == 8 and q.q.shape == w.shape
     err = float(jnp.max(jnp.abs(dequantize(q, jnp.float32) - w)))
     assert err < float(jnp.max(jnp.abs(w))) / 64
+
+
+def test_feature_tower_serves_forward_and_guards_generate():
+    """init_inference serves a feature tower (CLIP-style) via forward()
+    -> hidden states; generate() fails loudly instead of sampling from
+    hidden dims."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest as _pytest
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerConfig, build_model
+
+    cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                            max_seq=16, objective="feature",
+                            tie_embeddings=False, activation="quick_gelu",
+                            dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.key(0))
+    eng = ds.init_inference(model, params, {"dtype": "float32"})
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 8)),
+                      jnp.int32)
+    feats = np.asarray(eng.forward(ids))
+    assert feats.shape == (2, 8, 32) and np.isfinite(feats).all()
+    with _pytest.raises(ValueError, match="feature"):
+        eng.generate(ids, 4)
