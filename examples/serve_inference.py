@@ -10,7 +10,10 @@ decode loop per (shape, knobs). Three surfaces:
 2. TP=2 sharded serving, and int8 weight-only quantized serving,
 3. forward() on a feature tower (CLIP-text-style) -> hidden states.
 
-Run: DSTPU_EXAMPLE_SMOKE=1 python examples/serve_inference.py
+Run: DSTPU_EXAMPLE_SMOKE=1 JAX_PLATFORMS=cpu \
+     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/serve_inference.py
+(on a TPU pod slice, run unmodified — the mesh sizes to the real chips)
 """
 
 import numpy as np
@@ -35,11 +38,15 @@ out = np.asarray(engine.generate(prompt, max_new_tokens=8,
                                  temperature=0.8, top_p=0.9))
 print(f"sampled continuation shape {out.shape}")
 
-# 2a. TP=2 sharded serving ------------------------------------------------
-tp_engine = ds.init_inference(lm, params, {"dtype": "float32",
-                                           "tensor_parallel": 2})
-tp_out = np.asarray(tp_engine.generate(prompt, max_new_tokens=8, greedy=True))
-print(f"TP=2 continuation shape {tp_out.shape}")
+# 2a. TP=2 sharded serving (needs an even device count) -------------------
+if jax.device_count() % 2 == 0:
+    tp_engine = ds.init_inference(lm, params, {"dtype": "float32",
+                                               "tensor_parallel": 2})
+    tp_out = np.asarray(tp_engine.generate(prompt, max_new_tokens=8,
+                                           greedy=True))
+    print(f"TP=2 continuation shape {tp_out.shape}")
+else:
+    print(f"skipping TP=2 (device count {jax.device_count()} is odd)")
 
 # 2b. int8 weight-only quantized serving (single shard: WOQ+TP pending) ---
 q_engine = ds.init_inference(lm, params, {
