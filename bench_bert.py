@@ -26,15 +26,7 @@ _OUT = os.path.join(_ROOT, "BERT_BENCH.json")
 _CACHE = os.path.join(_ROOT, "BERT_BENCH_TPU_CACHE.json")
 
 
-def _mlm_batch(rng, B, S, vocab, mask_frac=0.15):
-    import numpy as np
-
-    labels = rng.integers(0, vocab, (B, S), dtype=np.int32)
-    mask = rng.random((B, S)) < mask_frac
-    ids = labels.copy()
-    ids[mask] = 103                      # [MASK]
-    return {"input_ids": ids, "labels": labels,
-            "loss_mask": mask.astype("float32")}
+_mlm_batch = bc.mlm_batch
 
 
 def _run_workload():
@@ -115,12 +107,7 @@ def _measure(size, micro, seq, n_steps, devices, on_tpu):
               "value": round(mfu, 4), "unit": unit,
               "vs_baseline": round(mfu / 0.512, 4)}
     if on_tpu:
-        payload = {"result": result, "ts": time.time(),
-                   "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
-        tmp = _CACHE + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f, indent=2)
-        os.replace(tmp, _CACHE)
+        bc.save_tpu_cache(_CACHE, result)
     print(json.dumps(result), flush=True)
 
 
@@ -134,15 +121,14 @@ def main():
     result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
                                     child_timeout=1800, tag="bert-bench")
     if result is None:
-        try:
-            with open(_CACHE) as f:
-                payload = json.load(f)
+        payload = bc.load_tpu_cache(_CACHE, tag="bert-bench")
+        if payload is not None:
             result = dict(payload["result"])
             result["unit"] = (result["unit"].rstrip(")")
                               + f", last-known-good cached {payload['iso']})")
             bc.log("TPU unavailable; reporting cached measurement",
                    "bert-bench")
-        except (OSError, json.JSONDecodeError, KeyError):
+        else:
             bc.log("TPU unavailable and no cache; CPU fallback", "bert-bench")
             result = bc.run_child(me, bc.cpu_fallback_env(env), timeout=900,
                                   tag="bert-bench")

@@ -20,6 +20,39 @@ import time
 
 PROBE_TIMEOUT_S = 240   # a draining tunnel can take minutes to grant
 
+# Version of the model-FLOPs formula behind every cached MFU number.
+# v2: + 6*d*V logit-projection term (Megatron model-FLOPs convention) and
+# the T5 enc/dec split. A last-known-good cache written under a different
+# formula is NOT comparable to fresh runs and must be discarded, not
+# replayed (the vs_baseline anchor would silently shift meaning).
+FLOPS_FORMULA_VERSION = 2
+
+
+def save_tpu_cache(path: str, result: dict) -> None:
+    """Persist a successful TPU measurement immediately (atomic rename)."""
+    payload = {"result": result, "ts": time.time(),
+               "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "flops_formula": FLOPS_FORMULA_VERSION}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, path)
+
+
+def load_tpu_cache(path: str, tag: str = "bench"):
+    """Last-known-good TPU measurement, or None if absent/stale-formula."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if payload.get("flops_formula") != FLOPS_FORMULA_VERSION:
+        log(f"discarding cached measurement ({path}): FLOPs formula "
+            f"v{payload.get('flops_formula')} != v{FLOPS_FORMULA_VERSION}",
+            tag)
+        return None
+    return payload if isinstance(payload.get("result"), dict) else None
+
 
 def log(msg: str, tag: str = "bench") -> None:
     print(f"[{tag}] {msg}", file=sys.stderr, flush=True)
@@ -130,3 +163,18 @@ def cpu_fallback_env(env: dict, n_devices: int = 8) -> dict:
     cpu_env["XLA_FLAGS"] = (
         flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
     return cpu_env
+
+
+def mlm_batch(rng, batch_size: int, seq: int, vocab: int,
+              mask_frac: float = 0.15, mask_id: int = 103):
+    """BERT-style MLM batch: random labels, mask_frac positions replaced by
+    [MASK] (id 103, BERT's real mask token). Shared by bench.py and
+    bench_bert.py so the two entry points measure the same workload."""
+    import numpy as np
+
+    labels = rng.integers(0, vocab, (batch_size, seq), dtype=np.int32)
+    mask = rng.random((batch_size, seq)) < mask_frac
+    ids = labels.copy()
+    ids[mask] = mask_id
+    return {"input_ids": ids, "labels": labels,
+            "loss_mask": mask.astype(np.float32)}

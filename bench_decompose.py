@@ -1,0 +1,89 @@
+"""Operator tool: decompose the main-bench train step's time on the TPU.
+
+Times, for the bench.py flagship config (GPT-2-350M, micro 16, seq 512,
+ZeRO-1, dots_saveable remat):
+  trunk_fwd — forward hidden states only (no lm-head matmul, no xent)
+  fwd       — full forward loss
+  grad      — loss + backward (no optimizer)
+  step      — full train_batch (fwd+bwd+optimizer+clip)
+Deltas localize the budget: lm-head+xent fwd = fwd - trunk_fwd;
+backward = grad - fwd; optimizer+clip+cast = step - grad.
+
+Not part of the test suite; run when the TPU is known up (exits if not).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, *args, n=10):
+    out = fn(*args)                      # compile
+    _ = float(jnp.sum(jax.tree.leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    # host readback is the barrier (axon tunnel: block_until_ready is early)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0]))
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", "needs the real TPU"
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, gpt2
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+    from deepspeed_tpu.runtime.engine import _remat_policy
+
+    micro, seq = 16, 512
+    cfg = {
+        "train_batch_size": micro,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 1},
+        "remat": {"enabled": True, "policy": "dots_saveable"},
+    }
+    model_cfg = gpt2("350m", max_seq=seq)
+    model = build_model(model_cfg)
+    engine = ds.initialize(cfg, model)
+    policy = _remat_policy(engine.config)
+    data = random_token_dataset(micro * 2, seq_len=seq,
+                                vocab_size=model_cfg.vocab_size)
+    batch = DataLoader(data, local_batch_size=micro,
+                       shuffle=False).collate_fn(data[:micro])
+
+    res = {}
+    res["step_ms"] = timed(lambda b: engine.train_batch(b)["loss"], batch) * 1e3
+
+    with jax.set_mesh(engine.mesh):
+        cp = jax.jit(engine._cast_compute)(engine.state.master_params)
+        cp = jax.tree.map(lambda x: x.copy(), cp)   # detach from donated state
+
+        loss_j = jax.jit(lambda p, b: model.loss(p, b, remat_policy=policy))
+        res["fwd_ms"] = timed(loss_j, cp, batch) * 1e3
+
+        grad_j = jax.jit(jax.value_and_grad(
+            lambda p, b: model.loss(p, b, remat_policy=policy)))
+        res["grad_ms"] = timed(lambda p, b: grad_j(p, b)[0], cp, batch) * 1e3
+
+        feat_cfg = gpt2("350m", max_seq=seq, objective="feature")
+        feat = build_model(feat_cfg)
+        fp = jax.jit(feat.init)(jax.random.PRNGKey(0))
+        fp = jax.tree.map(lambda x: x.astype(jnp.bfloat16), fp)
+        trunk_j = jax.jit(lambda p, ids: feat.apply(p, ids, remat_policy=policy))
+        res["trunk_fwd_ms"] = timed(trunk_j, fp, batch["input_ids"]) * 1e3
+
+    res = {k: round(v, 1) for k, v in res.items()}
+    res["head_xent_fwd_ms"] = round(res["fwd_ms"] - res["trunk_fwd_ms"], 1)
+    res["bwd_ms"] = round(res["grad_ms"] - res["fwd_ms"], 1)
+    res["opt_ms"] = round(res["step_ms"] - res["grad_ms"], 1)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..platform.mesh import BATCH_AXES, constrain
-from .transformer import _norm, vocab_parallel_lookup
+from .transformer import _norm, _token_nll, vocab_parallel_lookup
 
 B_AXES = BATCH_AXES
 
@@ -53,22 +53,53 @@ class T5Config:
     pad_token_id: int = 0
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
+    # Nominal sequence lengths for FLOPs/MFU accounting only (runtime
+    # shapes come from the batch): typical span-corruption pretraining.
+    max_src: int = 512
+    max_tgt: int = 114
 
     @property
     def inner_dim(self) -> int:
         return self.n_head * self.d_kv
 
-    def flops_per_token(self) -> float:
-        n = self.param_count(non_embedding=True)
-        return 6 * n
+    @property
+    def max_seq(self) -> int:
+        """Total counted tokens per sample (engine throughput accounting
+        multiplies flops_per_token() by this)."""
+        return self.max_src + self.max_tgt
 
-    def param_count(self, non_embedding: bool = False) -> int:
+    def flops_per_sample(self) -> float:
+        """Fwd+bwd model FLOPs per (max_src, max_tgt) sample — Megatron
+        convention, but split enc/dec: encoder params touch only source
+        tokens, decoder params (and the logit projection) only target
+        tokens, and attention counts self/self/cross separately."""
+        d, inner, V = self.d_model, self.inner_dim, self.vocab_size
+        S, T = self.max_src, self.max_tgt
+        n_enc, n_dec = self._trunk_param_split()
+        # cross-attention K/V projections (2*d*inner per decoder layer) run
+        # over the S encoder outputs, not the T decoder positions — count
+        # them at S and back them out of the T-scaled decoder trunk
+        cross_kv = self.n_dec_layer * 2 * d * inner
+        trunk = 6 * (n_enc * S + (n_dec - cross_kv) * T + cross_kv * S)
+        attn = 12 * inner * (self.n_layer * S * S
+                             + self.n_dec_layer * (T * T + S * T))
+        head = 6 * d * V * T
+        return trunk + attn + head
+
+    def flops_per_token(self) -> float:
+        return self.flops_per_sample() / self.max_seq
+
+    def _trunk_param_split(self) -> tuple[int, int]:
         d, inner, ff = self.d_model, self.inner_dim, self.d_ff
         attn = 3 * d * inner + inner * d
         ffn = d * ff * (3 if self.gated_ffn else 2)
         enc = self.n_layer * (attn + ffn)
         dec = self.n_dec_layer * (2 * attn + ffn)
-        emb = 0 if non_embedding else self.vocab_size * d
+        return enc, dec
+
+    def param_count(self, non_embedding: bool = False) -> int:
+        enc, dec = self._trunk_param_split()
+        emb = 0 if non_embedding else self.vocab_size * self.d_model
         return enc + dec + emb
 
 
@@ -327,9 +358,8 @@ class T5Model:
         logits = self.apply(params, batch["input_ids"], dec_ids,
                             attention_mask=batch.get("attention_mask"),
                             remat_policy=remat_policy)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         safe = jnp.maximum(labels, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll = _token_nll(logits, safe)
         mask = batch.get("loss_mask")
         w = (mask.astype(jnp.float32) if mask is not None
              else (labels != -100).astype(jnp.float32))

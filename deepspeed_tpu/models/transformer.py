@@ -103,13 +103,22 @@ class TransformerConfig:
         return self.activation.endswith("glu")
 
     def flops_per_token(self) -> float:
-        """6*N_active matmul FLOPs per token + attention term (MFU accounting).
+        """Fwd+bwd model FLOPs per token for MFU accounting (Megatron
+        convention): 6*N_active trunk matmul FLOPs + the attention
+        score/value term (12*L*d*S) + the output-logit projection
+        (6*d*V) — the unembedding is a real (B*S, d) x (d, V) matmul on
+        the MXU, so omitting it (as pure-6N accounting does) under-reports
+        achieved FLOPs; Megatron's model-FLOPs formula includes the logit
+        layer explicitly. The token-embedding *lookup* is a gather, not a
+        matmul, and stays excluded.
 
         For MoE only the ``moe_top_k`` routed experts do work per token, so
         FLOPs use the *active* parameter count, not the total bank size."""
         n_params = self.param_count(non_embedding=True, active_only=True)
         attn = 12 * self.n_layer * self.d_model * self.max_seq
-        return 6 * n_params + attn
+        head = (0 if self.objective == "feature"
+                else 6 * self.d_model * self.vocab_size)
+        return 6 * n_params + attn + head
 
     def _ffn_params_per_layer(self, active_only: bool = False) -> int:
         d, f, E = self.d_model, self.ffn_dim, self.num_experts
@@ -241,6 +250,25 @@ def alibi_slopes(n_head: int) -> jnp.ndarray:
         extra = pow2_slopes(2 * closest)[0::2][:n_head - closest]
         slopes += extra
     return jnp.asarray(slopes, jnp.float32)
+
+
+def _token_nll_impl(logits, targets):
+    """Per-token NLL in fp32 without materializing a (B, S, V) fp32 tensor:
+    nll = logsumexp(logits) - logit[target]. The bf16→fp32 cast and exp
+    fuse into a single reduction pass over V (log_softmax + take_along_axis
+    instead writes the full fp32 log-probability cube — ~2x the head's HBM
+    traffic at GPT-2 vocab sizes)."""
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    se = jnp.sum(jnp.exp((logits - m).astype(jnp.float32)), axis=-1)
+    lse = jnp.log(se) + m[..., 0].astype(jnp.float32)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return lse - tgt.astype(jnp.float32)
+
+
+# checkpoint: the backward recomputes exp(shifted) fused into the
+# d_logits = softmax - onehot epilogue instead of saving it as a resident
+# (B, S, V) fp32 tensor between forward and backward.
+_token_nll = jax.checkpoint(_token_nll_impl)
 
 
 def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None,
@@ -623,17 +651,14 @@ class TransformerLM:
                                  remat_policy=remat_policy, return_aux=True)
         if self.cfg.objective == "mlm":
             labels = batch["labels"]
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+            nll = _token_nll(logits, labels)
             mask = batch["loss_mask"].astype(jnp.float32)
             ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
             if self.cfg.num_experts > 1:
                 ce = ce + self.cfg.moe_aux_loss_weight * aux
             return ce
         targets = ids[:, 1:]
-        logits = logits[:, :-1].astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        nll = _token_nll(logits[:, :-1], targets)
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, 1:].astype(jnp.float32)

@@ -97,6 +97,7 @@ class Engine:
         self._ltd = de.random_ltd if de.random_ltd.enabled else None
         self._ltd_tokens = -1
         self._warned_device_batch = False
+        self._flops_nominal_checked = False
         self._comp = self.config.compression.enabled_techniques()
         if self._comp:
             from ..compression import convert_to_compressed
@@ -857,6 +858,33 @@ class Engine:
                    "loss_scale": scale, "skipped": skipped}
         return new_state, metrics
 
+    def _check_flops_nominal(self, batch: dict) -> None:
+        """One-time honesty check on MFU accounting: flops_per_sample is
+        computed from the model config's *nominal* lengths (max_seq, or
+        max_src/max_tgt for encoder-decoder), so if the actual batches
+        carry a different token count the reported TFLOPS/MFU scale with
+        the mismatch. Warn loudly rather than silently report wrong MFU
+        (the headline number must not depend on a config default)."""
+        if self._flops_nominal_checked:
+            return
+        self._flops_nominal_checked = True
+        cfg = getattr(self.model, "cfg", None)
+        nominal = getattr(cfg, "max_seq", None) if cfg is not None else None
+        ids = batch.get("input_ids") if isinstance(batch, dict) else None
+        if not nominal or ids is None or getattr(ids, "ndim", 0) < 2:
+            return
+        actual = ids.shape[-1]
+        labels = batch.get("labels")
+        if hasattr(cfg, "max_src") and getattr(labels, "ndim", 0) >= 2:
+            actual += labels.shape[-1]   # encoder-decoder: separate targets
+        if actual != nominal:
+            log_dist(
+                f"WARNING: MFU/TFLOPS accounting assumes {nominal} "
+                f"tokens/sample (model config nominal lengths) but batches "
+                f"carry {actual}; reported MFU is off by ~{nominal/actual:.2f}x "
+                "— set max_seq (or max_src/max_tgt) to the real lengths",
+                ranks=[0])
+
     def _eval_step_impl(self, master_params, batch: dict):
         cp = self._cast_compute(master_params)
         if self._ltd is not None:
@@ -942,6 +970,7 @@ class Engine:
         """One optimizer step over train_batch_size samples (micro-stepping,
         grad accumulation, and the update are all inside the compiled step;
         in offload mode the update runs on the host optimizer instead)."""
+        self._check_flops_nominal(batch)
         if self.offload:
             return self._train_batch_offload(batch)
         self.throughput.start()

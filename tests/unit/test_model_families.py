@@ -123,3 +123,60 @@ def test_encoder_rejects_custom_attention_and_pipeline():
         TransformerLM(bloom("tiny"), attention_fn=make_flash_attention())
     with pytest.raises(ValueError, match="pipeline|MLM"):
         PipelinedTransformerLM(bert("tiny", n_layer=4), n_stages=2)
+
+
+# ------------------------------------------------- FLOPs/MFU accounting
+def test_flops_accounting_counts_logit_projection():
+    """Megatron model-FLOPs convention: the unembedding matmul (6*d*V
+    fwd+bwd) is counted for models that compute logits, and excluded for
+    feature towers (whose apply() never runs the head)."""
+    from deepspeed_tpu.models import gpt2
+
+    clm = gpt2("125m", max_seq=512)
+    feat = gpt2("125m", max_seq=512, objective="feature")
+    head = 6 * clm.d_model * clm.vocab_size
+    assert clm.flops_per_token() - feat.flops_per_token() == head
+
+
+def test_t5_flops_head_counted_on_decoder_tokens_only():
+    """Encoder tokens never touch the logit matmul: the head term scales
+    with max_tgt, not max_src, and per-sample = per-token * max_seq (the
+    engine contract)."""
+    from deepspeed_tpu.models.t5 import T5Config
+
+    cfg = T5Config(max_src=512, max_tgt=114)
+    assert cfg.flops_per_sample() == pytest.approx(
+        cfg.flops_per_token() * cfg.max_seq)
+    # growing the vocab adds exactly 6*d*dV*max_tgt — the logit matmul
+    # runs per decoder token, and never per encoder token (same delta at
+    # a different max_src)
+    for src in (512, 1024):
+        a = T5Config(max_src=src, max_tgt=114)
+        b = T5Config(max_src=src, max_tgt=114, vocab_size=cfg.vocab_size + 1000)
+        assert b.flops_per_sample() - a.flops_per_sample() == pytest.approx(
+            6 * cfg.d_model * 1000 * 114)
+
+
+def test_token_nll_matches_log_softmax_and_grads():
+    """The HBM-lean logsumexp NLL is numerically the log_softmax NLL, for
+    values and gradients (bf16 logits, extreme magnitudes included)."""
+    from deepspeed_tpu.models.transformer import _token_nll
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(
+        rng.normal(0, 8, (2, 16, 97)).astype(np.float32)).astype(jnp.bfloat16)
+    targets = jnp.asarray(rng.integers(0, 97, (2, 16), dtype=np.int32))
+
+    def naive(lg, t):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+
+    a = _token_nll(logits, targets)
+    b = naive(logits, targets)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+    ga = jax.grad(lambda lg: jnp.sum(_token_nll(lg, targets)))(logits)
+    gb = jax.grad(lambda lg: jnp.sum(naive(lg, targets)))(logits)
+    np.testing.assert_allclose(np.asarray(ga, dtype=np.float32),
+                               np.asarray(gb, dtype=np.float32),
+                               rtol=1e-2, atol=1e-2)
