@@ -236,6 +236,44 @@ def _llama_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
     return params
 
 
+# --------------------------------------------------------- family: internlm
+def _internlm_config(hf: dict) -> TransformerConfig:
+    """InternLM v1 (reference ``module_inject/containers/internlm.py``): a
+    Llama block whose attention projections carry biases (config
+    ``"bias": true``)."""
+    cfg = _llama_config(hf)
+    if hf.get("bias", True):
+        cfg = TransformerConfig(**{**cfg.__dict__, "use_bias": True})
+    return cfg
+
+
+def _internlm_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Llama mapping + attention biases. The q/k biases feed pre-RoPE
+    activations, so they get the same interleave basis change as the wq/wk
+    columns. The trunk's use_bias is all-or-nothing; InternLM has no
+    rmsnorm/FFN biases, so those leaves are zeros (numeric no-ops)."""
+    params = _llama_convert(sd, cfg)
+    if not cfg.use_bias:
+        return params
+    hd = cfg.head_dim
+    perms = {"q_proj": _rope_interleave_perm(cfg.n_head, hd),
+             "k_proj": _rope_interleave_perm(cfg.kv_heads, hd)}
+    layers = params["layers"]
+    for name, leaf in (("q_proj", "bq"), ("k_proj", "bk"),
+                       ("v_proj", "bv"), ("o_proj", "bo")):
+        rows = np.stack([sd.take(f"layers.{i}.self_attn.{name}.bias")
+                         for i in range(cfg.n_layer)])
+        perm = perms.get(name)
+        layers[leaf] = rows[:, perm] if perm is not None else rows
+    L, d, f = cfg.n_layer, cfg.d_model, cfg.ffn_dim
+    layers["ln1_bias"] = np.zeros((L, d), np.float32)
+    layers["ln2_bias"] = np.zeros((L, d), np.float32)
+    layers["b_in"] = np.zeros((L, f), np.float32)
+    layers["b_out"] = np.zeros((L, d), np.float32)
+    params["lnf_bias"] = np.zeros((d,), np.float32)
+    return params
+
+
 # -------------------------------------------------------------- family: opt
 def _opt_config(hf: dict) -> TransformerConfig:
     if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
@@ -1154,6 +1192,7 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     # model_type → (config_fn, convert_fn, state-dict prefixes to strip)
     "gpt2": (_gpt2_config, _gpt2_convert, ("transformer.",)),
     "llama": (_llama_config, _llama_convert, ("model.",)),
+    "internlm": (_internlm_config, _internlm_convert, ("model.",)),
     "mistral": (_llama_config, _llama_convert, ("model.",)),
     "mixtral": (_llama_config, _llama_convert, ("model.",)),
     "opt": (_opt_config, _opt_convert, ("model.decoder.", "decoder.")),
@@ -1202,7 +1241,10 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
         return "phi"
     if any("self_attn.q_proj.bias" in k for k in keys) and \
             any("mlp.gate_proj" in k for k in keys):
-        return "qwen2"
+        # qwen2 biases q/k/v only; internlm v1 also biases o_proj
+        return ("internlm"
+                if any("self_attn.o_proj.bias" in k for k in keys)
+                else "qwen2")
     if any("language_model" in k for k in keys) and \
             any("self_attention.query_key_value" in k for k in keys):
         # both anchors: multimodal HF checkpoints (LLaVA-style) also prefix

@@ -149,28 +149,55 @@ PEAK_FLOPS_BY_PLATFORM = {
 }
 
 
-def peak_flops_for(device) -> float:
-    """Per-chip peak bf16 FLOP/s for MFU accounting.
+# Peak HBM bandwidth per chip (bytes/s), for decode MBU accounting
+# (autoregressive decode is bandwidth-bound: every generated token re-reads
+# the weights, so tokens/s * bytes-read-per-token / peak-BW is the honest
+# utilization metric — the decode analog of MFU).
+PEAK_HBM_BW_BY_PLATFORM = {
+    "tpu": {
+        "v4": 1228e9,
+        "v5 lite": 819e9,   # v5e
+        "v5": 2765e9,       # v5p
+        "v6 lite": 1640e9,  # trillium
+    },
+    "cpu": {"default": 50e9},
+    "gpu": {"default": 2039e9},
+}
 
-    MFU is the product's headline number, so an unknown TPU generation must
-    fail loudly rather than silently divide by a guessed peak (which would
-    report a wrong MFU as fact).  Override with ``DSTPU_PEAK_FLOPS`` when
-    running on hardware this table predates.
-    """
-    override = os.environ.get("DSTPU_PEAK_FLOPS")
+
+def _peak_lookup(device, tables: dict, env_var: str, what: str) -> float:
+    """Shared per-chip peak lookup for utilization accounting. MFU/MBU are
+    the product's headline numbers, so an unknown TPU generation must fail
+    loudly rather than silently divide by a guessed peak; override with the
+    named env var when running on hardware the table predates."""
+    override = os.environ.get(env_var)
     if override:
         return float(override)
-    table = PEAK_FLOPS_BY_PLATFORM.get(device.platform)
+    table = tables.get(device.platform)
     if table is None:
         raise ValueError(
-            f"no peak-FLOPs entry for platform {device.platform!r}; set "
-            "DSTPU_PEAK_FLOPS=<per-chip peak FLOP/s> to report MFU honestly")
+            f"no {what} entry for platform {device.platform!r}; set "
+            f"{env_var}=<per-chip value> to report utilization honestly")
     kind = getattr(device, "device_kind", "").lower()
     for key, val in table.items():
         if key != "default" and key in kind:
             return val
     if device.platform == "tpu":
         raise ValueError(
-            f"unknown TPU generation {kind!r} — refusing to guess a peak for "
-            "MFU; set DSTPU_PEAK_FLOPS=<per-chip peak FLOP/s>")
+            f"unknown TPU generation {kind!r} — refusing to guess {what}; "
+            f"set {env_var}=<per-chip value>")
     return table["default"]
+
+
+def peak_hbm_bw_for(device) -> float:
+    """Per-chip peak HBM bandwidth (bytes/s) for decode-MBU accounting.
+    Override: ``DSTPU_PEAK_HBM_BW``."""
+    return _peak_lookup(device, PEAK_HBM_BW_BY_PLATFORM,
+                        "DSTPU_PEAK_HBM_BW", "HBM bandwidth")
+
+
+def peak_flops_for(device) -> float:
+    """Per-chip peak bf16 FLOP/s for MFU accounting.
+    Override: ``DSTPU_PEAK_FLOPS``."""
+    return _peak_lookup(device, PEAK_FLOPS_BY_PLATFORM,
+                        "DSTPU_PEAK_FLOPS", "peak FLOPs")
