@@ -207,14 +207,8 @@ def main() -> None:
         _save_cache(result)  # parent-side too, in case an old child lacks it
 
     if result is None:
-        cached = _load_cache()
-        if cached is not None:
-            bc.log(f"TPU unavailable for the whole window; reporting "
-                   f"last-known-good TPU measurement from {cached['iso']}")
-            result = dict(cached["result"])
-            result["unit"] = (result["unit"].rstrip(")")
-                              + f", last-known-good cached {cached['iso']})")
-        else:
+        result = bc.cached_result(_CACHE_PATH)
+        if result is None:
             bc.log("TPU unavailable and no cached TPU measurement; "
                    "falling back to virtual CPU")
             result = bc.run_child(me, bc.cpu_fallback_env(child_env),

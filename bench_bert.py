@@ -121,14 +121,8 @@ def main():
     result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
                                     child_timeout=1800, tag="bert-bench")
     if result is None:
-        payload = bc.load_tpu_cache(_CACHE, tag="bert-bench")
-        if payload is not None:
-            result = dict(payload["result"])
-            result["unit"] = (result["unit"].rstrip(")")
-                              + f", last-known-good cached {payload['iso']})")
-            bc.log("TPU unavailable; reporting cached measurement",
-                   "bert-bench")
-        else:
+        result = bc.cached_result(_CACHE, tag="bert-bench")
+        if result is None:
             bc.log("TPU unavailable and no cache; CPU fallback", "bert-bench")
             result = bc.run_child(me, bc.cpu_fallback_env(env), timeout=900,
                                   tag="bert-bench")

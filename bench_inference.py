@@ -115,14 +115,8 @@ def main():
     result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
                                     child_timeout=1800, tag="infer-bench")
     if result is None:
-        payload = bc.load_tpu_cache(_CACHE, tag="infer-bench")
-        if payload is not None:
-            result = dict(payload["result"])
-            result["unit"] = (result["unit"].rstrip(")")
-                              + f", last-known-good cached {payload['iso']})")
-            bc.log("TPU unavailable; reporting cached measurement",
-                   "infer-bench")
-        else:
+        result = bc.cached_result(_CACHE, tag="infer-bench")
+        if result is None:
             bc.log("TPU unavailable and no cache; CPU fallback", "infer-bench")
             result = bc.run_child(me, bc.cpu_fallback_env(env), timeout=1800,
                                   tag="infer-bench")

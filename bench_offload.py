@@ -23,6 +23,8 @@ _CHILD_MARK = "_DSTPU_OFFBENCH_CHILD"
 _WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 15 * 60))
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "OFFLOAD_BENCH.json")
+_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "OFFLOAD_BENCH_TPU_CACHE.json")
 
 
 def _run_workload():
@@ -88,6 +90,8 @@ def _run_workload():
             n_params * jnp_dtype_size(engine.compute_dtype)),  # compute copy
         "host_state_bytes": int(n_params * 4 * 3),  # fp32 master + 2 moments
     }
+    if on_tpu:
+        bc.save_tpu_cache(_CACHE, result)
     print(json.dumps(result), flush=True)
 
 
@@ -101,7 +105,10 @@ def main():
     result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
                                     child_timeout=1500, tag="offload-bench")
     if result is None:
-        bc.log("TPU unavailable; falling back to virtual CPU", "offload-bench")
+        result = bc.cached_result(_CACHE, tag="offload-bench")
+    if result is None:
+        bc.log("TPU unavailable and no cache; falling back to virtual CPU",
+               "offload-bench")
         result = bc.run_child(me, bc.cpu_fallback_env(env), timeout=900,
                               tag="offload-bench")
     if result is None:

@@ -1,0 +1,88 @@
+"""Operator probe: does in-loop dequantization save decode HBM traffic?
+
+Decode is weight-re-read bound. If XLA fuses an int8->bf16 convert into
+the matmul operand load inside a scanned decode loop, keeping weights
+int8 in HBM halves traffic (true WOQ decode, the reference's in-kernel
+dequantize design, csrc/transformer/inference). If XLA instead hoists
+the loop-invariant convert out of the scan, the bf16 copy gets
+materialized once and re-read — no bandwidth win.
+
+Measures a weight-stationary scan: y_{t+1} = tanh(y_t @ W) with
+(a) W bf16, (b) W int8 dequantized inside the body, (c) W int8 with the
+matmul in mixed precision via lax.dot_general preferred_element_type.
+W is 64 MiB bf16 so the loop is firmly HBM-bound; if (b) or (c) runs
+~2x faster than (a), the convert fused and product WOQ-decode is worth
+building. Prints one JSON line; run when the TPU is known up.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed(fn, *args, n=5):
+    out = fn(*args)
+    _ = float(jnp.sum(out))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _ = float(jnp.sum(out))          # host readback barrier (axon tunnel)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu"
+    d, steps = 4096, 64
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (d, 2 * d), jnp.float32) / (d ** 0.5)
+    w_bf16 = w.astype(jnp.bfloat16)
+    scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+    w_q = jnp.round(w / scale).astype(jnp.int8)
+    x = jax.random.normal(key, (8, d), jnp.bfloat16)
+
+    @jax.jit
+    def run_bf16(x, w):
+        def body(y, _):
+            y = jnp.tanh(y @ w)[:, :d].astype(jnp.bfloat16)
+            return y, ()
+        y, _ = lax.scan(body, x, None, length=steps)
+        return y
+
+    @jax.jit
+    def run_dequant_in_loop(x, wq, s):
+        def body(y, _):
+            wd = wq.astype(jnp.bfloat16) * s.astype(jnp.bfloat16)
+            y = jnp.tanh(y @ wd)[:, :d].astype(jnp.bfloat16)
+            return y, ()
+        y, _ = lax.scan(body, x, None, length=steps)
+        return y
+
+    @jax.jit
+    def run_mixed_dot(x, wq, s):
+        def body(y, _):
+            acc = lax.dot_general(y, wq, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            y = jnp.tanh(acc * s)[:, :d].astype(jnp.bfloat16)
+            return y, ()
+        y, _ = lax.scan(body, x, None, length=steps)
+        return y
+
+    res = {
+        "bf16_ms": round(timed(run_bf16, x, w_bf16) * 1e3, 2),
+        "dequant_in_loop_ms": round(timed(run_dequant_in_loop, x, w_q,
+                                          scale) * 1e3, 2),
+        "mixed_dot_ms": round(timed(run_mixed_dot, x, w_q, scale) * 1e3, 2),
+        "steps": steps, "w_mib_bf16": d * 2 * d * 2 / 2**20,
+    }
+    res["verdict"] = ("fused: in-loop int8 saves decode bandwidth"
+                      if min(res["dequant_in_loop_ms"], res["mixed_dot_ms"])
+                      < 0.75 * res["bf16_ms"]
+                      else "hoisted/not-fused: no decode bandwidth win")
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

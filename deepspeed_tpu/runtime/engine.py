@@ -158,12 +158,10 @@ class Engine:
                 "pipe shard_map scans stage-local layer slices, so the "
                 "first/last-layer-full rule would apply per stage, not "
                 "globally; disable one of the two")
-        if self._pld and int(self.mesh.shape.get("pipe", 1)) > 1:
-            raise ValueError(
-                "progressive_layer_drop is not supported with pipeline "
-                "parallelism: the depth-scaled keep probability would be "
-                "computed per stage-local slice, not over the global depth; "
-                "disable one of the two")
+        # PLD composes with pipeline parallelism: PLDMixin._scan_layers
+        # recovers the global layer index from lax.axis_index("pipe") so the
+        # depth-scaled keep probability follows the paper's global-depth
+        # rule even on stage-local slices (see progressive_layer_drop.py).
         self.dp_world = dp_world_size(self.mesh)
         el = self.config.elasticity
         if el.enabled:

@@ -20,6 +20,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def pipe_stage_layer_offset(n_local_layers: int) -> jnp.ndarray:
+    """Global index of this pipeline stage's first layer (0 when no pipe
+    axis is bound — the dense trunk). Factored out so the global-depth
+    rule's wiring is directly testable: if this silently returned 0 under
+    a pipe axis, PLD would regress to per-stage depth scaling, the exact
+    bug the old engine-level exclusion guarded against."""
+    try:
+        return (lax.axis_index("pipe") * n_local_layers).astype(jnp.float32)
+    except NameError:
+        return jnp.float32(0.0)
+
+
 class PLDMixin:
     pld_theta_min: float = 0.5
     pld_gamma: float = 0.001
@@ -33,7 +45,14 @@ class PLDMixin:
         if self.pld_step is None:
             return super()._scan_layers(x, layers, positions, attn_mask,
                                         remat_policy)
-        L = jax.tree.leaves(layers)[0].shape[0]
+        L_local = jax.tree.leaves(layers)[0].shape[0]
+        # Under pipeline parallelism this method sees only the stage-local
+        # layer slice; the PLD depth scaling is defined over the GLOBAL
+        # depth (paper's p_l = 1 - (l/L)(1-theta)), so recover the global
+        # index as stage*L_local + local. axis_index raises at trace time
+        # when no pipe axis is bound (dense trunk) — offset 0 there.
+        L = getattr(self.cfg, "n_layer", L_local)
+        offset = pipe_stage_layer_offset(L_local)
         t = self.pld_step.astype(jnp.float32)
         theta = ((1.0 - self.pld_theta_min) * jnp.exp(-self.pld_gamma * t)
                  + self.pld_theta_min)
@@ -54,7 +73,7 @@ class PLDMixin:
         def scan_fn(carry, layer_params):
             x, key, li = carry
             key, sub = jax.random.split(key)
-            depth_frac = (li + 1).astype(jnp.float32) / L
+            depth_frac = (offset + (li + 1).astype(jnp.float32)) / L
             keep_p = 1.0 - depth_frac * (1.0 - theta)
             keep = jax.random.bernoulli(sub, keep_p)
             x_new, aux = lax.cond(

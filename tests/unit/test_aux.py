@@ -150,13 +150,8 @@ def test_moe_generate():
     assert np.all((out >= 0) & (out < 256))
 
 
-def test_pld_rejected_under_pipeline():
-    with pytest.raises(ValueError, match="pipeline"):
-        ds.initialize({
-            "train_batch_size": 8, "mesh": {"data": 2, "pipe": 4},
-            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-            "progressive_layer_drop": {"enabled": True},
-        }, build_model(tiny_test(n_layer=4)))
+# (the former PLD-under-pipeline rejection is lifted:
+#  test_pld_composes_with_pipeline proves the composition trains)
 
 
 def test_pld_no_tracer_leak():
@@ -338,3 +333,53 @@ def test_curriculum_sampler_from_metric_index(tmp_path):
     for _ in range(5):
         picks, difficulty = next(it)
     assert difficulty == 32
+
+
+def test_pld_composes_with_pipeline():
+    """PLD + pipe (lifted exclusion): the stage-local scan recovers the
+    GLOBAL layer index via lax.axis_index('pipe'), so the depth-scaled
+    keep probability follows the paper's global rule. Train must run,
+    converge, and actually drop (late-schedule loss differs from
+    full-depth eval of the same params)."""
+    from deepspeed_tpu.models import PipelinedTransformerLM
+
+    model = PipelinedTransformerLM(tiny_test(n_layer=4, max_seq=32),
+                                   n_stages=2, num_micro=4)
+    engine = ds.initialize({
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "mesh": {"pipe": 2, "data": 4},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.01},
+    }, model)
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(5)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    assert np.isfinite(engine.eval_batch(dict(batch)))
+    assert engine.model.pld_step is None
+
+
+def test_pld_global_offset_under_pipe_axis():
+    """The global-depth wiring itself: under a bound pipe axis the offset
+    is stage*L_local; without one it is 0. A regression to 0-under-pipe
+    would silently turn PLD's depth rule per-stage (the bug the old
+    engine exclusion guarded against)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from deepspeed_tpu.platform.mesh import build_mesh, MeshSpec
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        pipe_stage_layer_offset)
+
+    mesh = build_mesh(MeshSpec(pipe=2, data=4))
+    f = shard_map(lambda: pipe_stage_layer_offset(3)[None],
+                  mesh=mesh, in_specs=(), out_specs=P("pipe"))
+    offs = np.asarray(jax.jit(f)())
+    np.testing.assert_allclose(sorted(offs), [0.0, 3.0])
+    assert float(pipe_stage_layer_offset(3)) == 0.0   # no axis bound
