@@ -122,12 +122,20 @@ def run_child(script_path: str, env: dict, timeout: float,
 def run_with_tpu_window(script_path: str, child_env: dict, *,
                         window_s: float, child_timeout: float,
                         probe_timeout: float = PROBE_TIMEOUT_S,
-                        tag: str = "bench"):
-    """Probe → backoff → retry across the window; None if it never comes up."""
+                        tag: str = "bench", return_status: bool = False):
+    """Probe → backoff → retry across the window; None if it never comes up.
+
+    With ``return_status`` the caller also learns HOW the window failed:
+    ``"never-claimed"`` (the TPU was never granted — the workload is
+    unjudged, retry it) vs ``"child-failed"`` (the workload ran on a live
+    claim and died — a real failure, fall back/demote). Candidate loops
+    need the distinction to avoid demoting a config the hardware never saw."""
     warn_strays(tag)
     deadline = time.monotonic() + window_s
     attempt = 0
     backoff = 0.0
+    claimed = False
+    result = None
     while time.monotonic() < deadline:
         if attempt:
             remaining = deadline - time.monotonic()
@@ -140,9 +148,10 @@ def run_with_tpu_window(script_path: str, child_env: dict, *,
         attempt += 1
         status = probe_backend(probe_timeout, tag)
         if status is True:
+            claimed = True
             result = run_child(script_path, child_env, child_timeout, tag)
             if result is not None:
-                return result
+                break
             backoff = 120.0   # child failed after a good claim: brief pause
         elif status == "timeout":
             # our kill just re-wedged the grant: stay quiet long enough for
@@ -150,7 +159,11 @@ def run_with_tpu_window(script_path: str, child_env: dict, *,
             backoff = 600.0
         else:
             backoff = 60.0    # fast failure (chip busy): cheap to re-ask
-    return None
+    if not return_status:
+        return result
+    status = ("ok" if result is not None
+              else "child-failed" if claimed else "never-claimed")
+    return result, status
 
 
 def cpu_fallback_env(env: dict, n_devices: int = 8) -> dict:

@@ -130,19 +130,31 @@ def main():
     # The window budget is split across the remaining candidates.
     deadline = time.monotonic() + _WINDOW_S
     result = None
-    for idx, cand in enumerate(candidates):
+    idx = 0
+    while idx < len(candidates):
         remaining = deadline - time.monotonic()
         if remaining < 120:
             bc.log("window exhausted before all candidates ran",
                    "longseq-bench")
             break
+        cand = candidates[idx]
         env["DSTPU_LONGSEQ_TRY"] = cand
-        result = bc.run_with_tpu_window(
+        result, status = bc.run_with_tpu_window(
             me, env, window_s=remaining / (len(candidates) - idx),
-            child_timeout=600, tag="longseq-bench")
+            child_timeout=600, tag="longseq-bench", return_status=True)
         if result is not None:
             break
-        bc.log(f"candidate {cand} failed/hung; trying next", "longseq-bench")
+        if status == "child-failed":
+            # the hardware actually ran (and rejected) this config: demote
+            bc.log(f"candidate {cand} failed on a live claim; demoting",
+                   "longseq-bench")
+            idx += 1
+        else:
+            # TPU never granted: the candidate is unjudged — retry it with
+            # the next window slice rather than silently demoting the
+            # flagship sequence length
+            bc.log(f"candidate {cand} never got the TPU; retrying it",
+                   "longseq-bench")
     if result is None:
         result = bc.cached_result(_CACHE, tag="longseq-bench")
     if result is None:

@@ -75,6 +75,10 @@ class TransformerConfig:
     # BERT MLM head transform: LN(gelu(x @ W + b)) before the tied decoder
     # (+ output bias). Only meaningful with objective="mlm".
     mlm_transform: bool = False
+    # Fused Pallas softmax-xent over the unembedding (ops/xent.py): never
+    # materializes (B,S,V) logits. None = auto (on for TPU when eligible:
+    # tied embeddings, clm/mlm, model/seq/pipe axes unsharded).
+    fused_xent: Optional[bool] = None
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16             # compute dtype
     # MoE (dense when num_experts == 1); see models/moe.py
@@ -596,19 +600,27 @@ class TransformerLM:
         return _norm(x, params["lnf_scale"], params.get("lnf_bias"),
                      self.cfg.norm, self.cfg.norm_eps)
 
-    def _head(self, params, x):
-        """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
+    def _pre_head(self, params, x):
+        """Final norm + (BERT) MLM transform: everything before the
+        unembedding matmul — shared by the logits head and the fused-xent
+        loss path."""
         cfg = self.cfg
         x = self._head_norm(params, x)
         if cfg.mlm_transform:
             # BERT cls.predictions.transform: dense + hidden_act + LN before
             # the tied decoder (HF uses config.hidden_act here too); output
-            # bias added below via lm_head_bias
+            # bias added by the head / fused kernel via lm_head_bias
             x = _activation(x @ params["mlm_dense_w"].astype(x.dtype)
                             + params["mlm_dense_b"].astype(x.dtype),
                             cfg.activation)
             x = _norm(x, params["mlm_ln_scale"], params.get("mlm_ln_bias"),
                       cfg.norm, cfg.norm_eps)
+        return x
+
+    def _head(self, params, x):
+        """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
+        cfg = self.cfg
+        x = self._pre_head(params, x)
         if cfg.tie_embeddings:
             logits = x @ params["tok_embed"].astype(x.dtype).T
         else:
@@ -617,13 +629,17 @@ class TransformerLM:
             logits = logits + params["lm_head_bias"].astype(logits.dtype)
         return constrain(logits, P(B_AXES, "seq", "model"))
 
+    def _trunk(self, params, input_ids, attn_mask, remat_policy):
+        """Embed + layer stack: (B, S) → ((B, S, D) pre-final-norm, aux)."""
+        x, positions = self._embed(params, input_ids)
+        return self._scan_layers(x, params["layers"], positions, attn_mask,
+                                 remat_policy)
+
     def apply(self, params, input_ids, *, attn_mask=None, remat_policy=None,
               return_aux: bool = False):
         """Forward: (B, S) int32 → (B, S, V) logits (compute dtype), or
         (B, S, D) final-norm hidden states for ``objective='feature'``."""
-        x, positions = self._embed(params, input_ids)
-        x, aux = self._scan_layers(x, params["layers"], positions, attn_mask,
-                                   remat_policy)
+        x, aux = self._trunk(params, input_ids, attn_mask, remat_policy)
         if self.cfg.objective == "feature":
             # Feature extractor (CLIP text tower): no unembedding exists;
             # the product is the final-norm hidden states (B, S, D).
@@ -647,24 +663,98 @@ class TransformerLM:
                 "objective='feature' models have no unembedding/LM loss; "
                 "train them under a task head (apply() gives hidden states)")
         ids = batch["input_ids"]
-        logits, aux = self.apply(params, ids, attn_mask=batch.get("attention_mask"),
-                                 remat_policy=remat_policy, return_aux=True)
-        if self.cfg.objective == "mlm":
-            labels = batch["labels"]
-            nll = _token_nll(logits, labels)
-            mask = batch["loss_mask"].astype(jnp.float32)
-            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-            if self.cfg.num_experts > 1:
-                ce = ce + self.cfg.moe_aux_loss_weight * aux
-            return ce
-        targets = ids[:, 1:]
-        nll = _token_nll(logits[:, :-1], targets)
-        mask = batch.get("loss_mask")
+        mlm = self.cfg.objective == "mlm"
+        B, S = ids.shape
+        if self._fused_xent_active(n_tokens=B * (S if mlm else S - 1)):
+            x, aux = self._trunk(params, ids, batch.get("attention_mask"),
+                                 remat_policy)
+            feats = self._pre_head(params, x)
+            if mlm:
+                nll = self._fused_nll(params, feats, batch["labels"])
+            else:
+                nll = self._fused_nll(params, feats[:, :-1], ids[:, 1:])
+        else:
+            logits, aux = self.apply(params, ids,
+                                     attn_mask=batch.get("attention_mask"),
+                                     remat_policy=remat_policy,
+                                     return_aux=True)
+            if mlm:
+                nll = _token_nll(logits, batch["labels"])
+            else:
+                nll = _token_nll(logits[:, :-1], ids[:, 1:])
+        mask = batch["loss_mask"] if mlm else batch.get("loss_mask")
         if mask is not None:
-            mask = mask[:, 1:].astype(jnp.float32)
+            mask = (mask if mlm else mask[:, 1:]).astype(jnp.float32)
             ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
         else:
             ce = jnp.mean(nll)
         if self.cfg.num_experts > 1:
             ce = ce + self.cfg.moe_aux_loss_weight * aux
         return ce
+
+    def _fused_xent_active(self, n_tokens: Optional[int] = None) -> bool:
+        """Route the loss through the fused Pallas softmax-xent kernel?
+        Auto (fused_xent=None): on for TPU when the head is expressible —
+        tied embeddings (W stays in (V, d) table layout, no transpose) and
+        no model/seq/pipe sharding (the kernel runs per data shard under
+        shard_map; a vocab- or seq-sharded head keeps the XLA path). A
+        token count not divisible by the data-parallel world also keeps
+        the XLA path: shard_map splits rows evenly where GSPMD would pad
+        (partial eval batches must not start erroring because the fused
+        path auto-activated)."""
+        cfg = self.cfg
+        if cfg.fused_xent is False or not cfg.tie_embeddings \
+                or cfg.objective not in ("clm", "mlm"):
+            return False
+        from ..platform.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is not None and not mesh.empty:
+            if getattr(mesh, "manual_axes", frozenset()):
+                return False
+            for ax in ("model", "seq", "pipe"):
+                if ax in mesh.axis_names and mesh.shape[ax] != 1:
+                    return False
+            if n_tokens is not None and n_tokens % self._dp_world(mesh) != 0:
+                return False
+        if cfg.fused_xent:
+            return True
+        return jax.default_backend() == "tpu"
+
+    @staticmethod
+    def _dp_world(mesh) -> int:
+        return int(math.prod(mesh.shape[a] for a in BATCH_AXES
+                             if a in mesh.axis_names))
+
+    def _fused_nll(self, params, feats, targets):
+        """(B, S', D) features + (B, S') targets → (B, S') fp32 NLL via
+        ops/xent.py, shard_mapped over the batch axes when data-parallel
+        (each shard computes its own tokens; W/bias replicated)."""
+        from ..ops.xent import fused_token_nll
+        from ..platform.mesh import current_mesh
+
+        cfg = self.cfg
+        table = params["tok_embed"].astype(feats.dtype)
+        bias = (params["lm_head_bias"].astype(feats.dtype)
+                if cfg.lm_head_bias else None)
+        B, S, dm = feats.shape
+        h2 = feats.reshape(B * S, dm)
+        t2 = targets.reshape(B * S).astype(jnp.int32)
+        mesh = current_mesh()
+        dp = (self._dp_world(mesh)
+              if mesh is not None and not mesh.empty else 1)
+        if dp > 1:
+            has_b = bias is not None
+
+            def body(h, w, *rest):
+                b, t = rest if has_b else (None, rest[0])
+                return fused_token_nll(h, w, b, t)
+
+            in_specs = ((P(B_AXES, None), P(None, None))
+                        + ((P(None),) if has_b else ()) + (P(B_AXES),))
+            args = (h2, table) + ((bias,) if has_b else ()) + (t2,)
+            nll2 = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P(B_AXES), check_vma=False)(*args)
+        else:
+            nll2 = fused_token_nll(h2, table, bias, t2)
+        return nll2.reshape(B, S)

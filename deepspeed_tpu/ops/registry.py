@@ -100,3 +100,8 @@ def _ensure_builtin_ops() -> None:
     def _tiled():
         from .tiled import tiled_matmul
         return tiled_matmul
+
+    @register_op("fused_xent")
+    def _xent():
+        from .xent import fused_token_nll
+        return fused_token_nll

@@ -1,0 +1,282 @@
+"""Fused softmax-cross-entropy over the unembedding: Pallas TPU kernel.
+
+The naive head computes ``logits = h @ W`` ((T, V), ~800 MiB bf16 for GPT-2
+shapes), then reduces them — three-plus HBM round-trips over the largest
+tensor in the step, and the backward materializes a (T, V) d_logits as
+well. This kernel streams W in (block_v, d) tiles and keeps each logits
+tile in VMEM only: forward emits just the per-token NLL and logsumexp
+(flash-attention's online-softmax trick applied to the vocab dim, the same
+role the reference's fused CUDA softmax/logits kernels play,
+``csrc/transformer/inference/csrc/softmax.cu``); backward recomputes
+logits per tile and feeds ``p - onehot`` straight into the dx / dW
+matmuls. HBM traffic drops from O(T*V) tensors to O(T + V*d) operands.
+
+Layout: W is taken in (V, d) — the natural layout of a tied embedding
+table, so no transpose is ever materialized. An optional output bias
+(BERT's decoder bias) rides along: (V,) added per tile, gradient
+accumulated in the dW kernel. The backward runs two kernels with
+transposed grids (dx accumulates over vocab tiles per token block; dW and
+dbias over token blocks per vocab tile) because a Pallas TPU output block
+may only be revisited on consecutive grid steps.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+SUBLANES = 8
+BIG_NEG = -1e30
+
+
+def _tile_logits(x, w, b, vj, V):
+    """One (bt, bv) logits tile in f32, vocab padding masked."""
+    bt, bv = x.shape[0], w.shape[0]
+    logits = lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    logits = logits + b[None, :]
+    col = vj * bv + lax.broadcasted_iota(jnp.int32, (bt, bv), 1)
+    return jnp.where(col < V, logits, BIG_NEG), col
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, nll_ref, lse_ref,
+                m_sc, s_sc, tgt_sc, *, V: int, n_vj: int):
+    vj = pl.program_id(1)
+
+    @pl.when(vj == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, BIG_NEG, jnp.float32)
+        s_sc[...] = jnp.zeros(s_sc.shape, jnp.float32)
+        tgt_sc[...] = jnp.zeros(tgt_sc.shape, jnp.float32)
+
+    logits, col = _tile_logits(x_ref[...], w_ref[...],
+                               b_ref[0, :].astype(jnp.float32), vj, V)
+    t = t_ref[0, :]                                    # (bt,) int32
+    tgt_sc[...] += jnp.sum(jnp.where(col == t[:, None], logits, 0.0),
+                           axis=1, keepdims=True)
+    m = m_sc[...]
+    m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
+    s_sc[...] = (s_sc[...] * jnp.exp(m - m_new)
+                 + jnp.sum(jnp.exp(logits - m_new), axis=1, keepdims=True))
+    m_sc[...] = m_new
+
+    @pl.when(vj == n_vj - 1)
+    def _emit():
+        lse = m_sc[:, 0] + jnp.log(s_sc[:, 0])
+        # (SUBLANES, bt): replicated across sublanes for (8, 128) tiling
+        nll_ref[...] = jnp.broadcast_to((lse - tgt_sc[:, 0])[None, :],
+                                        nll_ref.shape)
+        lse_ref[...] = jnp.broadcast_to(lse[None, :], lse_ref.shape)
+
+
+# ----------------------------------------------------------------- backward
+def _dlogits(x, w, b, t, lse, g, vj, V):
+    """Recompute one logits tile; return (softmax - onehot) * dnll (f32)."""
+    logits, col = _tile_logits(x, w, b, vj, V)
+    p = jnp.exp(logits - lse[:, None])                 # exact: saved lse
+    onehot = (col == t[:, None]).astype(jnp.float32)
+    return (p - onehot) * g[:, None]                   # (bt, bv)
+
+
+def _dx_kernel(x_ref, w_ref, b_ref, t_ref, lse_ref, g_ref, dx_ref, acc_sc,
+               *, V: int, n_vj: int):
+    vj = pl.program_id(1)
+
+    @pl.when(vj == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    dl = _dlogits(x_ref[...], w_ref[...], b_ref[0, :].astype(jnp.float32),
+                  t_ref[0, :], lse_ref[0, :], g_ref[0, :], vj, V)
+    acc_sc[...] += jnp.dot(dl.astype(w_ref.dtype), w_ref[...],
+                           preferred_element_type=jnp.float32)
+
+    @pl.when(vj == n_vj - 1)
+    def _emit():
+        dx_ref[...] = acc_sc[...].astype(dx_ref.dtype)
+
+
+def _dw_kernel(x_ref, w_ref, b_ref, t_ref, lse_ref, g_ref, dw_ref, db_ref,
+               acc_sc, bacc_sc, *, V: int, n_ti: int):
+    vj = pl.program_id(0)
+    ti = pl.program_id(1)
+
+    @pl.when(ti == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+        bacc_sc[...] = jnp.zeros(bacc_sc.shape, jnp.float32)
+
+    x = x_ref[...]
+    dl = _dlogits(x, w_ref[...], b_ref[0, :].astype(jnp.float32),
+                  t_ref[0, :], lse_ref[0, :], g_ref[0, :], vj, V)
+    acc_sc[...] += lax.dot_general(dl.astype(x.dtype), x,
+                                   (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    bacc_sc[...] += jnp.sum(dl, axis=0, keepdims=True)
+
+    @pl.when(ti == n_ti - 1)
+    def _emit():
+        dw_ref[...] = acc_sc[...].astype(dw_ref.dtype)
+        db_ref[...] = jnp.broadcast_to(bacc_sc[...], db_ref.shape)
+
+
+# ----------------------------------------------------------------- wrapper
+def _pad_to(x, mult, axis):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _rep(v):
+    """(T,) → (SUBLANES, T) replicated operand for TPU tiling."""
+    return jnp.broadcast_to(v[None, :], (SUBLANES, v.shape[0]))
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _pow2_ceil(n):
+    return 1 << max(0, math.ceil(math.log2(max(1, n))))
+
+
+def _resolve_interpret(interpret):
+    return jax.default_backend() != "tpu" if interpret is None else interpret
+
+
+def _blocks(T, V, block_t, block_v):
+    return min(block_t, _pow2_ceil(T)), min(block_v, _pow2_ceil(V))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def fused_token_nll(x, w, bias, targets, block_t=256, block_v=512,
+                    interpret=None):
+    """Per-token NLL of ``softmax(x @ w.T + bias)`` with no (T, V) tensor.
+
+    x: (T, d) compute dtype; w: (V, d) — unembedding in embedding-table
+    layout; bias: (V,) or None; targets: (T,) int32 in [0, V).
+    Returns (T,) fp32 NLL. Differentiable in x, w, bias.
+    """
+    nll, _ = _fwd(x, w, bias, targets, block_t, block_v, interpret)
+    return nll
+
+
+def _operands(x, w, bias, targets, bt, bv, extra=()):
+    xp = _pad_to(x, bt, 0)
+    wp = _pad_to(w, bv, 0)
+    bp = _pad_to(jnp.zeros((w.shape[0],), x.dtype) if bias is None
+                 else bias.astype(x.dtype), bv, 0)
+    tp = _pad_to(targets, bt, 0)
+    return xp, wp, _rep(bp), _rep(tp), *(
+        _rep(_pad_to(e, bt, 0)) for e in extra)
+
+
+def _fwd(x, w, bias, targets, block_t, block_v, interpret):
+    T, d = x.shape
+    V = w.shape[0]
+    interpret = _resolve_interpret(interpret)
+    bt, bv = _blocks(T, V, block_t, block_v)
+    xp, wp, bp, tp = _operands(x, w, bias, targets, bt, bv)
+    Tp, Vp = xp.shape[0], wp.shape[0]
+    n_ti, n_vj = Tp // bt, Vp // bv
+    nll, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, V=V, n_vj=n_vj),
+        grid=(n_ti, n_vj),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((SUBLANES, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((SUBLANES, bt), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((SUBLANES, bt), lambda i, j: (0, i)),
+            pl.BlockSpec((SUBLANES, bt), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((SUBLANES, Tp), jnp.float32),
+            jax.ShapeDtypeStruct((SUBLANES, Tp), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((bt, 1)), _vmem((bt, 1)), _vmem((bt, 1))],
+        interpret=interpret,
+    )(xp, wp, bp, tp)
+    return nll[0, :T], lse[0, :]
+
+
+def _fwd_rule(x, w, bias, targets, block_t, block_v, interpret):
+    nll, lse_p = _fwd(x, w, bias, targets, block_t, block_v, interpret)
+    return nll, (x, w, bias, targets, lse_p)
+
+
+def _bwd_rule(block_t, block_v, interpret, res, g):
+    x, w, bias, targets, lse_p = res
+    T, d = x.shape
+    V = w.shape[0]
+    interpret = _resolve_interpret(interpret)
+    bt, bv = _blocks(T, V, block_t, block_v)
+    # padded tokens enter with g = 0: no contribution to dx / dW / dbias
+    xp, wp, bp, tp, gp = _operands(x, w, bias, targets, bt, bv,
+                                   extra=(g.astype(jnp.float32),))
+    lp = _rep(lse_p)
+    Tp, Vp = xp.shape[0], wp.shape[0]
+    n_ti, n_vj = Tp // bt, Vp // bv
+
+    dx = pl.pallas_call(
+        functools.partial(_dx_kernel, V=V, n_vj=n_vj),
+        grid=(n_ti, n_vj),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((SUBLANES, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((SUBLANES, bt), lambda i, j: (0, i)),
+            pl.BlockSpec((SUBLANES, bt), lambda i, j: (0, i)),
+            pl.BlockSpec((SUBLANES, bt), lambda i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((bt, d), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Tp, d), x.dtype),
+        scratch_shapes=[_vmem((bt, d))],
+        interpret=interpret,
+    )(xp, wp, bp, tp, lp, gp)
+
+    dw, db = pl.pallas_call(
+        functools.partial(_dw_kernel, V=V, n_ti=n_ti),
+        grid=(n_vj, n_ti),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda j, i: (i, 0)),
+            pl.BlockSpec((bv, d), lambda j, i: (j, 0)),
+            pl.BlockSpec((SUBLANES, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((SUBLANES, bt), lambda j, i: (0, i)),
+            pl.BlockSpec((SUBLANES, bt), lambda j, i: (0, i)),
+            pl.BlockSpec((SUBLANES, bt), lambda j, i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bv, d), lambda j, i: (j, 0)),
+            pl.BlockSpec((SUBLANES, bv), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Vp, d), w.dtype),
+            jax.ShapeDtypeStruct((SUBLANES, Vp), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((bv, d)), _vmem((1, bv))],
+        interpret=interpret,
+    )(xp, wp, bp, tp, lp, gp)
+
+    # bias=None is an empty pytree argument: its cotangent is None too
+    dbias = None if bias is None else db[0, :V].astype(bias.dtype)
+    zeros_t = np.zeros(targets.shape, jax.dtypes.float0)
+    return dx[:T], dw[:V], dbias, zeros_t
+
+
+fused_token_nll.defvjp(_fwd_rule, _bwd_rule)

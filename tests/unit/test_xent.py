@@ -1,0 +1,126 @@
+"""Fused Pallas softmax-xent (ops/xent.py): kernel equivalence vs the XLA
+path, gradients, padding, bias, and the model-loss integration (including
+the shard_mapped data-parallel route). Reference analog: the fused CUDA
+softmax/logits kernels (csrc/transformer/inference/csrc/softmax.cu)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.ops.xent import fused_token_nll
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+
+def _naive(x, w, b, t):
+    logits = jnp.dot(x, w.T, preferred_element_type=jnp.float32)
+    if b is not None:
+        logits = logits + b.astype(jnp.float32)[None, :]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, t[:, None], axis=-1)[:, 0]
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_kernel_matches_naive_with_grads(with_bias):
+    rng = np.random.default_rng(0)
+    T, d, V = 50, 64, 300                 # non-multiples: exercises padding
+    x = jnp.asarray(rng.normal(0, 2, (T, d)), jnp.float32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.normal(0, 0.5, (V, d)), jnp.float32).astype(jnp.bfloat16)
+    b = (jnp.asarray(rng.normal(0, 1, (V,)), jnp.float32).astype(jnp.bfloat16)
+         if with_bias else None)
+    t = jnp.asarray(rng.integers(0, V, (T,)), jnp.int32)
+
+    got = fused_token_nll(x, w, b, t, 16, 128, True)
+    want = _naive(x, w, b, t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    if with_bias:
+        ga = jax.grad(lambda *a: jnp.sum(fused_token_nll(*a, t, 16, 128, True)),
+                      argnums=(0, 1, 2))(x, w, b)
+        gb = jax.grad(lambda *a: jnp.sum(_naive(*a, t)),
+                      argnums=(0, 1, 2))(x, w, b)
+    else:
+        ga = jax.grad(lambda *a: jnp.sum(fused_token_nll(*a, None, t, 16, 128,
+                                                         True)),
+                      argnums=(0, 1))(x, w)
+        gb = jax.grad(lambda *a: jnp.sum(_naive(*a, None, t)),
+                      argnums=(0, 1))(x, w)
+    for p, q in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(p, np.float32),
+                                   np.asarray(q, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
+
+def test_model_loss_fused_matches_naive():
+    """Same params, same batch: fused_xent=True loss == fused_xent=False
+    loss (CLM, tied embeddings), and gradients agree."""
+    cfg_base = tiny_test(n_layer=2, dtype=jnp.float32)
+    naive_m = build_model(cfg_base)
+    import dataclasses
+
+    fused_m = build_model(dataclasses.replace(cfg_base, fused_xent=True))
+    params = naive_m.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg_base.vocab_size, (2, 24)), jnp.int32)}
+
+    a = float(fused_m.loss(params, batch))
+    b = float(naive_m.loss(params, batch))
+    assert abs(a - b) < 1e-4, (a, b)
+
+    from jax.flatten_util import ravel_pytree
+
+    ga = jax.grad(lambda p: fused_m.loss(p, batch))(params)
+    gb = jax.grad(lambda p: naive_m.loss(p, batch))(params)
+    flat_a, _ = ravel_pytree(ga)
+    flat_b, _ = ravel_pytree(gb)
+    np.testing.assert_allclose(np.asarray(flat_a), np.asarray(flat_b),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_engine_trains_with_fused_xent_data_parallel():
+    """e2e on the 8-device virtual mesh: the fused path runs under
+    shard_map over the batch axes and the loss converges."""
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": 1},
+    }, build_model(tiny_test(n_layer=2, fused_xent=True)))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(4)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+def test_fused_gate_declines_sharded_head_axes():
+    """Eligibility: a model/seq/pipe-sharded mesh keeps the XLA path."""
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    model = build_model(tiny_test(n_layer=2, fused_xent=True))
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    with jax.set_mesh(mesh):
+        assert not model._fused_xent_active()
+    mesh2 = build_mesh(MeshSpec(data=8))
+    with jax.set_mesh(mesh2):
+        assert model._fused_xent_active()
+
+
+def test_fused_gate_declines_indivisible_token_count():
+    """Partial batches whose token count does not divide the dp world must
+    keep the XLA path (shard_map splits rows evenly where GSPMD pads)."""
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    model = build_model(tiny_test(n_layer=2, fused_xent=True))
+    with jax.set_mesh(build_mesh(MeshSpec(data=8))):
+        assert model._fused_xent_active(n_tokens=128)
+        assert not model._fused_xent_active(n_tokens=124)
+    # (a batch whose B doesn't divide dp is rejected earlier, by the
+    # trunk's own sharding constraint, on BOTH loss paths — and whenever B
+    # divides dp, B*(S-1) does too, so the gate is a defensive backstop
+    # for future callers that flatten differently, not a reachable path
+    # through loss() today)
