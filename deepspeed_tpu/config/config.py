@@ -147,6 +147,14 @@ class MonitorConfig(ConfigModel):
     csv_monitor: dict[str, Any] = Field(default_factory=dict)
     wandb: dict[str, Any] = Field(default_factory=dict)
 
+    def any_enabled(self) -> bool:
+        """A backend-level ``"enabled": true`` must not be silently ignored
+        just because the outer flag was omitted (the reference reads the
+        per-backend blocks directly, with no outer gate)."""
+        return bool(self.enabled or self.tensorboard.get("enabled")
+                    or self.csv_monitor.get("enabled")
+                    or self.wandb.get("enabled"))
+
 
 class CommsLoggerConfig(ConfigModel):
     enabled: bool = False

@@ -414,7 +414,7 @@ class Engine:
         )
         self.global_steps = 0
         self.monitor = None
-        if self.config.monitor.enabled:
+        if self.config.monitor.any_enabled():
             from ..monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(self.config.monitor)
@@ -999,6 +999,10 @@ class Engine:
                 if stats:
                     events.append(("Train/samples_per_sec",
                                    stats["samples_per_sec"], self.global_steps))
+                    for key, tag in (("tflops", "Train/tflops"),
+                                     ("mfu", "Train/mfu")):
+                        if key in stats:
+                            events.append((tag, stats[key], self.global_steps))
                 self.monitor.write_events(events)
         else:
             self.throughput.stop(report=False)

@@ -383,3 +383,32 @@ def test_pld_global_offset_under_pipe_axis():
     offs = np.asarray(jax.jit(f)())
     np.testing.assert_allclose(sorted(offs), [0.0, 3.0])
     assert float(pipe_stage_layer_offset(3)) == 0.0   # no axis bound
+
+
+# ------------------------------------------------------------------ monitor
+def test_monitor_csv_receives_throughput_events(tmp_path):
+    """Engine-wired monitor fan-out (reference monitor/monitor.py:29):
+    at a steps_per_print boundary the csv backend receives loss/lr/
+    samples_per_sec AND the utilization events (tflops, mfu) computed by
+    the throughput timer."""
+    import csv as _csv
+
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "steps_per_print": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "monitor": {"csv_monitor": {"enabled": True,
+                                    "output_path": str(tmp_path)}},
+    }, build_model(tiny_test(n_layer=2)))
+    data = random_token_dataset(8, 32, 256)
+    batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data)
+    for _ in range(2):
+        engine.train_batch(dict(batch))
+    names = {p.name for p in tmp_path.iterdir()}
+    assert {"Train_loss.csv", "Train_lr.csv",
+            "Train_samples_per_sec.csv"} <= names, names
+    assert {"Train_tflops.csv", "Train_mfu.csv"} <= names, names
+    with open(tmp_path / "Train_mfu.csv") as f:
+        rows = list(_csv.reader(f))
+    assert rows[0] == ["step", "Train/mfu"] and len(rows) >= 2
+    assert 0.0 <= float(rows[1][1]) <= 1.0
