@@ -1018,31 +1018,25 @@ def _megatron_config(hf: dict) -> TransformerConfig:
     )
 
 
-def _megatron_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
-    """Megatron-LM GPT: sequential block, learned positions, fused
-    per-head-interleaved qkv (the layout NeoX inherited), biased
-    projections, word-embedding-tied head."""
-    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
-    per_layer = []
-    for i in range(cfg.n_layer):
-        p = f"encoder.layers.{i}."
-        wq, wk, wv = _split_fused_qkv_per_head(
-            sd.take(p + "self_attention.query_key_value.weight"), h, hd, d)
-        bq, bk, bv = _split_fused_qkv_bias_per_head(
-            sd.take(p + "self_attention.query_key_value.bias"), h, hd)
-        per_layer.append({
-            "ln1_scale": sd.take(p + "input_layernorm.weight"),
-            "ln1_bias": sd.take(p + "input_layernorm.bias"),
-            "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
-            "wo": sd.take(p + "self_attention.dense.weight").T,
-            "bo": sd.take(p + "self_attention.dense.bias"),
-            "ln2_scale": sd.take(p + "post_attention_layernorm.weight"),
-            "ln2_bias": sd.take(p + "post_attention_layernorm.bias"),
-            "w_in": sd.take(p + "mlp.dense_h_to_4h.weight").T,
-            "b_in": sd.take(p + "mlp.dense_h_to_4h.bias"),
-            "w_out": sd.take(p + "mlp.dense_4h_to_h.weight").T,
-            "b_out": sd.take(p + "mlp.dense_4h_to_h.bias"),
-        })
+def _megatron_attn_layer(sd: _SDict, p: str, cfg: TransformerConfig) -> dict:
+    """Shared attention/LN half of a Megatron layer (dense and MoE)."""
+    h, hd, d = cfg.n_head, cfg.head_dim, cfg.d_model
+    wq, wk, wv = _split_fused_qkv_per_head(
+        sd.take(p + "self_attention.query_key_value.weight"), h, hd, d)
+    bq, bk, bv = _split_fused_qkv_bias_per_head(
+        sd.take(p + "self_attention.query_key_value.bias"), h, hd)
+    return {
+        "ln1_scale": sd.take(p + "input_layernorm.weight"),
+        "ln1_bias": sd.take(p + "input_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+        "wo": sd.take(p + "self_attention.dense.weight").T,
+        "bo": sd.take(p + "self_attention.dense.bias"),
+        "ln2_scale": sd.take(p + "post_attention_layernorm.weight"),
+        "ln2_bias": sd.take(p + "post_attention_layernorm.bias"),
+    }
+
+
+def _megatron_embed_head(sd: _SDict, per_layer: list) -> dict:
     return {
         "tok_embed": sd.take("embedding.word_embeddings.weight"),
         "pos_embed": sd.take("embedding.position_embeddings.weight"),
@@ -1050,6 +1044,84 @@ def _megatron_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
         "lnf_scale": sd.take("encoder.final_layernorm.weight"),
         "lnf_bias": sd.take("encoder.final_layernorm.bias"),
     }
+
+
+def _megatron_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Megatron-LM GPT: sequential block, learned positions, fused
+    per-head-interleaved qkv (the layout NeoX inherited), biased
+    projections, word-embedding-tied head."""
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"encoder.layers.{i}."
+        lyr = _megatron_attn_layer(sd, p, cfg)
+        lyr.update({
+            "w_in": sd.take(p + "mlp.dense_h_to_4h.weight").T,
+            "b_in": sd.take(p + "mlp.dense_h_to_4h.bias"),
+            "w_out": sd.take(p + "mlp.dense_4h_to_h.weight").T,
+            "b_out": sd.take(p + "mlp.dense_4h_to_h.bias"),
+        })
+        per_layer.append(lyr)
+    return _megatron_embed_head(sd, per_layer)
+
+
+# ------------------------------------------------- family: megatron_gpt_moe
+def _megatron_moe_config(hf: dict) -> TransformerConfig:
+    """Megatron-DeepSpeed MoE GPT (reference
+    ``module_inject/containers/megatron_gpt_moe.py``): the dense Megatron
+    block with the MLP replaced by ``deepspeed_moe`` (TopKGate + expert
+    bank, ``moe/sharded_moe.py``). ``num_experts`` may arrive as the
+    Megatron arg list form; top-k defaults to the reference TopKGate's
+    k=1 (Switch-style) unless the args say otherwise."""
+    import dataclasses as _dc
+
+    cfg = _megatron_config(hf)
+    E = hf["num_experts"]
+    if isinstance(E, (list, tuple)):
+        if len(set(E)) != 1:
+            raise ValueError(
+                f"per-layer expert counts {E} are not supported: the trunk "
+                "routes a uniform expert bank (expert-interval checkpoints "
+                "with dense layers mixed in cannot be imported)")
+        E = E[0]
+    if int(E) < 2:
+        raise ValueError(
+            "num_experts=1 deepspeed_moe checkpoint: the routed trunk needs "
+            ">=2 experts (a 1-expert bank would import into shapes the dense "
+            "model cannot consume) — import it as model_type='megatron_gpt' "
+            "after renaming the expert MLP keys to the dense layout")
+    return _dc.replace(cfg, num_experts=int(E),
+                       moe_top_k=int(hf.get("moe_top_k", hf.get("topk", 1))))
+
+
+def _megatron_moe_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
+    """Megatron-DS MoE: router = ``gate.wg.weight`` (E, d) → (d, E);
+    experts ``deepspeed_experts.{e}.dense_*`` stacked into (E, d, f) /
+    (E, f, d) banks with per-expert biases."""
+    E = cfg.num_experts
+    per_layer = []
+    for i in range(cfg.n_layer):
+        p = f"encoder.layers.{i}."
+        moe = p + "mlp.deepspeed_moe."
+        if moe + "gate.wg.weight" not in sd:
+            raise ValueError(
+                f"layer {i} has no deepspeed_moe gate: mixed dense/MoE "
+                "(expert-interval > 1) checkpoints are not importable — the "
+                "trunk routes every layer")
+        lyr = _megatron_attn_layer(sd, p, cfg)
+        ex = moe + "experts.deepspeed_experts."
+        lyr.update({
+            "router": sd.take(moe + "gate.wg.weight").T,          # (d, E)
+            "w_in": np.stack([sd.take(f"{ex}{e}.dense_h_to_4h.weight").T
+                              for e in range(E)]),                # (E, d, f)
+            "b_in": np.stack([sd.take(f"{ex}{e}.dense_h_to_4h.bias")
+                              for e in range(E)]),                # (E, f)
+            "w_out": np.stack([sd.take(f"{ex}{e}.dense_4h_to_h.weight").T
+                               for e in range(E)]),               # (E, f, d)
+            "b_out": np.stack([sd.take(f"{ex}{e}.dense_4h_to_h.bias")
+                               for e in range(E)]),               # (E, d)
+        })
+        per_layer.append(lyr)
+    return _megatron_embed_head(sd, per_layer)
 
 
 # -------------------------------------------------------------- family: clip
@@ -1214,6 +1286,8 @@ _FAMILIES: dict[str, tuple[Callable, Callable, tuple[str, ...]]] = {
     "clip_text_model": (_clip_config, _clip_convert, ("text_model.",)),
     "megatron_gpt": (_megatron_config, _megatron_convert,
                      ("model.language_model.", "language_model.")),
+    "megatron_gpt_moe": (_megatron_moe_config, _megatron_moe_convert,
+                         ("model.language_model.", "language_model.")),
 }
 
 
@@ -1249,7 +1323,9 @@ def _detect_family(state_dict: Dict[str, Any]) -> str:
             any("self_attention.query_key_value" in k for k in keys):
         # both anchors: multimodal HF checkpoints (LLaVA-style) also prefix
         # llama-layout keys with "language_model."
-        return "megatron_gpt"
+        return ("megatron_gpt_moe"
+                if any("deepspeed_moe" in k for k in keys)
+                else "megatron_gpt")
     if any("gpt_neox" in k or "embed_in" in k for k in keys):
         return "gpt_neox"
     if any("word_embeddings_layernorm" in k for k in keys):

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..platform.mesh import BATCH_AXES, constrain
-from .transformer import TransformerConfig, TransformerLM
+from .transformer import TransformerConfig, TransformerLM, _activation
 
 B_AXES = BATCH_AXES
 
@@ -144,10 +144,11 @@ class MoETransformerLM(TransformerLM):
         if cfg.is_glu:
             g = jnp.einsum("becd,edf->becf", xs, p["w_gate"].astype(y.dtype))
             u = jax.nn.silu(g) * u
-        elif cfg.activation == "gelu":
-            u = jax.nn.gelu(u)
         else:
-            u = jax.nn.silu(u)
+            # same dispatch as the dense trunk: unknown names fail loudly
+            # instead of silently running experts with the wrong nonlinearity
+            # (gelu_exact Megatron-MoE imports reached this path)
+            u = _activation(u, cfg.activation)
         u = constrain(u, P(("data", "zero"), "expert", None, "model"))
         out = jnp.einsum("becf,efd->becd", u, p["w_out"].astype(y.dtype))
         out = self._expert_bias(out, p, "b_out")

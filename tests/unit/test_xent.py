@@ -124,3 +124,21 @@ def test_fused_gate_declines_indivisible_token_count():
     # divides dp, B*(S-1) does too, so the gate is a defensive backstop
     # for future callers that flatten differently, not a reachable path
     # through loss() today)
+
+
+def test_engine_fused_xent_with_gradient_accumulation():
+    """The fused kernel's shard_map must compose inside the GAS lax.scan
+    (micro-batching) and with QAT compression's param transform."""
+    engine = ds.initialize({
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "compression": {"weight_quantization": {"enabled": True, "bits": 8}},
+    }, build_model(tiny_test(n_layer=2, fused_xent=True)))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=16,
+                       shuffle=False).collate_fn(data)
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(4)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
