@@ -32,6 +32,12 @@ class InferenceConfig:
     # (ops/decode_attention.py). None = auto: on for TPU, off elsewhere
     # (interpret-mode Pallas inside the decode scan is test-only slow).
     flash_decode: Optional[bool] = None
+    # WOQ only: dequantize inside each decode step instead of once per
+    # generate(). If XLA fuses the int8→bf16 convert into the matmul
+    # operand loads, decode weight traffic halves (true in-kernel WOQ, the
+    # reference's dequantize-in-kernel design); if it hoists, identical to
+    # the default. bench_woq_probe.py measures which; off by default.
+    dequant_per_step: bool = False
 
     def flash_decode_resolved(self) -> bool:
         if self.flash_decode is not None:

@@ -157,13 +157,22 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
 
 def generate_tokens(model, params, input_ids, rng, *, max_new: int,
                     sampler, eos_token_id=None, cache_dtype=None,
-                    flash_decode: bool = False):
+                    flash_decode: bool = False, materialize=None):
     """Shared prefill + decode-scan generation loop.
 
     Used by both :class:`~deepspeed_tpu.inference.InferenceEngine` and the
     RLHF :class:`~deepspeed_tpu.runtime.hybrid_engine.HybridEngine` so the
     schedule/eos logic cannot drift between them. ``sampler(logits, rng)``
     -> (B,) int32.
+
+    ``materialize``: optional ``quantized params -> dense params`` fn.
+    When given, the prefill materializes once (compute-bound, dense is
+    right), but each decode step re-materializes INSIDE the scan body —
+    inviting XLA to fuse the int8→bf16 convert into the matmul operand
+    loads so the weights re-read from HBM each token stay int8 (half the
+    decode traffic). Whether the compiler fuses or hoists is toolchain-
+    dependent: ``bench_woq_probe.py`` measures it; the knob is
+    ``InferenceConfig.dequant_per_step``.
     """
     objective = getattr(model.cfg, "objective", "clm")
     if objective != "clm":
@@ -174,15 +183,16 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
     B, S = input_ids.shape
     cache = init_cache(model.cfg, B, S + max_new, cache_dtype or model.cfg.dtype)
     eos = eos_token_id
+    mat = materialize if materialize is not None else (lambda p: p)
 
-    logits, cache = forward_with_cache(model, params, input_ids, cache)
+    logits, cache = forward_with_cache(model, mat(params), input_ids, cache)
     rng, sub = jax.random.split(rng)
     tok = sampler(logits[:, -1], sub)
     done = (tok == eos) if eos is not None else jnp.zeros((B,), bool)
 
     def step(carry, _):
         tok, cache, rng, done = carry
-        lg, cache = forward_with_cache(model, params, tok[:, None], cache,
+        lg, cache = forward_with_cache(model, mat(params), tok[:, None], cache,
                                        flash_decode=flash_decode)
         rng, sub = jax.random.split(rng)
         nxt = sampler(lg[:, 0], sub)

@@ -98,12 +98,16 @@ class InferenceEngine:
                        greedy: bool):
         sampler = partial(sample_logits, temperature=temperature, top_k=top_k,
                           top_p=top_p, greedy=greedy)
-        return generate_tokens(self.model, self._materialized(params),
-                               input_ids, rng, max_new=max_new,
-                               sampler=sampler,
-                               eos_token_id=self.config.eos_token_id,
-                               cache_dtype=self.compute_dtype,
-                               flash_decode=self.config.flash_decode_resolved())
+        # per_step: weights stay quantized and each decode step
+        # re-materializes in the scan body (generate_tokens docs)
+        per_step = self.config.quantize and self.config.dequant_per_step
+        return generate_tokens(
+            self.model, params if per_step else self._materialized(params),
+            input_ids, rng, max_new=max_new, sampler=sampler,
+            eos_token_id=self.config.eos_token_id,
+            cache_dtype=self.compute_dtype,
+            flash_decode=self.config.flash_decode_resolved(),
+            materialize=self._materialized if per_step else None)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)

@@ -241,3 +241,24 @@ def test_feature_tower_serves_forward_and_guards_generate():
     assert feats.shape == (2, 8, 32) and np.isfinite(feats).all()
     with _pytest.raises(ValueError, match="feature"):
         eng.generate(ids, 4)
+
+
+def test_woq_dequant_per_step_matches_default():
+    """dequant_per_step re-materializes quantized weights inside the decode
+    scan; the tokens must be identical to the default (dequantize-once)
+    int8 path — only the HBM traffic pattern may differ."""
+    import jax
+
+    from deepspeed_tpu.inference import init_inference
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    cfg = tiny_test(n_layer=2, vocab_size=256, max_seq=64, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = np.random.default_rng(0).integers(0, 256, (2, 8)).astype(np.int32)
+    base = {"dtype": "float32", "quantize": True, "quant_bits": 8}
+    a = init_inference(model, params, dict(base))
+    b = init_inference(model, params, {**base, "dequant_per_step": True})
+    out_a = np.asarray(a.generate(prompt, max_new_tokens=8, greedy=True))
+    out_b = np.asarray(b.generate(prompt, max_new_tokens=8, greedy=True))
+    np.testing.assert_array_equal(out_a, out_b)
