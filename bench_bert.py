@@ -48,19 +48,50 @@ def _run_workload():
         candidates = [("tiny", 8)]
         n_steps = 2
 
+    import gc
+
     last_err = None
+    result = None
     for size, micro in candidates:
         try:
-            _measure(size, micro, seq, n_steps, devices, on_tpu)
-            return
+            result = _measure(size, micro, seq, n_steps, devices, on_tpu)
+            break
         except Exception as e:
             last_err = RuntimeError(f"{type(e).__name__}: {str(e)[:300]}")
             print(f"[bert-child] {size}/mbs{micro} failed ({last_err}); "
                   "next candidate", flush=True)
-            import gc
             gc.collect()
             jax.clear_caches()
-    raise last_err
+    if result is None:
+        raise last_err
+
+    # Persist + emit the primary IMMEDIATELY: the parent keeps the LAST
+    # JSON line on stdout, so if the secondary row below times the child
+    # out or crashes the process, this measurement already stands.
+    if on_tpu:
+        bc.save_tpu_cache(_CACHE, result)
+    print(json.dumps(result), flush=True)
+
+    if on_tpu and size == "large":
+        # Secondary anchor row (large only — a base-demoted primary must
+        # not graft a different model's row): the reference also reports
+        # 53 TFLOPS at seq512 on the V100 (42.4% util,
+        # bert-pretraining.md:392). Best-effort.
+        try:
+            gc.collect()
+            jax.clear_caches()
+            r512 = _measure("large", 16, 512, n_steps, devices, on_tpu)
+            result["rows"] = {"seq512": {
+                "mfu": r512["value"],
+                "vs_seq512_anchor": round(r512["value"] / 0.424, 4)}}
+            result["unit"] = (result["unit"][:-1]
+                              + f", seq512 mfu={r512['value']} "
+                              f"(ref anchor 0.424))")
+            bc.save_tpu_cache(_CACHE, result)
+            print(json.dumps(result), flush=True)   # enriched line wins
+        except Exception as e:
+            print(f"[bert-child] seq512 secondary row failed: "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
 
 
 def _measure(size, micro, seq, n_steps, devices, on_tpu):
@@ -103,12 +134,9 @@ def _measure(size, micro, seq, n_steps, devices, on_tpu):
     if not on_tpu:
         unit += ", CPU-FALLBACK"
     unit += ")"
-    result = {"metric": f"bert_{size}_seq128_mlm_mfu",
-              "value": round(mfu, 4), "unit": unit,
-              "vs_baseline": round(mfu / 0.512, 4)}
-    if on_tpu:
-        bc.save_tpu_cache(_CACHE, result)
-    print(json.dumps(result), flush=True)
+    return {"metric": f"bert_{size}_seq{seq}_mlm_mfu",
+            "value": round(mfu, 4), "unit": unit,
+            "vs_baseline": round(mfu / 0.512, 4)}
 
 
 def main():
