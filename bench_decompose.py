@@ -48,9 +48,12 @@ def main():
         "zero_optimization": {"stage": 1},
         "remat": {"enabled": True, "policy": "dots_saveable"},
     }
+    # engine row: the flagship auto config (fused xent auto-on for TPU);
+    # the explicit fwd/grad rows below pin fused_xent both ways so the
+    # naive baseline is actually naive
     model_cfg = gpt2("350m", max_seq=seq)
-    model = build_model(model_cfg)
-    engine = ds.initialize(cfg, model)
+    model = build_model(gpt2("350m", max_seq=seq, fused_xent=False))
+    engine = ds.initialize(cfg, build_model(model_cfg))
     policy = _remat_policy(engine.config)
     data = random_token_dataset(micro * 2, seq_len=seq,
                                 vocab_size=model_cfg.vocab_size)
@@ -77,6 +80,16 @@ def main():
         fp = jax.tree.map(lambda x: x.astype(jnp.bfloat16), fp)
         trunk_j = jax.jit(lambda p, ids: feat.apply(p, ids, remat_policy=policy))
         res["trunk_fwd_ms"] = timed(trunk_j, fp, batch["input_ids"]) * 1e3
+
+        # fused Pallas xent vs the XLA loss path, fwd and fwd+bwd
+        fused_model = build_model(gpt2("350m", max_seq=seq, fused_xent=True))
+        floss_j = jax.jit(lambda p, b: fused_model.loss(p, b,
+                                                        remat_policy=policy))
+        res["fwd_fused_ms"] = timed(floss_j, cp, batch) * 1e3
+        fgrad_j = jax.jit(jax.value_and_grad(
+            lambda p, b: fused_model.loss(p, b, remat_policy=policy)))
+        res["grad_fused_ms"] = timed(lambda p, b: fgrad_j(p, b)[0],
+                                     cp, batch) * 1e3
 
     res = {k: round(v, 1) for k, v in res.items()}
     res["head_xent_fwd_ms"] = round(res["fwd_ms"] - res["trunk_fwd_ms"], 1)

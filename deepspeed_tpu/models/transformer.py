@@ -712,9 +712,15 @@ class TransformerLM:
         if mesh is not None and not mesh.empty:
             if getattr(mesh, "manual_axes", frozenset()):
                 return False
-            for ax in ("model", "seq", "pipe"):
+            for ax in ("seq", "pipe"):
                 if ax in mesh.axis_names and mesh.shape[ax] != 1:
                     return False
+            # model-axis sharding IS supported (vocab-sharded TP kernel:
+            # per-shard partials + two collectives) when the vocab splits
+            # evenly across the axis
+            tp = int(mesh.shape.get("model", 1))
+            if tp > 1 and cfg.vocab_size % tp != 0:
+                return False
             if n_tokens is not None and n_tokens % self._dp_world(mesh) != 0:
                 return False
         if cfg.fused_xent:
@@ -741,17 +747,21 @@ class TransformerLM:
         h2 = feats.reshape(B * S, dm)
         t2 = targets.reshape(B * S).astype(jnp.int32)
         mesh = current_mesh()
-        dp = (self._dp_world(mesh)
-              if mesh is not None and not mesh.empty else 1)
-        if dp > 1:
+        in_mesh = mesh is not None and not mesh.empty
+        dp = self._dp_world(mesh) if in_mesh else 1
+        tp = int(mesh.shape.get("model", 1)) if in_mesh else 1
+        if dp > 1 or tp > 1:
             has_b = bias is not None
+            from ..ops.xent import fused_token_nll_tp
 
             def body(h, w, *rest):
                 b, t = rest if has_b else (None, rest[0])
+                if tp > 1:
+                    return fused_token_nll_tp(h, w, b, t, "model")
                 return fused_token_nll(h, w, b, t)
 
-            in_specs = ((P(B_AXES, None), P(None, None))
-                        + ((P(None),) if has_b else ()) + (P(B_AXES),))
+            in_specs = ((P(B_AXES, None), P("model", None))
+                        + ((P("model"),) if has_b else ()) + (P(B_AXES),))
             args = (h2, table) + ((bias,) if has_b else ()) + (t2,)
             nll2 = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                                  out_specs=P(B_AXES), check_vma=False)(*args)

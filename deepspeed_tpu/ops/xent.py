@@ -47,7 +47,13 @@ def _tile_logits(x, w, b, vj, V):
 
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, nll_ref, lse_ref,
-                m_sc, s_sc, tgt_sc, *, V: int, n_vj: int):
+                m_sc, s_sc, tgt_sc, *, V: int, n_vj: int,
+                partials: bool = False):
+    """``partials=False``: emit per-token (nll, lse). ``partials=True``
+    (TP vocab shards): emit per-token (target-logit partial, shard-local
+    logsumexp m + log s); the cross-shard combine (pmax/psum) happens
+    upstream in ``_fwd_tp``. Both modes share every tile op; only _emit
+    differs."""
     vj = pl.program_id(1)
 
     @pl.when(vj == 0)
@@ -59,8 +65,13 @@ def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, nll_ref, lse_ref,
     logits, col = _tile_logits(x_ref[...], w_ref[...],
                                b_ref[0, :].astype(jnp.float32), vj, V)
     t = t_ref[0, :]                                    # (bt,) int32
-    tgt_sc[...] += jnp.sum(jnp.where(col == t[:, None], logits, 0.0),
-                           axis=1, keepdims=True)
+    # col < V guard: under TP a FOREIGN shard's shifted target id can land
+    # in this shard's padded vocab region [V, Vp), where logits are
+    # BIG_NEG — matching it would poison the psum'd target partial with
+    # -1e30 (real hit: NeoX vocab 50304 / tp 4 pads 12576→12800)
+    tgt_sc[...] += jnp.sum(
+        jnp.where((col == t[:, None]) & (col < V), logits, 0.0),
+        axis=1, keepdims=True)
     m = m_sc[...]
     m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
     s_sc[...] = (s_sc[...] * jnp.exp(m - m_new)
@@ -69,11 +80,19 @@ def _fwd_kernel(x_ref, w_ref, b_ref, t_ref, nll_ref, lse_ref,
 
     @pl.when(vj == n_vj - 1)
     def _emit():
-        lse = m_sc[:, 0] + jnp.log(s_sc[:, 0])
-        # (SUBLANES, bt): replicated across sublanes for (8, 128) tiling
-        nll_ref[...] = jnp.broadcast_to((lse - tgt_sc[:, 0])[None, :],
-                                        nll_ref.shape)
-        lse_ref[...] = jnp.broadcast_to(lse[None, :], lse_ref.shape)
+        if partials:
+            # shard-local (m, tgt) ride out for the cross-shard combine;
+            # s is carried as log for a numerically uniform psum upstream
+            a = m_sc[:, 0] + jnp.log(jnp.maximum(s_sc[:, 0], 1e-30))
+            nll_ref[...] = jnp.broadcast_to(tgt_sc[:, 0][None, :],
+                                            nll_ref.shape)
+            lse_ref[...] = jnp.broadcast_to(a[None, :], lse_ref.shape)
+        else:
+            lse = m_sc[:, 0] + jnp.log(s_sc[:, 0])
+            # (SUBLANES, bt): replicated across sublanes for (8,128) tiling
+            nll_ref[...] = jnp.broadcast_to((lse - tgt_sc[:, 0])[None, :],
+                                            nll_ref.shape)
+            lse_ref[...] = jnp.broadcast_to(lse[None, :], lse_ref.shape)
 
 
 # ----------------------------------------------------------------- backward
@@ -81,7 +100,10 @@ def _dlogits(x, w, b, t, lse, g, vj, V):
     """Recompute one logits tile; return (softmax - onehot) * dnll (f32)."""
     logits, col = _tile_logits(x, w, b, vj, V)
     p = jnp.exp(logits - lse[:, None])                 # exact: saved lse
-    onehot = (col == t[:, None]).astype(jnp.float32)
+    # col < V: a foreign target in the padded region must not set a onehot
+    # (its dw/db rows are sliced off and padded w rows are zeros, so the
+    # damage would be bounded — but keep fwd/bwd masking identical)
+    onehot = ((col == t[:, None]) & (col < V)).astype(jnp.float32)
     return (p - onehot) * g[:, None]                   # (bt, bv)
 
 
@@ -184,7 +206,7 @@ def _operands(x, w, bias, targets, bt, bv, extra=()):
         _rep(_pad_to(e, bt, 0)) for e in extra)
 
 
-def _fwd(x, w, bias, targets, block_t, block_v, interpret):
+def _fwd(x, w, bias, targets, block_t, block_v, interpret, partials=False):
     T, d = x.shape
     V = w.shape[0]
     interpret = _resolve_interpret(interpret)
@@ -193,7 +215,7 @@ def _fwd(x, w, bias, targets, block_t, block_v, interpret):
     Tp, Vp = xp.shape[0], wp.shape[0]
     n_ti, n_vj = Tp // bt, Vp // bv
     nll, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, V=V, n_vj=n_vj),
+        functools.partial(_fwd_kernel, V=V, n_vj=n_vj, partials=partials),
         grid=(n_ti, n_vj),
         in_specs=[
             pl.BlockSpec((bt, d), lambda i, j: (i, 0)),
@@ -212,7 +234,7 @@ def _fwd(x, w, bias, targets, block_t, block_v, interpret):
         scratch_shapes=[_vmem((bt, 1)), _vmem((bt, 1)), _vmem((bt, 1))],
         interpret=interpret,
     )(xp, wp, bp, tp)
-    return nll[0, :T], lse[0, :]
+    return nll[0, :T], lse[0, :T]
 
 
 def _fwd_rule(x, w, bias, targets, block_t, block_v, interpret):
@@ -220,16 +242,18 @@ def _fwd_rule(x, w, bias, targets, block_t, block_v, interpret):
     return nll, (x, w, bias, targets, lse_p)
 
 
-def _bwd_rule(block_t, block_v, interpret, res, g):
-    x, w, bias, targets, lse_p = res
+def _bwd_kernels(x, w, bias, targets, lse, g, block_t, block_v, interpret):
+    """Shared dx/dW/dbias pass: recompute-logits kernels against a given
+    per-token lse (the GLOBAL one under TP). Returns (dx, dw, db[:V])."""
     T, d = x.shape
     V = w.shape[0]
     interpret = _resolve_interpret(interpret)
     bt, bv = _blocks(T, V, block_t, block_v)
     # padded tokens enter with g = 0: no contribution to dx / dW / dbias
-    xp, wp, bp, tp, gp = _operands(x, w, bias, targets, bt, bv,
-                                   extra=(g.astype(jnp.float32),))
-    lp = _rep(lse_p)
+    # (their padded lse of 0 is therefore harmless)
+    xp, wp, bp, tp, gp, lp = _operands(
+        x, w, bias, targets, bt, bv,
+        extra=(g.astype(jnp.float32), lse.astype(jnp.float32)))
     Tp, Vp = xp.shape[0], wp.shape[0]
     n_ti, n_vj = Tp // bt, Vp // bv
 
@@ -273,10 +297,80 @@ def _bwd_rule(block_t, block_v, interpret, res, g):
         interpret=interpret,
     )(xp, wp, bp, tp, lp, gp)
 
+    return dx[:T], dw[:V], db[0, :V]
+
+
+def _bwd_rule(block_t, block_v, interpret, res, g):
+    x, w, bias, targets, lse = res
+    dx, dw, db = _bwd_kernels(x, w, bias, targets, lse, g,
+                              block_t, block_v, interpret)
     # bias=None is an empty pytree argument: its cotangent is None too
-    dbias = None if bias is None else db[0, :V].astype(bias.dtype)
+    dbias = None if bias is None else db.astype(bias.dtype)
     zeros_t = np.zeros(targets.shape, jax.dtypes.float0)
-    return dx[:T], dw[:V], dbias, zeros_t
+    return dx, dw, dbias, zeros_t
 
 
 fused_token_nll.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ------------------------------------------------ tensor-parallel (vocab)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def fused_token_nll_tp(x, w_shard, bias_shard, targets, axis="model",
+                       block_t=256, block_v=512, interpret=None):
+    """Vocab-sharded fused NLL — call INSIDE shard_map with ``axis`` bound.
+
+    Each shard streams its own (V/P, d) slice of the unembedding through
+    the kernel in partials mode (shard-local logsumexp + target-logit
+    partial), then two collectives assemble the global loss: the same
+    max/sum-exp exchange the pipeline's vocab-sharded head does in XLA,
+    but with no shard ever materializing its (T, V/P) logits. Targets are
+    GLOBAL ids; shards own contiguous equal slices.
+    """
+    nll, _ = _fwd_tp(x, w_shard, bias_shard, targets, axis,
+                     block_t, block_v, interpret)
+    return nll
+
+
+def _fwd_tp(x, w_shard, bias_shard, targets, axis, block_t, block_v,
+            interpret):
+    v_local = w_shard.shape[0]
+    off = lax.axis_index(axis) * v_local
+    t_loc = (targets - off).astype(jnp.int32)   # foreign ids never match
+    tgt_p, lse_l = _fwd(x, w_shard, bias_shard, t_loc,
+                        block_t, block_v, interpret, partials=True)
+    m_g = lax.pmax(lse_l, axis)
+    lse_g = m_g + jnp.log(lax.psum(jnp.exp(lse_l - m_g), axis))
+    tgt_g = lax.psum(tgt_p, axis)
+    return lse_g - tgt_g, lse_g
+
+
+def _fwd_tp_rule(x, w_shard, bias_shard, targets, axis, block_t, block_v,
+                 interpret):
+    nll, lse_g = _fwd_tp(x, w_shard, bias_shard, targets, axis,
+                         block_t, block_v, interpret)
+    return nll, (x, w_shard, bias_shard, targets, lse_g)
+
+
+def _bwd_tp_rule(axis, block_t, block_v, interpret, res, g):
+    x, w_shard, bias_shard, targets, lse_g = res
+    v_local = w_shard.shape[0]
+    off = lax.axis_index(axis) * v_local
+    t_loc = (targets - off).astype(jnp.int32)
+    # Under check_vma=False shard_map distributes a replicated output's
+    # cotangent as g/axis_size per shard; undo that so each shard's
+    # slice-local dw/dbias (and its dx partial, which shard_map's
+    # replicated-x backward then psums) carry the full signal. The TP
+    # equivalence test pins this convention against JAX changes.
+    g = g * lax.psum(jnp.float32(1.0), axis)
+    dx_l, dw, db = _bwd_kernels(x, w_shard, bias_shard, t_loc, lse_g, g,
+                                block_t, block_v, interpret)
+    # each shard returns only its vocab slice's dx contribution;
+    # shard_map's backward for the replicated x operand performs the
+    # cross-shard psum (an explicit psum here double-counts)
+    dx = dx_l
+    dbias = None if bias_shard is None else db.astype(bias_shard.dtype)
+    zeros_t = np.zeros(targets.shape, jax.dtypes.float0)
+    return dx, dw, dbias, zeros_t
+
+
+fused_token_nll_tp.defvjp(_fwd_tp_rule, _bwd_tp_rule)

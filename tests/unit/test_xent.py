@@ -97,17 +97,43 @@ def test_engine_trains_with_fused_xent_data_parallel():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
 
 
-def test_fused_gate_declines_sharded_head_axes():
-    """Eligibility: a model/seq/pipe-sharded mesh keeps the XLA path."""
+def test_fused_gate_axis_eligibility():
+    """Eligibility: seq/pipe-sharded meshes keep the XLA path; data and
+    model (vocab-sharded TP kernel) meshes take the fused path — unless
+    the vocab doesn't split evenly over the model axis."""
     from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
 
     model = build_model(tiny_test(n_layer=2, fused_xent=True))
-    mesh = build_mesh(MeshSpec(data=2, model=4))
-    with jax.set_mesh(mesh):
+    with jax.set_mesh(build_mesh(MeshSpec(data=2, model=4))):
+        assert model._fused_xent_active()          # 256 % 4 == 0
+    odd_vocab = build_model(tiny_test(n_layer=2, vocab_size=254,
+                                      fused_xent=True))
+    with jax.set_mesh(build_mesh(MeshSpec(data=2, model=4))):
+        assert not odd_vocab._fused_xent_active()  # 254 % 4 != 0
+    with jax.set_mesh(build_mesh(MeshSpec(data=2, seq=4))):
         assert not model._fused_xent_active()
-    mesh2 = build_mesh(MeshSpec(data=8))
-    with jax.set_mesh(mesh2):
+    with jax.set_mesh(build_mesh(MeshSpec(data=8))):
         assert model._fused_xent_active()
+
+
+def test_engine_trains_with_fused_xent_tensor_parallel():
+    """e2e: data x model mesh — the vocab-sharded TP kernel runs under the
+    engine and the first-step loss matches the XLA path's."""
+    losses = {}
+    for fused in (True, False):
+        engine = ds.initialize({
+            "train_batch_size": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+            "mesh": {"data": 2, "model": 4},
+        }, build_model(tiny_test(n_layer=2, fused_xent=fused)))
+        data = random_token_dataset(8, 32, 256, learnable=True)
+        batch = DataLoader(data, local_batch_size=4,
+                           shuffle=False).collate_fn(data[:4])
+        seq = [float(engine.train_batch(dict(batch))["loss"])
+               for _ in range(3)]
+        assert all(np.isfinite(seq)) and seq[-1] < seq[0], (fused, seq)
+        losses[fused] = seq
+    assert abs(losses[True][0] - losses[False][0]) < 2e-3, losses
 
 
 def test_fused_gate_declines_indivisible_token_count():
@@ -142,3 +168,86 @@ def test_engine_fused_xent_with_gradient_accumulation():
     losses = [float(engine.train_batch(dict(batch))["loss"])
               for _ in range(4)]
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_tp_vocab_sharded_kernel_matches_full(with_bias):
+    """fused_token_nll_tp under shard_map on a model=4 mesh: per-shard
+    partials + two collectives must equal the full-vocab kernel/naive
+    path, for values and for (dx, sharded dw/dbias) gradients."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.xent import fused_token_nll_tp
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    rng = np.random.default_rng(0)
+    T, d, V = 32, 64, 512                       # V % 4 == 0
+    x = jnp.asarray(rng.normal(0, 2, (T, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 0.5, (V, d)), jnp.float32)
+    b = (jnp.asarray(rng.normal(0, 1, (V,)), jnp.float32)
+         if with_bias else None)
+    t = jnp.asarray(rng.integers(0, V, (T,)), jnp.int32)
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+
+    def tp_loss(x, w, b, t):
+        if b is None:
+            body = lambda x_, w_, t_: fused_token_nll_tp(
+                x_, w_, None, t_, "model", 16, 64, True)
+            fn = jax.shard_map(body, mesh=mesh,
+                               in_specs=(P(), P("model", None), P()),
+                               out_specs=P(), check_vma=False)
+            return jnp.sum(fn(x, w, t))
+        body = lambda x_, w_, b_, t_: fused_token_nll_tp(
+            x_, w_, b_, t_, "model", 16, 64, True)
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(P(), P("model", None), P("model"), P()),
+                           out_specs=P(), check_vma=False)
+        return jnp.sum(fn(x, w, b, t))
+
+    def naive_loss(x, w, b, t):
+        return jnp.sum(_naive(x, w, b, t))
+
+    got = float(tp_loss(x, w, b, t))
+    want = float(naive_loss(x, w, b, t))
+    assert abs(got - want) / abs(want) < 1e-5, (got, want)
+
+    args = (x, w) + ((b,) if with_bias else ())
+    nums = tuple(range(len(args)))
+    ga = jax.grad(lambda *a: tp_loss(a[0], a[1],
+                                     a[2] if with_bias else None, t),
+                  argnums=nums)(*args)
+    gb = jax.grad(lambda *a: naive_loss(a[0], a[1],
+                                        a[2] if with_bias else None, t),
+                  argnums=nums)(*args)
+    for p, q in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(q),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_tp_foreign_target_in_padded_region_not_poisoned():
+    """Regression: with V/tp not a block multiple (NeoX 50304/tp4 class),
+    a foreign shard's shifted target id lands in another shard's padded
+    vocab columns — the BIG_NEG padding must not leak into the psum'd
+    target partial."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.xent import fused_token_nll_tp
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    rng = np.random.default_rng(1)
+    T, d, V = 16, 32, 1280                      # v_local=320 pads to 512
+    x = jnp.asarray(rng.normal(0, 2, (T, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 0.5, (V, d)), jnp.float32)
+    # targets chosen INSIDE the would-be padded windows [320*k+?]: id 400
+    # shifts to 80 on shard 1 but to 400-960<0... the poisoning case is
+    # shard 0 seeing t_loc=400 in [320, 512)
+    t = jnp.asarray(np.full((T,), 400, dtype=np.int32))
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    body = lambda x_, w_, t_: fused_token_nll_tp(x_, w_, None, t_,
+                                                 "model", 16, 64, True)
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(), P("model", None), P()),
+                       out_specs=P(), check_vma=False)
+    got = np.asarray(fn(x, w, t))
+    want = np.asarray(_naive(x, w, None, t))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
