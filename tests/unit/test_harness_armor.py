@@ -42,3 +42,33 @@ def test_suite_runs_under_poisoned_relay_env():
     assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-1500:])
     # output must be VISIBLE (the broken-capture failure mode printed nothing)
     assert "passed" in p.stdout, (p.stdout[-500:], p.stderr[-500:])
+
+
+def test_tpu_window_distinguishes_never_claimed_from_child_failed(monkeypatch):
+    """Candidate loops (bench_longseq) must not demote a config the
+    hardware never saw: run_with_tpu_window's return_status reports
+    'never-claimed' when no probe ever succeeded vs 'child-failed' when
+    a live claim ran the workload and it died."""
+    import bench_common as bc
+
+    # never-claimed: every probe fails fast
+    monkeypatch.setattr(bc, "probe_backend", lambda *a, **k: "failed")
+    monkeypatch.setattr(bc, "warn_strays", lambda *a, **k: None)
+    r, status = bc.run_with_tpu_window("/nonexistent.py", {}, window_s=0.2,
+                                       child_timeout=1, probe_timeout=0.01,
+                                       return_status=True)
+    assert r is None and status == "never-claimed"
+
+    # child-failed: probe ok, child produces no JSON
+    monkeypatch.setattr(bc, "probe_backend", lambda *a, **k: True)
+    monkeypatch.setattr(bc, "run_child", lambda *a, **k: None)
+    r, status = bc.run_with_tpu_window("/nonexistent.py", {}, window_s=0.2,
+                                       child_timeout=1, probe_timeout=0.01,
+                                       return_status=True)
+    assert r is None and status == "child-failed"
+
+    # ok: result flows through, backward-compatible single-value return
+    monkeypatch.setattr(bc, "run_child", lambda *a, **k: {"metric": "m"})
+    r = bc.run_with_tpu_window("/nonexistent.py", {}, window_s=0.2,
+                               child_timeout=1, probe_timeout=0.01)
+    assert r == {"metric": "m"}
