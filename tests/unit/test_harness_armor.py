@@ -49,6 +49,8 @@ def test_tpu_window_distinguishes_never_claimed_from_child_failed(monkeypatch):
     hardware never saw: run_with_tpu_window's return_status reports
     'never-claimed' when no probe ever succeeded vs 'child-failed' when
     a live claim ran the workload and it died."""
+    if _ROOT not in sys.path:       # bench_common lives at the repo root
+        sys.path.insert(0, _ROOT)
     import bench_common as bc
 
     # never-claimed: every probe fails fast
