@@ -1,0 +1,19 @@
+#!/bin/bash
+# Run every bench serially against a live TPU (the tunnel admits ONE
+# process at a time — never run these concurrently). Each entry point
+# carries its own tunnel armor and last-known-good cache, so a mid-chain
+# wedge costs only the remaining entries. Operator tool; see
+# docs/OPERATIONS.md "Benchmarks".
+set -u
+cd "$(dirname "$0")"
+for b in bench.py bench_bert.py bench_inference.py bench_longseq.py \
+         bench_offload.py; do
+  echo "=== $b $(date -u +%H:%M:%SZ) ==="
+  python "$b" || echo "[bench_all] $b failed (continuing)"
+  sleep 20   # let the tunnel grant drain between claimants
+done
+echo "=== probes ==="
+python bench_woq_probe.py || echo "[bench_all] woq probe failed"
+sleep 20
+python bench_decompose.py || echo "[bench_all] decompose failed"
+echo "=== bench_all done $(date -u +%H:%M:%SZ) ==="
