@@ -65,28 +65,34 @@ def _run_workload():
         # decoder configs close the chain so a BERT-specific failure still
         # records a TPU number (350m/mbs16/seq512 won the round-3 sweep
         # among decoder configs).
-        candidates = [("bert", "large", 64, 128, True),
-                      ("bert", "large", 32, 128, True),
-                      ("gpt2", "350m", 16, 512, True),
-                      ("gpt2", "125m", 16, 512, True)]
+        # last tuple element: fused_xent (None = auto → Pallas fused loss
+        # on TPU). The fused kernel is the better program, but the
+        # fused=False twin follows IMMEDIATELY so a kernel-compile failure
+        # on a new toolchain costs one candidate, never the measurement.
+        candidates = [("bert", "large", 64, 128, True, None),
+                      ("bert", "large", 64, 128, True, False),
+                      ("bert", "large", 32, 128, True, False),
+                      ("gpt2", "350m", 16, 512, True, False),
+                      ("gpt2", "125m", 16, 512, True, False)]
         if os.environ.get("DSTPU_BENCH_TRY_NOREMAT") == "1":
             # Operator opt-in only: activations fit at these shapes and
             # skipping the backward recompute is free MFU, but the round-3
             # sweep saw the tunnel's remote-compile helper HTTP-500 on
             # EVERY no-remat graph — leading with a known-crasher by
             # default would burn the window against a wedge-prone tunnel.
-            candidates.insert(0, ("bert", "large", 64, 128, False))
+            candidates.insert(0, ("bert", "large", 64, 128, False, False))
+            candidates.insert(0, ("bert", "large", 64, 128, False, None))
         n_steps = 10
     else:
         # CPU fallback: tiny shapes so a 1-core box finishes in minutes.
-        candidates = [("bert", "tiny", 8, 128, True)]
+        candidates = [("bert", "tiny", 8, 128, True, False)]
         n_steps = 3
 
     last_err = None
-    for family, size, micro, seq, remat in candidates:
+    for family, size, micro, seq, remat, fused in candidates:
         try:
             _measure(family, size, micro, seq, n_steps, devices, on_tpu,
-                     remat=remat)
+                     remat=remat, fused=fused)
             return
         except Exception as e:       # RESOURCE_EXHAUSTED, divergence, ...
             # keep only the message: the live traceback would pin the OOMed
@@ -106,7 +112,7 @@ def _run_workload():
 
 
 def _measure(family, size, micro, seq, n_steps, devices, on_tpu,
-             remat: bool = True):
+             remat: bool = True, fused=None):
     import time
 
     import numpy as np
@@ -132,7 +138,8 @@ def _measure(family, size, micro, seq, n_steps, devices, on_tpu,
         "zero_optimization": {"stage": 1},
         "remat": {"enabled": remat, "policy": "dots_saveable"},
     }
-    model_cfg = (bert if is_bert else gpt2)(size, max_seq=seq)
+    model_cfg = (bert if is_bert else gpt2)(size, max_seq=seq,
+                                            fused_xent=fused)
     model = build_model(model_cfg)
     engine = ds.initialize(cfg, model)
 
@@ -179,9 +186,10 @@ def _measure(family, size, micro, seq, n_steps, devices, on_tpu,
     # Reference anchor: 64 TFLOPS / 125 TFLOPS fp16 peak V100 = 51.2% kernel MFU
     vs_baseline = mfu / 0.512
 
+    xent = bc.xent_label(fused, on_tpu)
     unit = (f"MFU (tokens/s={tokens_per_sec:.0f}, step={dt * 1000:.1f}ms, "
-            f"seq={seq}, remat={'on' if remat else 'off'}, devices={n_dev}, "
-            f"platform={devices[0].platform}")
+            f"seq={seq}, remat={'on' if remat else 'off'}, xent={xent}, "
+            f"devices={n_dev}, platform={devices[0].platform}")
     if not on_tpu:
         unit += ", CPU-FALLBACK: TPU tunnel unavailable"
     unit += ")"

@@ -6,14 +6,16 @@
 # docs/OPERATIONS.md "Benchmarks".
 set -u
 cd "$(dirname "$0")"
+fails=0
 for b in bench.py bench_bert.py bench_inference.py bench_longseq.py \
          bench_offload.py; do
   echo "=== $b $(date -u +%H:%M:%SZ) ==="
-  python "$b" || echo "[bench_all] $b failed (continuing)"
+  python "$b" || { echo "[bench_all] $b failed (continuing)"; fails=$((fails+1)); }
   sleep 20   # let the tunnel grant drain between claimants
 done
 echo "=== probes ==="
-python bench_woq_probe.py || echo "[bench_all] woq probe failed"
+python bench_woq_probe.py || { echo "[bench_all] woq probe failed"; fails=$((fails+1)); }
 sleep 20
-python bench_decompose.py || echo "[bench_all] decompose failed"
-echo "=== bench_all done $(date -u +%H:%M:%SZ) ==="
+python bench_decompose.py || { echo "[bench_all] decompose failed"; fails=$((fails+1)); }
+echo "=== bench_all done, $fails failures $(date -u +%H:%M:%SZ) ==="
+exit $((fails > 0))

@@ -42,19 +42,24 @@ def _run_workload():
     on_tpu = devices[0].platform == "tpu"
     seq = 128
     if on_tpu:
-        candidates = [("large", 64), ("large", 32), ("base", 64)]
+        # (size, micro, fused_xent): the fused-loss candidate leads, its
+        # XLA-loss twin follows so a Pallas-compile failure on a new
+        # toolchain costs one candidate, never the measurement
+        candidates = [("large", 64, None), ("large", 64, False),
+                      ("large", 32, False), ("base", 64, False)]
         n_steps = 10
     else:
-        candidates = [("tiny", 8)]
+        candidates = [("tiny", 8, False)]
         n_steps = 2
 
     import gc
 
     last_err = None
     result = None
-    for size, micro in candidates:
+    for size, micro, fused in candidates:
         try:
-            result = _measure(size, micro, seq, n_steps, devices, on_tpu)
+            result = _measure(size, micro, seq, n_steps, devices, on_tpu,
+                              fused=fused)
             break
         except Exception as e:
             last_err = RuntimeError(f"{type(e).__name__}: {str(e)[:300]}")
@@ -80,7 +85,8 @@ def _run_workload():
         try:
             gc.collect()
             jax.clear_caches()
-            r512 = _measure("large", 16, 512, n_steps, devices, on_tpu)
+            r512 = _measure("large", 16, 512, n_steps, devices, on_tpu,
+                            fused=fused)
             result["rows"] = {"seq512": {
                 "mfu": r512["value"],
                 "vs_seq512_anchor": round(r512["value"] / 0.424, 4)}}
@@ -94,7 +100,7 @@ def _run_workload():
                   f"{type(e).__name__}: {str(e)[:200]}", flush=True)
 
 
-def _measure(size, micro, seq, n_steps, devices, on_tpu):
+def _measure(size, micro, seq, n_steps, devices, on_tpu, fused=None):
     import numpy as np
 
     import deepspeed_tpu as ds
@@ -110,7 +116,7 @@ def _measure(size, micro, seq, n_steps, devices, on_tpu):
         "zero_optimization": {"stage": 1},
         "remat": {"enabled": True, "policy": "dots_saveable"},
     }
-    model_cfg = bert(size, max_seq=seq)
+    model_cfg = bert(size, max_seq=seq, fused_xent=fused)
     engine = ds.initialize(cfg, build_model(model_cfg))
 
     rng = np.random.default_rng(0)
@@ -129,8 +135,10 @@ def _measure(size, micro, seq, n_steps, devices, on_tpu):
     mfu = tokens_per_sec * model_cfg.flops_per_token() / (
         peak_flops_for(devices[0]) * n_dev)
     samples_per_sec = engine.train_batch_size / dt
+    xent = bc.xent_label(fused, on_tpu)
     unit = (f"MFU (samples/s={samples_per_sec:.0f}, step={dt * 1000:.1f}ms, "
-            f"seq={seq}, devices={n_dev}, platform={devices[0].platform}")
+            f"seq={seq}, xent={xent}, devices={n_dev}, "
+            f"platform={devices[0].platform}")
     if not on_tpu:
         unit += ", CPU-FALLBACK"
     unit += ")"

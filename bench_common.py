@@ -206,3 +206,9 @@ def cached_result(cache_path: str, tag: str = "bench"):
     result["unit"] = unit + f", last-known-good cached {payload['iso']})"
     log("TPU unavailable; reporting last-known-good cached measurement", tag)
     return result
+
+
+def xent_label(fused, on_tpu: bool) -> str:
+    """Unit-string label for the loss path (mirrors TransformerConfig's
+    fused_xent auto rule at DP-only bench shapes: None = fused on TPU)."""
+    return "fused" if (fused or (fused is None and on_tpu)) else "xla"
