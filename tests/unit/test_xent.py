@@ -251,3 +251,20 @@ def test_tp_foreign_target_in_padded_region_not_poisoned():
     got = np.asarray(fn(x, w, t))
     want = np.asarray(_naive(x, w, None, t))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_fused_xent_with_fp16_loss_scaling():
+    """fp16 dynamic loss scaling multiplies the loss before backward; the
+    scaled cotangent must flow through the fused kernel's custom VJP
+    (linearity) and converge exactly like the XLA path."""
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+    }, build_model(tiny_test(n_layer=2, fused_xent=True)))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(4)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
