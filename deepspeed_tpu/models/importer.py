@@ -20,6 +20,7 @@ to matmul ``(in, out)``), and the RoPE basis permutation (HF "rotate-half"
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import math
 import os
@@ -243,7 +244,7 @@ def _internlm_config(hf: dict) -> TransformerConfig:
     ``"bias": true``)."""
     cfg = _llama_config(hf)
     if hf.get("bias", True):
-        cfg = TransformerConfig(**{**cfg.__dict__, "use_bias": True})
+        cfg = dataclasses.replace(cfg, use_bias=True)
     return cfg
 
 
@@ -676,9 +677,7 @@ def _qwen2_config(hf: dict) -> TransformerConfig:
     cfg = _llama_config(hf)
     # Qwen2 = llama trunk + attention-projection biases (q/k/v only; the
     # remaining bias slots import as zeros)
-    import dataclasses as _dc
-
-    return _dc.replace(cfg, use_bias=True)
+    return dataclasses.replace(cfg, use_bias=True)
 
 
 def _qwen2_convert(sd: _SDict, cfg: TransformerConfig) -> dict:
@@ -1072,8 +1071,6 @@ def _megatron_moe_config(hf: dict) -> TransformerConfig:
     bank, ``moe/sharded_moe.py``). ``num_experts`` may arrive as the
     Megatron arg list form; top-k defaults to the reference TopKGate's
     k=1 (Switch-style) unless the args say otherwise."""
-    import dataclasses as _dc
-
     cfg = _megatron_config(hf)
     E = hf["num_experts"]
     if isinstance(E, (list, tuple)):
@@ -1089,8 +1086,9 @@ def _megatron_moe_config(hf: dict) -> TransformerConfig:
             ">=2 experts (a 1-expert bank would import into shapes the dense "
             "model cannot consume) — import it as model_type='megatron_gpt' "
             "after renaming the expert MLP keys to the dense layout")
-    return _dc.replace(cfg, num_experts=int(E),
-                       moe_top_k=int(hf.get("moe_top_k", hf.get("topk", 1))))
+    return dataclasses.replace(
+        cfg, num_experts=int(E),
+        moe_top_k=int(hf.get("moe_top_k", hf.get("topk", 1))))
 
 
 def _megatron_moe_convert(sd: _SDict, cfg: TransformerConfig) -> dict:

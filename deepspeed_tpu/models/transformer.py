@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..platform.mesh import BATCH_AXES, constrain
+from ..platform.mesh import BATCH_AXES, constrain, current_mesh
 
 B_AXES = BATCH_AXES  # ("data", "zero", "expert")
 
@@ -214,8 +214,6 @@ def vocab_parallel_lookup(table, ids):
     foreign ids to zero, and one psum over ``model`` assembles the rows —
     activation-sized traffic instead of table-sized.
     """
-    from ..platform.mesh import current_mesh
-
     ctx = current_mesh()
     manual = getattr(ctx, "manual_axes", frozenset()) if ctx is not None \
         else frozenset()
@@ -706,8 +704,6 @@ class TransformerLM:
         if cfg.fused_xent is False or not cfg.tie_embeddings \
                 or cfg.objective not in ("clm", "mlm"):
             return False
-        from ..platform.mesh import current_mesh
-
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:
             if getattr(mesh, "manual_axes", frozenset()):
@@ -737,7 +733,6 @@ class TransformerLM:
         ops/xent.py, shard_mapped over the batch axes when data-parallel
         (each shard computes its own tokens; W/bias replicated)."""
         from ..ops.xent import fused_token_nll
-        from ..platform.mesh import current_mesh
 
         cfg = self.cfg
         table = params["tok_embed"].astype(feats.dtype)
