@@ -37,7 +37,11 @@ import time
 import bench_common as bc
 
 _CHILD_MARK = "_DSTPU_BENCH_CHILD"
-_CHILD_TIMEOUT_S = 1800   # up to 3 candidate compiles over the tunnel
+# Budget for the whole candidate chain in one child: 5 standard candidates
+# (7 with DSTPU_BENCH_TRY_NOREMAT), each a remote compile (~1-5 min over
+# the tunnel) + 10 timed steps; failures surface fast (OOM/HTTP-500
+# raise within the first compile).
+_CHILD_TIMEOUT_S = 2400
 _TPU_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 40 * 60))
 _CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_TPU_CACHE.json")
