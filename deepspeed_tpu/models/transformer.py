@@ -77,7 +77,8 @@ class TransformerConfig:
     mlm_transform: bool = False
     # Fused Pallas softmax-xent over the unembedding (ops/xent.py): never
     # materializes (B,S,V) logits. None = auto (on for TPU when eligible:
-    # tied embeddings, clm/mlm, model/seq/pipe axes unsharded).
+    # tied embeddings, clm/mlm, seq/pipe axes unsharded; data-parallel
+    # and vocab-sharded TP meshes both supported via shard_map).
     fused_xent: Optional[bool] = None
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16             # compute dtype
