@@ -31,8 +31,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..platform.mesh import BATCH_AXES, constrain
-from .transformer import _norm, _token_nll, vocab_parallel_lookup
+from ..platform.mesh import BATCH_AXES, constrain, current_mesh
+from .transformer import (_norm, _token_nll, fused_nll_sharded,
+                          mesh_dp_world, vocab_parallel_lookup)
 
 B_AXES = BATCH_AXES
 
@@ -52,6 +53,10 @@ class T5Config:
     tie_embeddings: bool = True
     pad_token_id: int = 0
     norm_eps: float = 1e-6
+    # Fused Pallas softmax-xent over the tied shared embedding (see
+    # TransformerConfig.fused_xent). None = auto: on for TPU when tied
+    # and the model/seq/pipe axes are unsharded.
+    fused_xent: Any = None
     dtype: Any = jnp.bfloat16
     # Nominal sequence lengths for FLOPs/MFU accounting only (runtime
     # shapes come from the batch): typical span-corruption pretraining.
@@ -329,15 +334,25 @@ class T5Model:
                      cfg.norm_eps)
 
     # ------------------------------------------------------------------ api
+    def _features(self, params, input_ids, decoder_input_ids,
+                  attention_mask, remat_policy):
+        """Everything before the unembedding: (B, Sd, D) decoder output,
+        already d_model^-0.5-rescaled when tied (the HF T5 rule). Shared
+        by apply() and the fused loss path so they cannot drift."""
+        self._remat_policy = remat_policy
+        enc_out = self._encode(params, input_ids, attention_mask)
+        x = self._decode(params, decoder_input_ids, enc_out, attention_mask)
+        if self.cfg.tie_embeddings:
+            x = x * (self.cfg.d_model ** -0.5)
+        return x
+
     def apply(self, params, input_ids, decoder_input_ids, *,
               attention_mask=None, remat_policy=None, return_aux=False):
         """((B,Se), (B,Sd)) → (B, Sd, V) logits."""
         cfg = self.cfg
-        self._remat_policy = remat_policy
-        enc_out = self._encode(params, input_ids, attention_mask)
-        x = self._decode(params, decoder_input_ids, enc_out, attention_mask)
+        x = self._features(params, input_ids, decoder_input_ids,
+                           attention_mask, remat_policy)
         if cfg.tie_embeddings:
-            x = x * (cfg.d_model ** -0.5)     # HF T5: rescale when tied
             logits = x @ params["shared"].astype(x.dtype).T
         else:
             logits = x @ params["lm_head"].astype(x.dtype)
@@ -355,15 +370,42 @@ class T5Model:
         dec_ids = batch.get("decoder_input_ids")
         if dec_ids is None:
             dec_ids = self._shift_right(labels)
-        logits = self.apply(params, batch["input_ids"], dec_ids,
-                            attention_mask=batch.get("attention_mask"),
-                            remat_policy=remat_policy)
         safe = jnp.maximum(labels, 0)
-        nll = _token_nll(logits, safe)
+        if self._fused_xent_active(n_tokens=labels.shape[0] * labels.shape[1]):
+            x = self._features(params, batch["input_ids"], dec_ids,
+                               batch.get("attention_mask"), remat_policy)
+            nll = fused_nll_sharded(x, safe,
+                                    params["shared"].astype(x.dtype))
+        else:
+            logits = self.apply(params, batch["input_ids"], dec_ids,
+                                attention_mask=batch.get("attention_mask"),
+                                remat_policy=remat_policy)
+            nll = _token_nll(logits, safe)
         mask = batch.get("loss_mask")
         w = (mask.astype(jnp.float32) if mask is not None
              else (labels != -100).astype(jnp.float32))
         return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    def _fused_xent_active(self, n_tokens=None) -> bool:
+        """T5 fused-loss gate: tied shared embedding only (the kernel takes
+        the (V, d) table), and conservatively NO model/seq/pipe sharding —
+        the shared table's TP layout differs from the decoder trunk's, so
+        T5 does not take the vocab-sharded variant."""
+        cfg = self.cfg
+        if cfg.fused_xent is False or not cfg.tie_embeddings:
+            return False
+        mesh = current_mesh()
+        if mesh is not None and not mesh.empty:
+            if getattr(mesh, "manual_axes", frozenset()):
+                return False
+            for ax in ("model", "seq", "pipe"):
+                if ax in mesh.axis_names and mesh.shape[ax] != 1:
+                    return False
+            if n_tokens is not None and n_tokens % mesh_dp_world(mesh) != 0:
+                return False
+        if cfg.fused_xent:
+            return True
+        return jax.default_backend() == "tpu"
 
 
 def t5(size: str = "small", **overrides) -> T5Config:

@@ -274,6 +274,46 @@ def _token_nll_impl(logits, targets):
 _token_nll = jax.checkpoint(_token_nll_impl)
 
 
+def mesh_dp_world(mesh) -> int:
+    """Product of the batch (token-sharding) axes of a mesh."""
+    return int(math.prod(mesh.shape[a] for a in BATCH_AXES
+                         if a in mesh.axis_names))
+
+
+def fused_nll_sharded(feats, targets, table, bias=None):
+    """(B, S', D) features + (B, S') targets → (B, S') fp32 NLL via the
+    fused Pallas kernel (ops/xent.py), shard_mapped over the batch axes
+    when data-parallel and over the model axis (vocab-sharded variant)
+    when tensor-parallel. ``table`` is the (V, D) unembedding in
+    embedding layout; shared by the decoder trunk's and T5's loss paths."""
+    from ..ops.xent import fused_token_nll, fused_token_nll_tp
+
+    B, S, dm = feats.shape
+    h2 = feats.reshape(B * S, dm)
+    t2 = targets.reshape(B * S).astype(jnp.int32)
+    mesh = current_mesh()
+    in_mesh = mesh is not None and not mesh.empty
+    dp = mesh_dp_world(mesh) if in_mesh else 1
+    tp = int(mesh.shape.get("model", 1)) if in_mesh else 1
+    if dp > 1 or tp > 1:
+        has_b = bias is not None
+
+        def body(h, w, *rest):
+            b, t = rest if has_b else (None, rest[0])
+            if tp > 1:
+                return fused_token_nll_tp(h, w, b, t, "model")
+            return fused_token_nll(h, w, b, t)
+
+        in_specs = ((P(B_AXES, None), P("model", None))
+                    + ((P("model"),) if has_b else ()) + (P(B_AXES),))
+        args = (h2, table) + ((bias,) if has_b else ()) + (t2,)
+        nll2 = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(B_AXES), check_vma=False)(*args)
+    else:
+        nll2 = fused_token_nll(h2, table, bias, t2)
+    return nll2.reshape(B, S)
+
+
 def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None,
                      causal: bool = True, bias: jnp.ndarray | None = None):
     """Plain attention, fp32 softmax. q:(B,S,H,hd) k/v:(B,S,KV,hd).
@@ -726,41 +766,12 @@ class TransformerLM:
 
     @staticmethod
     def _dp_world(mesh) -> int:
-        return int(math.prod(mesh.shape[a] for a in BATCH_AXES
-                             if a in mesh.axis_names))
+        return mesh_dp_world(mesh)
 
     def _fused_nll(self, params, feats, targets):
-        """(B, S', D) features + (B, S') targets → (B, S') fp32 NLL via
-        ops/xent.py, shard_mapped over the batch axes when data-parallel
-        (each shard computes its own tokens; W/bias replicated)."""
-        from ..ops.xent import fused_token_nll
-
         cfg = self.cfg
-        table = params["tok_embed"].astype(feats.dtype)
         bias = (params["lm_head_bias"].astype(feats.dtype)
                 if cfg.lm_head_bias else None)
-        B, S, dm = feats.shape
-        h2 = feats.reshape(B * S, dm)
-        t2 = targets.reshape(B * S).astype(jnp.int32)
-        mesh = current_mesh()
-        in_mesh = mesh is not None and not mesh.empty
-        dp = self._dp_world(mesh) if in_mesh else 1
-        tp = int(mesh.shape.get("model", 1)) if in_mesh else 1
-        if dp > 1 or tp > 1:
-            has_b = bias is not None
-            from ..ops.xent import fused_token_nll_tp
-
-            def body(h, w, *rest):
-                b, t = rest if has_b else (None, rest[0])
-                if tp > 1:
-                    return fused_token_nll_tp(h, w, b, t, "model")
-                return fused_token_nll(h, w, b, t)
-
-            in_specs = ((P(B_AXES, None), P("model", None))
-                        + ((P("model"),) if has_b else ()) + (P(B_AXES),))
-            args = (h2, table) + ((bias,) if has_b else ()) + (t2,)
-            nll2 = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                                 out_specs=P(B_AXES), check_vma=False)(*args)
-        else:
-            nll2 = fused_token_nll(h2, table, bias, t2)
-        return nll2.reshape(B, S)
+        return fused_nll_sharded(feats, targets,
+                                 params["tok_embed"].astype(feats.dtype),
+                                 bias)
