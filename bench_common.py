@@ -193,9 +193,14 @@ def mlm_batch(rng, batch_size: int, seq: int, vocab: int,
             "loss_mask": mask.astype(np.float32)}
 
 
-def cached_result(cache_path: str, tag: str = "bench"):
+def cached_result(cache_path: str, tag: str = "bench", *,
+                  preemptive: bool = False):
     """Annotated last-known-good TPU result for a bench main's fallback
-    chain, or None. One implementation for every bench entry point."""
+    chain, or None. One implementation for every bench entry point.
+
+    ``preemptive``: the caller is emitting the cache UPFRONT as driver-kill
+    armor (before any tunnel contact), not because the TPU is unavailable —
+    log accordingly so a healthy window's stderr doesn't claim a wedge."""
     payload = load_tpu_cache(cache_path, tag)
     if payload is None:
         return None
@@ -204,7 +209,33 @@ def cached_result(cache_path: str, tag: str = "bench"):
     if unit.endswith(")"):
         unit = unit[:-1]                       # reopen the trailing paren
     result["unit"] = unit + f", last-known-good cached {payload['iso']})"
-    log("TPU unavailable; reporting last-known-good cached measurement", tag)
+    if preemptive:
+        log("emitting last-known-good cache upfront (driver-kill armor); "
+            "a fresh measurement, if any, follows as a later line", tag)
+    else:
+        log("TPU unavailable; reporting last-known-good cached measurement",
+            tag)
+    return result
+
+
+def emit_cache_upfront(cache_path: str, tag: str = "bench",
+                       out_path: str | None = None):
+    """Driver-kill armor for every bench entry point: print the
+    last-known-good cache line (and pre-write the artifact file) BEFORE
+    any tunnel contact, so a parent killed on the driver's own timeout
+    (round-3 artifact: rc=124, parsed null, window still retrying) still
+    leaves a parseable artifact. A fresh measurement printed later
+    supersedes the line (drivers parse the LAST JSON line) and overwrites
+    the file."""
+    result = cached_result(cache_path, tag, preemptive=True)
+    if result is None:
+        return None
+    print(json.dumps(result), flush=True)
+    if out_path is not None:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
     return result
 
 

@@ -119,6 +119,7 @@ def main():
     if os.environ.get(_CHILD_MARK) == "1":
         _run_workload()
         return
+    bc.emit_cache_upfront(_CACHE, tag="longseq-bench", out_path=_OUT)
     env = dict(os.environ)
     env[_CHILD_MARK] = "1"
     me = os.path.abspath(__file__)

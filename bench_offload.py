@@ -99,6 +99,7 @@ def main():
     if os.environ.get(_CHILD_MARK) == "1":
         _run_workload()
         return
+    bc.emit_cache_upfront(_CACHE, tag="offload-bench", out_path=_OUT)
     env = dict(os.environ)
     env[_CHILD_MARK] = "1"
     me = os.path.abspath(__file__)

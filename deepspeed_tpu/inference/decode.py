@@ -112,14 +112,20 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
                       bias=bias)
     o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
                           p, "bo")
+    # MoE trunks expose a single-group no-drop dispatch (_mlp_block_infer,
+    # models/moe.py) for the T=1 decode step; prefill (T>1) and dense
+    # trunks use the training MLP unchanged (per-row grouping keeps
+    # prefill's dispatch one-hots at the training memory profile).
+    mlp = (getattr(model, "_mlp_block_infer", None) if T == 1 else None) \
+        or model._mlp_block
     if cfg.parallel_residual:
         y2 = y if cfg.parallel_shared_ln else _norm(
             x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-        out, _aux = model._mlp_block(y2, p)
+        out, _aux = mlp(y2, p)
         return x + o + out, cache_k, cache_v
     x = x + o
     y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-    out, _aux = model._mlp_block(y2, p)
+    out, _aux = mlp(y2, p)
     return x + out, cache_k, cache_v
 
 

@@ -52,11 +52,29 @@ class InferenceEngine:
         cfg = self.config
         self.compute_dtype = cfg.compute_dtype
         self.model = model_with_dtype(model, self.compute_dtype)
+        if getattr(self.model.cfg, "num_experts", 1) > 1:
+            # MoE prefill routes through the training dispatch; serve with
+            # the (larger) eval capacity factor so fewer tokens drop
+            # (reference eval_capacity_factor). Clone before flagging so a
+            # shared training model doesn't inherit eval routing.
+            if self.model is model:
+                self.model = copy.copy(model)
+            self.model.moe_eval_mode = True
         self.mesh = mesh or build_mesh(MeshSpec(data=-1, model=cfg.tensor_parallel))
 
-        cast = jax.tree.map(
-            lambda p: p.astype(self.compute_dtype)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        # Same fp32 exemptions as the training engine's compute cast
+        # (runtime/engine.py _cast_compute): leaves the model names — MoE
+        # routers above all — stay fp32 so near-tie routing decisions
+        # don't flap across bf16 rounding at serve time.
+        keep = set(getattr(self.model, "fp32_param_names", lambda: ())())
+
+        def _cast(path, p):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in keep or not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            return p.astype(self.compute_dtype)
+
+        cast = jax.tree_util.tree_map_with_path(_cast, params)
         if cfg.quantize:
             assert cfg.tensor_parallel == 1, \
                 "WOQ + TP: not yet supported together"

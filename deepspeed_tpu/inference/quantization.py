@@ -99,9 +99,11 @@ def _should_quantize(path, leaf, min_size: int) -> bool:
     if leaf.ndim < 2 or leaf.size < min_size:
         return False
     name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-    # norms/bias stay full precision (match the reference WOQ exclusions)
+    # norms/bias stay full precision (match the reference WOQ exclusions);
+    # MoE routers too — near-tie routing decisions flap across quantization
+    # rounding, same reason the engine's compute cast keeps them fp32.
     return not (name.startswith(("ln", "b")) or "bias" in name
-                or "scale" in name)
+                or "scale" in name or name == "router")
 
 
 def quantize_params(params: Any, group_size: int = 128,

@@ -163,6 +163,55 @@ class MoETransformerLM(TransformerLM):
             return u + p[name][:, None, :].astype(u.dtype)  # (E,f) -> (E,1,f)
         return u
 
+    # -------------------------------------------------------- inference MoE
+    def _mlp_block_infer(self, y, p):
+        """Single-group MoE dispatch for the T=1 KV-cache decode step
+        (reference ``DeepSpeedMoEInference``,
+        ``ops/transformer/inference/moe_inference.py:159``).
+
+        The training dispatch groups tokens per batch row so each group's
+        capacity is a static function of S — but at decode T=1 that
+        degenerates to ``min_capacity`` slots per row on every expert
+        (min_capacity·E× the ideal compute). Decode instead flattens the
+        B·1 tokens into ONE routing group with capacity C = B: NO token is
+        ever dropped (a decode drop silently zeroes that token's FFN
+        contribution, with no training loss to compensate — a generation
+        quality bug, not a throughput tradeoff). Compute is E·B·d·f, E/k×
+        the routed ideal, but decode is HBM-bandwidth-bound on the expert
+        bank read, so the slack compute is hidden; the bench's MBU row
+        counts the full bank read for the same reason. Routing decisions
+        are per-token and independent of grouping, so the output equals
+        the training layer's exactly whenever the training path doesn't
+        drop either (the decode parity test pins this). Prefill (T>1)
+        keeps the training per-row dispatch — same memory profile as
+        training, no B× inflation of the dispatch one-hots."""
+        cfg = self.cfg
+        B, T, d = y.shape
+        E = cfg.num_experts
+        tg = B * T
+        C = tg
+        yt = y.reshape(tg, d)
+        logits = yt.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+        combine, dispatch, aux = topk_gating(logits, cfg.moe_top_k, C)
+
+        # (tg,E,C) x (tg,d) -> (E,C,d); the expert axis carries the same
+        # all-to-all the training path's constraint emits.
+        xs = jnp.einsum("tec,td->ecd", dispatch.astype(y.dtype), yt)
+        xs = constrain(xs, P("expert", None, None))
+        u = jnp.einsum("ecd,edf->ecf", xs, p["w_in"].astype(y.dtype))
+        u = self._expert_bias(u, p, "b_in")
+        if cfg.is_glu:
+            g = jnp.einsum("ecd,edf->ecf", xs, p["w_gate"].astype(y.dtype))
+            u = jax.nn.silu(g) * u
+        else:
+            u = _activation(u, cfg.activation)
+        u = constrain(u, P("expert", None, "model"))
+        out = jnp.einsum("ecf,efd->ecd", u, p["w_out"].astype(y.dtype))
+        out = self._expert_bias(out, p, "b_out")
+        out = constrain(out, P("expert", None, None))
+        res = jnp.einsum("tec,ecd->td", combine.astype(y.dtype), out)
+        return res.reshape(B, T, d), aux.astype(jnp.float32)
+
     # ----------------------------------------------------------------- init
     def init(self, rng) -> dict:
         params = super().init(rng)

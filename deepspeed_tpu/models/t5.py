@@ -371,7 +371,7 @@ class T5Model:
         if dec_ids is None:
             dec_ids = self._shift_right(labels)
         safe = jnp.maximum(labels, 0)
-        if self._fused_xent_active(n_tokens=labels.shape[0] * labels.shape[1]):
+        if self._fused_xent_active(batch_size=labels.shape[0]):
             x = self._features(params, batch["input_ids"], dec_ids,
                                batch.get("attention_mask"), remat_policy)
             nll = fused_nll_sharded(x, safe,
@@ -386,11 +386,12 @@ class T5Model:
              else (labels != -100).astype(jnp.float32))
         return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
-    def _fused_xent_active(self, n_tokens=None) -> bool:
+    def _fused_xent_active(self, batch_size=None) -> bool:
         """T5 fused-loss gate: tied shared embedding only (the kernel takes
         the (V, d) table), and conservatively NO model/seq/pipe sharding —
         the shared table's TP layout differs from the decoder trunk's, so
-        T5 does not take the vocab-sharded variant."""
+        T5 does not take the vocab-sharded variant. Batch must split on
+        batch boundaries across the dp world (see the decoder gate)."""
         cfg = self.cfg
         if cfg.fused_xent is False or not cfg.tie_embeddings:
             return False
@@ -401,7 +402,8 @@ class T5Model:
             for ax in ("model", "seq", "pipe"):
                 if ax in mesh.axis_names and mesh.shape[ax] != 1:
                     return False
-            if n_tokens is not None and n_tokens % mesh_dp_world(mesh) != 0:
+            if batch_size is not None \
+                    and batch_size % mesh_dp_world(mesh) != 0:
                 return False
         if cfg.fused_xent:
             return True

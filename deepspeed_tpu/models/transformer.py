@@ -304,11 +304,17 @@ def fused_nll_sharded(feats, targets, table, bias=None):
                 return fused_token_nll_tp(h, w, b, t, "model")
             return fused_token_nll(h, w, b, t)
 
-        in_specs = ((P(B_AXES, None), P("model", None))
-                    + ((P("model"),) if has_b else ()) + (P(B_AXES),))
+        # Specs name only axes the mesh actually carries: a user-built
+        # mesh with, say, just a "data" axis still takes the fused path
+        # instead of crashing on an unknown axis name (advisor r3). tp > 1
+        # implies "model" exists (tp is read off the mesh above).
+        b_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names) or None
+        mdl = "model" if "model" in mesh.axis_names else None
+        in_specs = ((P(b_axes, None), P(mdl, None))
+                    + ((P(mdl),) if has_b else ()) + (P(b_axes),))
         args = (h2, table) + ((bias,) if has_b else ()) + (t2,)
         nll2 = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=P(B_AXES), check_vma=False)(*args)
+                             out_specs=P(b_axes), check_vma=False)(*args)
     else:
         nll2 = fused_token_nll(h2, table, bias, t2)
     return nll2.reshape(B, S)
@@ -704,7 +710,7 @@ class TransformerLM:
         ids = batch["input_ids"]
         mlm = self.cfg.objective == "mlm"
         B, S = ids.shape
-        if self._fused_xent_active(n_tokens=B * (S if mlm else S - 1)):
+        if self._fused_xent_active(batch_size=B):
             x, aux = self._trunk(params, ids, batch.get("attention_mask"),
                                  remat_policy)
             feats = self._pre_head(params, x)
@@ -731,16 +737,19 @@ class TransformerLM:
             ce = ce + self.cfg.moe_aux_loss_weight * aux
         return ce
 
-    def _fused_xent_active(self, n_tokens: Optional[int] = None) -> bool:
+    def _fused_xent_active(self, batch_size: Optional[int] = None) -> bool:
         """Route the loss through the fused Pallas softmax-xent kernel?
         Auto (fused_xent=None): on for TPU when the head is expressible —
         tied embeddings (W stays in (V, d) table layout, no transpose) and
-        no model/seq/pipe sharding (the kernel runs per data shard under
-        shard_map; a vocab- or seq-sharded head keeps the XLA path). A
-        token count not divisible by the data-parallel world also keeps
-        the XLA path: shard_map splits rows evenly where GSPMD would pad
-        (partial eval batches must not start erroring because the fused
-        path auto-activated)."""
+        no seq/pipe sharding (the kernel runs per data shard under
+        shard_map; a seq-sharded head keeps the XLA path; model-axis
+        sharding takes the vocab-sharded TP kernel). A batch size not
+        divisible by the data-parallel world also keeps the XLA path:
+        shard_map would split the flattened rows mid-sequence, which is
+        numerically fine (the kernel is per-token) but forces a resharding
+        gather against the batch-sharded feature layout right in the hot
+        loss path — and partial eval batches must not start erroring
+        because the fused path auto-activated."""
         cfg = self.cfg
         if cfg.fused_xent is False or not cfg.tie_embeddings \
                 or cfg.objective not in ("clm", "mlm"):
@@ -758,7 +767,8 @@ class TransformerLM:
             tp = int(mesh.shape.get("model", 1))
             if tp > 1 and cfg.vocab_size % tp != 0:
                 return False
-            if n_tokens is not None and n_tokens % self._dp_world(mesh) != 0:
+            if batch_size is not None \
+                    and batch_size % self._dp_world(mesh) != 0:
                 return False
         if cfg.fused_xent:
             return True

@@ -359,8 +359,12 @@ def _bwd_tp_rule(axis, block_t, block_v, interpret, res, g):
     # Under check_vma=False shard_map distributes a replicated output's
     # cotangent as g/axis_size per shard; undo that so each shard's
     # slice-local dw/dbias (and its dx partial, which shard_map's
-    # replicated-x backward then psums) carry the full signal. The TP
-    # equivalence test pins this convention against JAX changes.
+    # replicated-x backward then psums) carry the full signal.
+    # CAUTION (JAX-upgrade checklist, pinned jax==0.9.0): this
+    # unmentioned-out-axis transpose convention is a JAX internal, not
+    # documented API — a release that changes it would silently double- or
+    # under-scale TP gradients. test_xent.py's TP-equivalence test pins it;
+    # re-run that test first on any JAX bump (docs/OPERATIONS.md).
     g = g * lax.psum(jnp.float32(1.0), axis)
     dx_l, dw, db = _bwd_kernels(x, w_shard, bias_shard, t_loc, lse_g, g,
                                 block_t, block_v, interpret)

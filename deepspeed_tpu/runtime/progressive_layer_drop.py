@@ -29,6 +29,12 @@ def pipe_stage_layer_offset(n_local_layers: int) -> jnp.ndarray:
     try:
         return (lax.axis_index("pipe") * n_local_layers).astype(jnp.float32)
     except NameError:
+        # NameError is what jax==0.9.0 raises for an unbound axis name
+        # (a JAX internal, not API — test_aux.py::test_unbound_axis_raises
+        # pins it; re-check on any JAX bump, docs/OPERATIONS.md). Keeping
+        # the catch NARROW matters: a broader except would silently turn a
+        # real error into offset 0 — the per-stage depth regression this
+        # helper exists to prevent.
         return jnp.float32(0.0)
 
 
@@ -45,6 +51,20 @@ class PLDMixin:
         if self.pld_step is None:
             return super()._scan_layers(x, layers, positions, attn_mask,
                                         remat_policy)
+        from ..platform.mesh import current_mesh
+        mesh = current_mesh()
+        if (mesh is not None and not mesh.empty
+                and int(mesh.shape.get("pipe", 1)) != 1
+                and "pipe" not in getattr(mesh, "manual_axes", frozenset())):
+            # A pipe-sharded mesh whose pipe axis is NOT manual means this
+            # trunk is running outside the pipeline engine's shard_map:
+            # axis_index("pipe") is unbound, the stage offset silently
+            # becomes 0, and PLD regresses to per-stage depth scaling.
+            # Fail loud instead (advisor r3).
+            raise ValueError(
+                "PLD under a pipe-sharded mesh requires the pipeline "
+                "engine (manual pipe axis); running the dense trunk here "
+                "would silently drop the global-depth stage offset")
         L_local = jax.tree.leaves(layers)[0].shape[0]
         # Under pipeline parallelism this method sees only the stage-local
         # layer slice; the PLD depth scaling is defined over the GLOBAL

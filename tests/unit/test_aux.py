@@ -385,6 +385,36 @@ def test_pld_global_offset_under_pipe_axis():
     assert float(pipe_stage_layer_offset(3)) == 0.0   # no axis bound
 
 
+def test_unbound_axis_raises_name_error():
+    """JAX-pin test (jax==0.9.0): lax.axis_index on an unbound axis raises
+    NameError — the exact type pipe_stage_layer_offset catches to detect
+    the dense trunk. If a JAX upgrade changes this type, the narrow catch
+    goes loud (good) but this test localizes the change immediately
+    (see the CAUTION comment in progressive_layer_drop.py)."""
+    from jax import lax
+
+    with pytest.raises(NameError):
+        jax.jit(lambda: lax.axis_index("pipe"))()
+
+
+def test_pld_rejects_nonmanual_pipe_mesh():
+    """PLD on the dense trunk under a pipe-sharded (non-manual) mesh must
+    fail loud: axis_index('pipe') would be unbound, the stage offset would
+    silently become 0, and the depth rule would regress to per-stage
+    (advisor r3)."""
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        convert_to_progressive_layer_drop)
+
+    model = convert_to_progressive_layer_drop(
+        build_model(tiny_test(n_layer=2, max_seq=32)))
+    model.set_pld_step(jnp.float32(10.0))
+    ids = jnp.zeros((4, 16), jnp.int32)
+    with jax.set_mesh(build_mesh(MeshSpec(pipe=2, data=4))):
+        with pytest.raises(ValueError, match="pipeline engine"):
+            model.apply(model.init(jax.random.PRNGKey(0)), ids)
+
+
 # ------------------------------------------------------------------ monitor
 def test_monitor_csv_receives_throughput_events(tmp_path):
     """Engine-wired monitor fan-out (reference monitor/monitor.py:29):

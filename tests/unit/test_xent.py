@@ -136,20 +136,40 @@ def test_engine_trains_with_fused_xent_tensor_parallel():
     assert abs(losses[True][0] - losses[False][0]) < 2e-3, losses
 
 
-def test_fused_gate_declines_indivisible_token_count():
-    """Partial batches whose token count does not divide the dp world must
-    keep the XLA path (shard_map splits rows evenly where GSPMD pads)."""
+def test_fused_gate_declines_indivisible_batch():
+    """Batches whose B does not divide the dp world keep the XLA path:
+    shard_map would split the flattened rows mid-sequence — numerically
+    fine but paying a resharding gather in the hot loss path (advisor r3).
+    Checking B (not B*S') also covers partial eval batches."""
     from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
 
     model = build_model(tiny_test(n_layer=2, fused_xent=True))
     with jax.set_mesh(build_mesh(MeshSpec(data=8))):
-        assert model._fused_xent_active(n_tokens=128)
-        assert not model._fused_xent_active(n_tokens=124)
-    # (a batch whose B doesn't divide dp is rejected earlier, by the
-    # trunk's own sharding constraint, on BOTH loss paths — and whenever B
-    # divides dp, B*(S-1) does too, so the gate is a defensive backstop
-    # for future callers that flatten differently, not a reachable path
-    # through loss() today)
+        assert model._fused_xent_active(batch_size=16)
+        # B*S' may divide dp while B does not: 12 tokens/row x 12 rows
+        # is divisible by 8, but B=12 is not — must decline.
+        assert not model._fused_xent_active(batch_size=12)
+
+
+def test_fused_path_works_on_custom_axis_subset_mesh():
+    """A user-built mesh carrying only a subset of the canonical axes
+    (here: just "data") still takes the fused path — fused_nll_sharded
+    names only axes the mesh carries in its shard_map specs, instead of
+    crashing on unknown axis names (advisor r3). The loss must match the
+    XLA path on the same mesh."""
+    from jax.sharding import Mesh
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (4, 16)), jnp.int32)
+    data_only = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    losses = {}
+    for fused in (True, False):
+        model = build_model(tiny_test(n_layer=2, fused_xent=fused))
+        params = model.init(jax.random.PRNGKey(0))
+        with jax.set_mesh(data_only):
+            assert model._fused_xent_active(batch_size=4) == fused
+            losses[fused] = float(model.loss(params, {"input_ids": ids}))
+    assert abs(losses[True] - losses[False]) < 2e-4, losses
 
 
 def test_engine_fused_xent_with_gradient_accumulation():
