@@ -17,5 +17,7 @@ echo "=== probes ==="
 python bench_woq_probe.py || { echo "[bench_all] woq probe failed"; fails=$((fails+1)); }
 sleep 20
 python bench_decompose.py || { echo "[bench_all] decompose failed"; fails=$((fails+1)); }
+sleep 20
+python bench_act_offload.py || { echo "[bench_all] act-offload failed"; fails=$((fails+1)); }
 echo "=== bench_all done, $fails failures $(date -u +%H:%M:%SZ) ==="
 exit $((fails > 0))

@@ -35,13 +35,22 @@ def apply_compression(params: dict, cfg, active: Iterable[str], *,
     active = set(active)
     if not active:
         return params
+    # MoQ-annotated entries carry the scheduled bit width
+    # ("weight_quantization:<bits>", compression/moq.py)
+    wq_bits = None
+    for entry in list(active):
+        if entry.startswith("weight_quantization:"):
+            active.discard(entry)
+            active.add("weight_quantization")
+            wq_bits = int(entry.split(":", 1)[1])
     layers = dict(params["layers"])
 
     if "weight_quantization" in active:
         wq = cfg.weight_quantization
         for name in _QUANT_LEAVES:
             if name in layers:
-                layers[name] = fake_quant(layers[name], wq.bits,
+                layers[name] = fake_quant(layers[name],
+                                          wq_bits or wq.bits,
                                           group_size=wq.group_size or None,
                                           symmetric=wq.symmetric)
     if "sparse_pruning" in active:

@@ -1,0 +1,63 @@
+"""MoQ: mixed-precision quantization-aware training schedule.
+
+Analog of the reference's MoQ (``quantize_training``): QAT starts wide
+(``start_bits``) and steps the fake-quant bit width down toward the target,
+either on a fixed step period or — the part that makes it MoQ — gated on
+the measured loss curvature: the reference consults its eigenvalue module
+before narrowing precision (``runtime/engine.py:2116-2127``,
+``runtime/quantize.py`` schedule), the intuition being that narrowing is
+safe once the loss landscape has flattened. Here the curvature probe is
+``utils/eigenvalue.py``'s jittable power iteration, and a bit-width switch
+is one retrace of the compiled step (the bit width rides the same static
+``comp_active`` argument the compression techniques already use, encoded
+as ``"weight_quantization:<bits>"``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class MoQScheduler:
+    """Holds the current QAT bit width and decides when to narrow it."""
+
+    def __init__(self, wq_cfg):
+        self.target_bits = int(wq_cfg.bits)
+        self.bits = int(wq_cfg.start_bits or wq_cfg.bits)
+        if self.bits < self.target_bits:
+            raise ValueError(
+                f"MoQ start_bits ({self.bits}) must be >= target bits "
+                f"({self.target_bits})")
+        self.period = max(1, int(wq_cfg.quantize_period))
+        self.use_eigenvalue = bool(wq_cfg.eigenvalue)
+        self.threshold = float(wq_cfg.eigenvalue_threshold)
+        self.initial_eig: Optional[float] = None
+        self.history: list = []     # (step, eigenvalue, bits) probe ledger
+
+    @property
+    def active(self) -> bool:
+        return self.bits > self.target_bits
+
+    def maybe_step(self, step: int, eig_fn: Callable[[], float]) -> None:
+        """Advance the schedule at ``step``. ``eig_fn`` is called only on
+        probe steps (period boundaries) and only in eigenvalue mode — it
+        returns the dominant Hessian eigenvalue of the current loss."""
+        if not self.active or step == 0 or step % self.period != 0:
+            return
+        if self.use_eigenvalue:
+            eig = abs(float(eig_fn()))
+            self.history.append((step, eig, self.bits))
+            if self.initial_eig is None:
+                # first probe anchors the scale; never narrow on it
+                self.initial_eig = max(eig, 1e-12)
+                return
+            if eig > self.threshold * self.initial_eig:
+                return          # landscape still sharp: hold precision
+        self.bits = max(self.target_bits, self.bits // 2)
+
+    def annotate(self, comp_active: tuple) -> tuple:
+        """Rewrite the weight_quantization entry to carry the scheduled
+        bit width (static jit argument: a switch is one retrace)."""
+        return tuple(f"weight_quantization:{self.bits}"
+                     if n == "weight_quantization" else n
+                     for n in comp_active)

@@ -227,13 +227,24 @@ class DataEfficiencyConfig(ConfigModel):
 
 
 class WeightQuantConfig(ConfigModel):
-    """QAT (reference ``compression/basic_layer.py`` weight quantization)."""
+    """QAT (reference ``compression/basic_layer.py`` weight quantization).
+
+    MoQ (reference ``quantize_training`` + eigenvalue gating,
+    ``runtime/engine.py:2116-2127``): set ``start_bits`` above ``bits`` and
+    the engine steps the fake-quant width down (halving toward ``bits``)
+    every ``quantize_period`` steps; with ``eigenvalue: true`` a step only
+    happens once the measured loss curvature falls below
+    ``eigenvalue_threshold`` x its first probe."""
 
     enabled: bool = False
     bits: int = 8
     group_size: int = 0            # 0 = per-row scales
     symmetric: bool = True
     schedule_offset: int = 0
+    start_bits: Optional[int] = None   # MoQ: begin QAT wider than `bits`
+    quantize_period: int = 100
+    eigenvalue: bool = False
+    eigenvalue_threshold: float = 0.5
 
 
 class SparsePruningConfig(ConfigModel):
@@ -261,6 +272,16 @@ class ProgressiveLayerDropConfig(ConfigModel):
     enabled: bool = False
     theta: float = 0.5          # terminal keep probability
     gamma: float = 0.001        # decay rate of theta(t)
+
+
+class LoRAConfig(ConfigModel):
+    """LoRA adapters (reference DeepSpeed-Chat ``only_optimize_lora`` +
+    hybrid-engine LoRA fuse, ``containers/features/hybrid_engine.py:12``):
+    base weights freeze, (A, B) deltas train, generate merges."""
+
+    enabled: bool = False
+    rank: int = 8
+    alpha: float = 16.0
 
 
 class ElasticityConfig(ConfigModel):
@@ -315,6 +336,11 @@ class Config(ConfigModel):
     seed: int = 42
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False
+    # Row-sparse embedding-grad transfer on the offload path (the reference
+    # ds_config flag of the same name gates its sparse embedding
+    # allreduce, engine.py:2427). No effect without offload_optimizer: the
+    # in-device dense reduction is GSPMD's business.
+    sparse_gradients: bool = False
 
     optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
     scheduler: Optional[SchedulerConfig] = None  # None => constant optimizer lr
@@ -334,6 +360,7 @@ class Config(ConfigModel):
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     compression: CompressionConfig = Field(default_factory=CompressionConfig)
+    lora: LoRAConfig = Field(default_factory=LoRAConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = Field(
         default_factory=ProgressiveLayerDropConfig)

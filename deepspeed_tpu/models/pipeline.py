@@ -268,8 +268,12 @@ class _PipelinedLMBase:
             # XLA CPU bug workaround: any bf16<->f32 convert inside the
             # pipe-axis shard_map + scan + grad pattern CHECK-fails the CPU
             # compiler ("Invalid binary instruction opcode copy",
-            # hlo_instruction.cc:1585 — float-normalization pass, which
-            # native-bf16 TPUs don't run). Upcast params OUTSIDE the
+            # hlo_instruction.cc:1585 — AllReducePromotion cloning the bf16
+            # grad all-reduces, a pass native-bf16 TPUs don't run;
+            # re-reproduced on jax 0.9.0). The bf16 pipe body itself IS
+            # covered: test_pipeline.py::test_bf16_pipe_body_traces_and_lowers
+            # traces + lowers it with this workaround bypassed (only
+            # .compile() hits the CPU backend pass). Upcast params OUTSIDE the
             # shard_map and run the pipelined body through an fp32-config
             # clone (self.cfg stays untouched — dense fallback/eval numerics
             # are unchanged). Gated on actual dtypes at call time: the

@@ -29,6 +29,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..platform.mesh import BATCH_AXES, constrain, current_mesh
@@ -288,9 +289,13 @@ class T5Model:
                               max_distance=cfg.rel_max_distance)
 
         def layer(x, p):
+            # same offload-policy anchors as the decoder trunk
+            # (transformer.py _layer; engine OFFLOAD_ACTIVATION_NAMES)
+            x = checkpoint_name(x, "layer_in")
             y = _norm(x, p["ln1"], None, "rmsnorm", cfg.norm_eps)
             o = _t5_attention(self._heads(y, p["wq"]), self._heads(y, p["wk"]),
                               self._heads(y, p["wv"]), bias=bias, mask=mask)
+            o = checkpoint_name(o, "attn_out")
             x = x + (o.reshape(*o.shape[:2], -1) @ p["wo"].astype(x.dtype))
             y = _norm(x, p["ln_ffn"], None, "rmsnorm", cfg.norm_eps)
             x = x + self._ffn(y, p)
@@ -313,9 +318,11 @@ class T5Model:
                               max_distance=cfg.rel_max_distance)
 
         def layer(x, p):
+            x = checkpoint_name(x, "layer_in")
             y = _norm(x, p["ln1"], None, "rmsnorm", cfg.norm_eps)
             o = _t5_attention(self._heads(y, p["wq"]), self._heads(y, p["wk"]),
                               self._heads(y, p["wv"]), bias=bias, causal=True)
+            o = checkpoint_name(o, "attn_out")
             x = x + (o.reshape(*o.shape[:2], -1) @ p["wo"].astype(x.dtype))
             y = _norm(x, p["ln_cross"], None, "rmsnorm", cfg.norm_eps)
             o = _t5_attention(self._heads(y, p["cq"]),

@@ -29,6 +29,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..platform.mesh import BATCH_AXES, constrain, current_mesh
@@ -68,6 +69,11 @@ class TransformerConfig:
     parallel_shared_ln: bool = False
     embed_norm: bool = False              # Bloom word_embeddings_layernorm
     lm_head_bias: bool = False            # GPT-J lm_head has a bias
+    # >1: compute the unembedding matmul as a scan over that many vocab
+    # column tiles (ops/tiled.py; reference zero/tiling.py TiledLinear) —
+    # bounds the logits working set of a giant-vocab head on the XLA loss
+    # path. The fused-xent path never materializes logits and ignores this.
+    tiled_head: int = 1
     # post-LN block (BERT family): x = LN(x + attn(x)); x = LN(x + mlp(x)).
     # The norm params keep their pre-LN names: ln1 = post-attention LN,
     # ln2 = post-FFN LN; no final lnf exists.
@@ -325,9 +331,11 @@ def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None,
     """Plain attention, fp32 softmax. q:(B,S,H,hd) k/v:(B,S,KV,hd).
 
     ``causal=False`` = bidirectional (encoder); ``bias`` is an additive
-    (H, S, S) score bias (ALiBi). Heads are grouped for GQA by repeating kv.
-    The Pallas flash kernel (ops/flash_attention.py) replaces this on TPU
-    for long sequences.
+    score bias, shape (S, S), (H, S, S) (ALiBi) or (B|1, H|1, S, S)
+    (evoformer pair bias) — broadcast gradients flow correctly through the
+    ``broadcast_to``. Heads are grouped for GQA by repeating kv. The
+    Pallas flash kernel (ops/flash_attention.py) replaces this on TPU for
+    long sequences.
     """
     B, S, H, hd = q.shape
     KV = k.shape[2]
@@ -336,7 +344,8 @@ def causal_attention(q, k, v, *, mask: jnp.ndarray | None = None,
         v = jnp.repeat(v, H // KV, axis=2)
     scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) / math.sqrt(hd)
     if bias is not None:
-        scores = scores + bias[None].astype(jnp.float32)
+        b4 = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        scores = scores + jnp.broadcast_to(b4, scores.shape).astype(jnp.float32)
     big_neg = jnp.finfo(jnp.float32).min
     if causal:
         tri = jnp.tril(jnp.ones((S, S), dtype=bool))
@@ -358,11 +367,13 @@ class TransformerLM:
                 "encoder (causal=False) configs require the default "
                 "attention: the flash/sparse/Ulysses attention_fns apply a "
                 "causal mask and would silently break bidirectionality")
-        if attention_fn is not None and config.pos_embedding == "alibi":
+        if attention_fn is not None and config.pos_embedding == "alibi" \
+                and not getattr(attention_fn, "accepts_bias", False):
             raise ValueError(
-                "alibi needs an additive score bias, which custom "
-                "attention_fns (flash/sparse/Ulysses) do not accept; use "
-                "the default attention")
+                "alibi needs an additive score bias; this attention_fn "
+                "does not accept one (flash attention does — "
+                "make_flash_attention() — since the bias operand landed; "
+                "sparse/Ulysses still do not)")
         self.attention_fn = attention_fn or partial(causal_attention,
                                                     causal=config.causal)
 
@@ -554,7 +565,18 @@ class TransformerLM:
     def _layer(self, x, layer_params, positions, attn_mask):
         cfg = self.cfg
         p = layer_params
+        # Remat-policy anchors (reference cpu_checkpointing,
+        # activation_checkpointing/checkpointing.py:1036): under the
+        # engine's "offload_dots" policy these two names — the residual
+        # stream entering the layer and the projected attention output —
+        # are offloaded to pinned host memory during the forward and
+        # fetched back in the backward, instead of being kept in HBM
+        # (dots_saveable) or recomputed (full remat: for attn_out that
+        # means redoing the whole S^2 attention). Under any other policy
+        # checkpoint_name is an identity.
+        x = checkpoint_name(x, "layer_in")
         o = self._attention_block(x, p, positions, attn_mask)
+        o = checkpoint_name(o, "attn_out")
         if cfg.post_ln:
             # BERT block: norms AFTER each residual; FFN input is the
             # post-attention-LN output directly
@@ -666,13 +688,26 @@ class TransformerLM:
         """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
         cfg = self.cfg
         x = self._pre_head(params, x)
-        if cfg.tie_embeddings:
-            logits = x @ params["tok_embed"].astype(x.dtype).T
+        w = (params["tok_embed"].astype(x.dtype).T if cfg.tie_embeddings
+             else params["lm_head"].astype(x.dtype))
+        if cfg.tiled_head > 1 and w.shape[1] % cfg.tiled_head == 0:
+            from ..ops.tiled import tiled_matmul
+
+            logits = tiled_matmul(x, w, cfg.tiled_head)
         else:
-            logits = x @ params["lm_head"].astype(x.dtype)
+            logits = x @ w
         if cfg.lm_head_bias:
             logits = logits + params["lm_head_bias"].astype(logits.dtype)
         return constrain(logits, P(B_AXES, "seq", "model"))
+
+    def sparse_grad_names(self) -> tuple[str, ...]:
+        """Param leaves whose gradient is row-sparse in the batch's tokens
+        (the engine's ``sparse_gradients`` offload-D2H compression,
+        reference ``sparse_allreduce`` engine.py:2427). ONLY the untied
+        input embedding qualifies: a tied table also receives the
+        unembedding's softmax gradient, which is dense over the vocab —
+        top-k row selection there would silently drop gradient mass."""
+        return () if self.cfg.tie_embeddings else ("tok_embed",)
 
     def _trunk(self, params, input_ids, attn_mask, remat_policy):
         """Embed + layer stack: (B, S) → ((B, S, D) pre-final-norm, aux)."""

@@ -3,26 +3,35 @@
 Analog of the reference's evoformer attention kernels
 (``csrc/deepspeed4science/evoformer_attn/``, ~15 kLoC of CUTLASS): the
 AlphaFold-style attention variant — scores take an additive pair-represent-
-ation bias, the output is gated by a sigmoid projection of the input, and
-the memory-efficient streaming the CUTLASS kernels hand-build is what the
-flash kernel already does on TPU.
+ation bias, the output is gated by a sigmoid projection of the input.
 
-Two paths:
-- ``evoformer_attention``: XLA implementation with bias + gating (fp32
-  softmax) — the general case, including the (B, H, S, S) bias tensors
-  AlphaFold's triangle attention produces;
-- when the bias is None the call routes through the Pallas flash kernel
-  (ops/flash_attention.py), which is the memory-efficient case that
-  matters for long sequences.
+The reference's kernels exist precisely for the BIASED case: streaming
+attention that never materializes the (B, H, S, S) score tensor even when
+a pair bias is added. Here that is the Pallas flash kernel's ``bias``
+operand (ops/flash_attention.py): the bias is streamed in (block, S)
+slices through the forward and both backward kernels, and a full-shape
+(B, H, S, S) bias is differentiable (dbias tiles written by the dq
+kernel) — the pair-representation gradient AlphaFold training needs.
+``dense_biased_attention`` remains only as the fallback for sequence
+lengths the block tiling cannot cover.
 """
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def dense_biased_attention(q, k, v, bias, *, mask=None, causal: bool = False):
+    """XLA fallback: materializes (B, H, S, S) scores. Only used when S
+    doesn't divide the flash block tile. One implementation with the plain
+    trunk attention (its bias arg takes every broadcast rank) — two dense
+    paths would drift numerically."""
+    from ..models.transformer import causal_attention
+
+    return causal_attention(q, k, v, mask=mask, causal=causal, bias=bias)
 
 
 def evoformer_attention(q, k, v, *, bias: Optional[jnp.ndarray] = None,
@@ -33,22 +42,15 @@ def evoformer_attention(q, k, v, *, bias: Optional[jnp.ndarray] = None,
     gate: (B, S, H, hd) pre-sigmoid gating values. Returns (B, S, H, hd).
 
     Mirrors the reference kernel contract (``EvoformerAttnBuilder``):
-    ``softmax(q·kᵀ/√d + bias) · v``, then ``sigmoid(gate) ⊙ out``."""
-    B, S, H, hd = q.shape
-    if bias is None:
-        from .flash_attention import flash_attention
+    ``softmax(q·kᵀ/√d + bias) · v``, then ``sigmoid(gate) ⊙ out``.
 
-        out = flash_attention(q, k, v, causal=causal, interpret=interpret)
-    else:
-        scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
-        scores = scores / math.sqrt(hd)
-        scores = scores + jnp.broadcast_to(bias, (B, H, S, S)).astype(jnp.float32)
-        if causal:
-            tri = jnp.tril(jnp.ones((S, S), bool))
-            scores = jnp.where(tri[None, None], scores,
-                               jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    Biased and bias-free paths BOTH stream through the flash kernel; a
+    full-shape (B, H, S, S) bias additionally flows gradients back into
+    the pair representation (dbias)."""
+    from .flash_attention import flash_attention
+
+    out = flash_attention(q, k, v, bias=bias, causal=causal,
+                          interpret=interpret)
     if gate is not None:
         out = out * jax.nn.sigmoid(gate.astype(out.dtype))
     return out

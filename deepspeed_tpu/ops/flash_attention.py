@@ -37,12 +37,17 @@ SUBLANES = 8  # fp32 sublane tile: lse/delta rows replicated to (8, S)
 
 
 # ---------------------------------------------------------------- forward
-def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool):
+def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool,
+                biased: bool):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    mask_ref = bias_ref = None
     if masked:
-        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
-        mask_ref = None
+        mask_ref = refs[i]; i += 1
+    if biased:
+        bias_ref = refs[i]; i += 1
+    o_ref, lse_ref = refs[i:]
     iq = pl.program_id(2)
     q = q_ref[...].astype(jnp.float32) * scale          # (blk, hd)
     nkb = k_ref.shape[0] // block
@@ -53,6 +58,11 @@ def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool):
         k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
         v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if bias_ref is not None:
+            # additive score bias tile (blk, blk), streamed from the
+            # (blk, S) row slice this q-block owns — never a full (S, S)
+            # materialization (the whole point vs the dense path)
+            s = s + bias_ref[:, pl.ds(jk * block, block)].astype(jnp.float32)
         keep = None
         if causal:
             kpos = jk * block + jax.lax.broadcasted_iota(
@@ -94,13 +104,32 @@ def _mask_operand(mask, S):
     return jnp.broadcast_to(m, (mask.shape[0], SUBLANES, S))
 
 
-def _fwd_call(q, k, v, mask, *, block: int, causal: bool, interpret: bool):
+def _bias_row_spec(bias_shape, B, H, block):
+    """(blk, S) row-slice BlockSpec for a (BB, HH, S, S) bias with BB in
+    {1, B} and HH in {1, H} (broadcast handled by the index map, NOT by
+    materializing the broadcast in HBM)."""
+    bb, hh = bias_shape[0], bias_shape[1]
+    return pl.BlockSpec(
+        (None, None, block, bias_shape[3]),
+        lambda b, h, i: (b if bb > 1 else 0, h if hh > 1 else 0, i, 0))
+
+
+def _bias_col_spec(bias_shape, B, H, block):
+    """(S, blk) column-slice BlockSpec (dk/dv kernel: grid over k blocks)."""
+    bb, hh = bias_shape[0], bias_shape[1]
+    return pl.BlockSpec(
+        (None, None, bias_shape[2], block),
+        lambda b, h, j: (b if bb > 1 else 0, h if hh > 1 else 0, 0, j))
+
+
+def _fwd_call(q, k, v, mask, bias, *, block: int, causal: bool,
+              interpret: bool):
     B, H, S, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     grid = (B, H, S // block)
-    masked = mask is not None
+    masked, biased = mask is not None, bias is not None
     kernel = partial(_fwd_kernel, block=block, scale=scale, causal=causal,
-                     masked=masked)
+                     masked=masked, biased=biased)
     in_specs = [
         pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
         pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
@@ -111,6 +140,9 @@ def _fwd_call(q, k, v, mask, *, block: int, causal: bool, interpret: bool):
         in_specs.append(pl.BlockSpec((None, SUBLANES, S),
                                      lambda b, h, i: (b, 0, 0)))
         args.append(mask)
+    if biased:
+        in_specs.append(_bias_row_spec(bias.shape, B, H, block))
+        args.append(bias)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -129,14 +161,24 @@ def _fwd_call(q, k, v, mask, *, block: int, causal: bool, interpret: bool):
 
 
 # ---------------------------------------------------------------- backward
-def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool):
+def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
+                        biased: bool = False, grad_bias: bool = False):
 
     def kernel(*refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        i = 6
+        mask_ref = bias_ref = dbias_ref = None
         if masked:
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dq_ref = refs
-        else:
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
-            mask_ref = None
+            mask_ref = refs[i]; i += 1
+        if biased:
+            bias_ref = refs[i]; i += 1
+        dq_ref = refs[i]; i += 1
+        if grad_bias:
+            dbias_ref = refs[i]
+            # causal bias rows never visit jk > iq: zero-fill so the
+            # untouched upper triangle doesn't carry garbage
+            dbias_ref[...] = jnp.zeros(dbias_ref.shape, dbias_ref.dtype)
         iq = pl.program_id(2)
         q = q_ref[...].astype(jnp.float32) * scale
         do = do_ref[...].astype(jnp.float32)
@@ -150,6 +192,9 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool):
             k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
             v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+            if bias_ref is not None:
+                s = s + bias_ref[:, pl.ds(jk * block, block)].astype(
+                    jnp.float32)
             keep = None
             if causal:
                 kpos = jk * block + jax.lax.broadcasted_iota(
@@ -167,6 +212,11 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool):
                 p = jnp.where(keep, p, 0.0)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
+            if dbias_ref is not None:
+                # d(bias) == d(scores): each (iq, jk) tile is owned by
+                # exactly one grid step, so this is a plain write
+                dbias_ref[:, pl.ds(jk * block, block)] = ds.astype(
+                    dbias_ref.dtype)
             return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
         ub = iq + 1 if causal else nkb
@@ -176,14 +226,18 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool):
     return kernel
 
 
-def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool):
+def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
+                         biased: bool = False):
     def kernel(*refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        i = 6
+        mask_ref = bias_ref = None
         if masked:
-            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-             dk_ref, dv_ref) = refs
-        else:
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs
-            mask_ref = None
+            mask_ref = refs[i]; i += 1
+        if biased:
+            bias_ref = refs[i]; i += 1
+        dk_ref, dv_ref = refs[i:]
         jk = pl.program_id(2)
         k = k_ref[...].astype(jnp.float32)               # (blk, hd)
         v = v_ref[...].astype(jnp.float32)
@@ -201,6 +255,10 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool):
             lse = lse_ref[0, pl.ds(iq * block, block)]
             delta = delta_ref[0, pl.ds(iq * block, block)]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+            if bias_ref is not None:
+                # (S, blk) column slice of the bias: rows iq-block
+                s = s + bias_ref[pl.ds(iq * block, block), :].astype(
+                    jnp.float32)
             keep = None
             if causal:
                 q_pos = iq * block + jax.lax.broadcasted_iota(
@@ -228,14 +286,18 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool):
     return kernel
 
 
-def _bwd_call(q, k, v, o, lse, do, mask, *, block: int, causal: bool,
-              interpret: bool):
+def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
+              interpret: bool, grad_bias: bool = False):
     B, H, S, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, :, None, :], (B, H, SUBLANES, S))
     grid = (B, H, S // block)
-    masked = mask is not None
+    masked, biased = mask is not None, bias is not None
+    # dbias tiles are plain writes (one owner per grid step): only valid
+    # when the bias carries its own full (B, H) leading dims — broadcast
+    # biases would need cross-iteration accumulation
+    assert not grad_bias or (biased and bias.shape[:2] == (B, H))
     blk_spec = pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0))
     full_spec = pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0))
     row_blk = pl.BlockSpec((None, None, SUBLANES, block),
@@ -243,49 +305,57 @@ def _bwd_call(q, k, v, o, lse, do, mask, *, block: int, causal: bool,
     row_full = pl.BlockSpec((None, None, SUBLANES, S),
                             lambda b, h, i: (b, h, 0, 0))
     mask_spec = pl.BlockSpec((None, SUBLANES, S), lambda b, h, i: (b, 0, 0))
-    mask_args = [mask] if masked else []
+    extra_args = ([mask] if masked else []) + ([bias] if biased else [])
 
-    dq = pl.pallas_call(
-        _make_bwd_dq_kernel(block, scale, causal, masked),
+    dq_outs = pl.pallas_call(
+        _make_bwd_dq_kernel(block, scale, causal, masked, biased, grad_bias),
         grid=grid,
         in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk, row_blk]
-                 + ([mask_spec] if masked else []),
-        out_specs=[blk_spec],
-        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+                 + ([mask_spec] if masked else [])
+                 + ([_bias_row_spec(bias.shape, B, H, block)] if biased else []),
+        out_specs=[blk_spec] + ([_bias_row_spec(bias.shape, B, H, block)]
+                                if grad_bias else []),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)]
+                  + ([jax.ShapeDtypeStruct(bias.shape, bias.dtype)]
+                     if grad_bias else []),
         interpret=interpret,
-    )(q, k, v, do, lse, delta, *mask_args)[0]
+    )(q, k, v, do, lse, delta, *extra_args)
+    dq = dq_outs[0]
+    dbias = dq_outs[1] if grad_bias else None
 
     dk, dv = pl.pallas_call(
-        _make_bwd_dkv_kernel(block, scale, causal, masked),
+        _make_bwd_dkv_kernel(block, scale, causal, masked, biased),
         grid=grid,
         in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full, row_full]
-                 + ([mask_spec] if masked else []),
+                 + ([mask_spec] if masked else [])
+                 + ([_bias_col_spec(bias.shape, B, H, block)] if biased else []),
         out_specs=[blk_spec, blk_spec],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, *mask_args)
-    return dq, dk, dv
+    )(q, k, v, do, lse, delta, *extra_args)
+    return dq, dk, dv, dbias
 
 
 # ------------------------------------------------------------- custom VJP
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash(block, causal, interpret, q, k, v):
-    o, _ = _fwd_call(q, k, v, None, block=block, causal=causal,
+    o, _ = _fwd_call(q, k, v, None, None, block=block, causal=causal,
                      interpret=interpret)
     return o
 
 
 def _flash_fwd(block, causal, interpret, q, k, v):
-    o, lse = _fwd_call(q, k, v, None, block=block, causal=causal,
+    o, lse = _fwd_call(q, k, v, None, None, block=block, causal=causal,
                        interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(block, causal, interpret, res, g):
     q, k, v, o, lse = res
-    return _bwd_call(q, k, v, o, lse, g, None, block=block, causal=causal,
-                     interpret=interpret)
+    dq, dk, dv, _ = _bwd_call(q, k, v, o, lse, g, None, None, block=block,
+                              causal=causal, interpret=interpret)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -293,29 +363,63 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _flash_masked(block, causal, interpret, q, k, v, mask):
-    o, _ = _fwd_call(q, k, v, mask, block=block, causal=causal,
+    o, _ = _fwd_call(q, k, v, mask, None, block=block, causal=causal,
                      interpret=interpret)
     return o
 
 
 def _flash_masked_fwd(block, causal, interpret, q, k, v, mask):
-    o, lse = _fwd_call(q, k, v, mask, block=block, causal=causal,
+    o, lse = _fwd_call(q, k, v, mask, None, block=block, causal=causal,
                        interpret=interpret)
     return o, (q, k, v, o, lse, mask)
 
 
 def _flash_masked_bwd(block, causal, interpret, res, g):
     q, k, v, o, lse, mask = res
-    dq, dk, dv = _bwd_call(q, k, v, o, lse, g, mask, block=block,
-                           causal=causal, interpret=interpret)
+    dq, dk, dv, _ = _bwd_call(q, k, v, o, lse, g, mask, None, block=block,
+                              causal=causal, interpret=interpret)
     return dq, dk, dv, jnp.zeros_like(mask)   # mask is {0,1} data, no grad
 
 
 _flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_biased(block, causal, interpret, grad_bias, q, k, v, bias, mask):
+    o, _ = _fwd_call(q, k, v, mask, bias, block=block, causal=causal,
+                     interpret=interpret)
+    return o
+
+
+def _flash_biased_fwd(block, causal, interpret, grad_bias, q, k, v, bias,
+                      mask):
+    o, lse = _fwd_call(q, k, v, mask, bias, block=block, causal=causal,
+                       interpret=interpret)
+    return o, (q, k, v, o, lse, bias, mask)
+
+
+def _flash_biased_bwd(block, causal, interpret, grad_bias, res, g):
+    q, k, v, o, lse, bias, mask = res
+    dq, dk, dv, dbias = _bwd_call(q, k, v, o, lse, g, mask, bias,
+                                  block=block, causal=causal,
+                                  interpret=interpret, grad_bias=grad_bias)
+    if dbias is None:
+        # Broadcast-shaped biases (ALiBi slopes x positions, padding
+        # biases) are positional constants: a zero cotangent is correct
+        # and DCE'd under jit. Learned biases must come in full-shape
+        # (B, H, S, S) to get a real dbias (enforced in flash_attention).
+        dbias = jnp.zeros_like(bias)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dbias, dmask
+
+
+_flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
+
+
 # ------------------------------------------------------------- public API
 def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
+                    bias: Optional[jnp.ndarray] = None,
+                    bias_is_constant: bool = False,
                     causal: bool = True, block: int = 128,
                     interpret: Optional[bool] = None):
     """Fused causal attention. q: (B, S, H, hd); k/v: (B, S, KV, hd).
@@ -323,15 +427,33 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     ``mask`` is a (B, S) key-padding mask ({0,1}); it is applied INSIDE the
     kernel (fwd and both bwd kernels), so padded/packed batches stay on the
     fused path — the reference-parity requirement the round-1 fallback
-    violated. The only remaining fallback is S not divisible by the block
-    tile.
+    violated.
+
+    ``bias`` is an additive score bias, shape (S, S), (H, S, S),
+    (B|1, H|1, S, S) — streamed into the fwd and both bwd kernels in
+    (block, S) slices, never materializing (B, H, S, S) *scores* in HBM.
+    Gradient handling by shape:
+
+    - full (B, H, S, S): differentiable in-kernel (dbias = ds tiles — the
+      evoformer pair-bias case, reference
+      csrc/deepspeed4science/evoformer_attn/);
+    - broadcast shapes with ``bias_is_constant=True``: index-map broadcast,
+      explicit ``stop_gradient`` — zero HBM cost, for positional constants
+      (ALiBi, additive masks);
+    - broadcast shapes otherwise: broadcast OUTSIDE the kernel so the
+      ``broadcast_to`` transpose sums a CORRECT cotangent for learned
+      shared biases (costs a (B, H, S, S) bias materialization — still
+      cheaper than the dense path, which adds scores+probs on top; pass
+      ``bias_is_constant=True`` to opt out when the bias isn't trained).
+
+    The only remaining fallback is S not divisible by the block tile.
     """
     B, S, H, hd = q.shape
     blk = min(block, S)
     if S % blk != 0:
         from ..models.transformer import causal_attention
 
-        return causal_attention(q, k, v, mask=mask, causal=causal)
+        return causal_attention(q, k, v, mask=mask, causal=causal, bias=bias)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     KV = k.shape[2]
@@ -340,7 +462,21 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
         v = jnp.repeat(v, H // KV, axis=2)
     # (B, S, H, hd) -> (B, H, S, hd)
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    if mask is not None:
+    if bias is not None:
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        if bias.shape[:2] != (B, H):
+            if bias_is_constant:
+                bias = jax.lax.stop_gradient(bias)
+            else:
+                # learned shared bias: materialize the broadcast so its
+                # transpose sums the true dbias (silent zero grads were
+                # the round-4 review's finding #1)
+                bias = jnp.broadcast_to(bias, (B, H) + bias.shape[2:])
+        grad_bias = bias.shape[:2] == (B, H)
+        o = _flash_biased(blk, causal, interpret, grad_bias, qt, kt, vt,
+                          bias, _mask_operand(mask, S) if mask is not None
+                          else None)
+    elif mask is not None:
         o = _flash_masked(blk, causal, interpret, qt, kt, vt,
                           _mask_operand(mask, S))
     else:
@@ -351,8 +487,12 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
 def make_flash_attention(block: int = 128, interpret: Optional[bool] = None):
     """attention_fn factory for :class:`TransformerLM`."""
 
-    def attn(q, k, v, *, mask=None):
-        return flash_attention(q, k, v, mask=mask, block=block,
+    def attn(q, k, v, *, mask=None, bias=None):
+        # model-path biases are ALiBi distance ramps: positional
+        # constants, streamed via index-map broadcast at zero HBM cost
+        return flash_attention(q, k, v, mask=mask, bias=bias,
+                               bias_is_constant=True, block=block,
                                interpret=interpret)
 
+    attn.accepts_bias = True   # ALiBi models may route through this fn
     return attn

@@ -5,7 +5,11 @@ which splits a huge linear into sub-linears so ZeRO-3 only materializes one
 tile's worth of gathered parameters at a time. The JAX shape of the same
 idea: scan over column tiles of the weight; inside the scan each tile is the
 unit XLA gathers/keeps live, so peak memory holds ~one tile of W instead of
-all of it (plus remat-friendliness for the giant vocab head)."""
+all of it (plus remat-friendliness for the giant vocab head).
+
+Wired into the model head via ``TransformerConfig.tiled_head`` (> 1 tiles
+the unembedding matmul on the XLA logits path; the fused-xent loss path
+never materializes logits and ignores it)."""
 
 from __future__ import annotations
 

@@ -20,6 +20,7 @@ from functools import partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..inference.decode import generate_tokens
 from ..inference.engine import _MAX_COMPILED_SHAPES, model_with_dtype
@@ -27,19 +28,92 @@ from ..inference.sampling import sample_logits
 from .engine import Engine
 
 
+def _gather_logp(logits, ids):
+    """Per-token log p(ids) under logits: (B, T, V), (B, T) → (B, T) f32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+
+
+def ppo_token_loss(logp, old_logp, advantage, mask, *,
+                   clip_eps: float = 0.2, kl_coef: float = 0.1):
+    """Clipped policy-ratio objective + KL penalty (the PPO-shaped loss of
+    DeepSpeed-Chat's actor step, ``blogs/deepspeed-chat/README.md:41``).
+
+    logp/old_logp/mask: (B, T) over predicted positions; advantage: (B,)
+    or (B, T). Returns a scalar to MINIMIZE."""
+    adv = advantage if advantage.ndim == logp.ndim else advantage[:, None]
+    log_ratio = logp - old_logp
+    ratio = jnp.exp(log_ratio)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    pg = -jnp.sum(jnp.minimum(unclipped, clipped) * mask) / denom
+    # k3 KL estimator (Schulman): exp(-x) + x - 1 >= 0 pointwise, so the
+    # penalty is a true deviation cost in BOTH directions (the signed k1
+    # estimator would *reward* one-sided logp increases)
+    kl = jnp.sum((jnp.exp(-log_ratio) + log_ratio - 1.0) * mask) / denom
+    return pg + kl_coef * kl
+
+
+class _RLHFLossMixin:
+    """Routes batches that carry PPO keys (``ppo_old_logp``,
+    ``ppo_advantage``) through the clipped-ratio objective; plain LM
+    batches fall through to the standard loss unchanged."""
+
+    ppo_clip_eps: float = 0.2
+    ppo_kl_coef: float = 0.1
+
+    def loss(self, params, batch, *, remat_policy=None, **kw):
+        if "ppo_old_logp" not in batch:
+            return super().loss(params, batch, remat_policy=remat_policy,
+                                **kw)
+        ids = batch["input_ids"]
+        logits = self.apply(params, ids,
+                            attn_mask=batch.get("attention_mask"),
+                            remat_policy=remat_policy)
+        logp = _gather_logp(logits[:, :-1], ids[:, 1:])
+        mask = batch.get("loss_mask")
+        mask = (mask[:, 1:].astype(jnp.float32) if mask is not None
+                else jnp.ones_like(logp))
+        return ppo_token_loss(logp, batch["ppo_old_logp"],
+                              batch["ppo_advantage"], mask,
+                              clip_eps=self.ppo_clip_eps,
+                              kl_coef=self.ppo_kl_coef)
+
+
+def _convert_rlhf(model):
+    cls = type(model)
+    new_cls = type(f"RLHF{cls.__name__}", (_RLHFLossMixin, cls), {})
+    new = object.__new__(new_cls)
+    new.__dict__.update(model.__dict__)
+    return new
+
+
 class HybridEngine(Engine):
     """Training engine + in-place generation over the live params."""
 
-    def __init__(self, *args, eos_token_id: Optional[int] = None, **kwargs):
-        super().__init__(*args, **kwargs)
+    def __init__(self, config=None, model=None, *args,
+                 eos_token_id: Optional[int] = None, **kwargs):
+        super().__init__(config, _convert_rlhf(model), *args, **kwargs)
         self.eos_token_id = eos_token_id
         self._gen_cache: OrderedDict = OrderedDict()
+        self._logp_cache: OrderedDict = OrderedDict()
         self._rng = jax.random.PRNGKey(self.seed)
+
+    def _serving_params(self, master_params):
+        """Compute-cast params with LoRA adapters MERGED — the reference
+        hybrid engine's fuse-before-generate
+        (``containers/features/hybrid_engine.py:12``), here one functional
+        transform instead of module surgery (and nothing to unfuse)."""
+        params = self._cast_compute(master_params)
+        if hasattr(self.model, "merge_lora"):
+            params = self.model.merge_lora(params)
+        return params
 
     def _generate_impl(self, master_params, input_ids, rng, *, max_new: int,
                        temperature: float, top_k: int, top_p: float,
                        greedy: bool):
-        params = self._cast_compute(master_params)
+        params = self._serving_params(master_params)
         model = model_with_dtype(self.model, self.compute_dtype)
         sampler = partial(sample_logits, temperature=temperature, top_k=top_k,
                           top_p=top_p, greedy=greedy)
@@ -47,6 +121,28 @@ class HybridEngine(Engine):
                                max_new=max_new, sampler=sampler,
                                eos_token_id=self.eos_token_id,
                                cache_dtype=self.compute_dtype)
+
+    def token_logprobs(self, input_ids) -> jax.Array:
+        """(B, S) ids → (B, S-1) fp32 log-probs of each realized next token
+        under the CURRENT policy — the rollout-time ``old_logp`` snapshot
+        of the PPO loop."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        fn = self._logp_cache.get(input_ids.shape)
+        if fn is None:
+            def impl(master, ids):
+                # _serving_params already merged any adapters; the LoRA
+                # wrapper's own merge no-ops on a merged tree
+                params = self._serving_params(master)
+                model = model_with_dtype(self.model, self.compute_dtype)
+                logits = model.apply(params, ids)
+                return _gather_logp(logits[:, :-1], ids[:, 1:])
+
+            fn = jax.jit(impl)
+            self._logp_cache[input_ids.shape] = fn
+            if len(self._logp_cache) > _MAX_COMPILED_SHAPES:
+                self._logp_cache.popitem(last=False)
+        with self.mesh:
+            return fn(self.state.master_params, input_ids)
 
     def generate(self, input_ids, max_new_tokens: int = 64, *,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,

@@ -1,0 +1,117 @@
+"""LoRA adapters as a functional param-tree transform.
+
+Reference analog: the hybrid engine's LoRA fuse/unfuse around RLHF
+generate (``module_inject/containers/features/hybrid_engine.py:12``) and
+DeepSpeed-Chat's ``only_optimize_lora`` actor
+(``blogs/deepspeed-chat/README.md:41``). The torch version walks modules,
+swaps Linear for LinearLayer_LoRA, and physically fuses W += B·A before
+each generate; here the adapters are just an extra ``"lora"`` subtree in
+the param pytree:
+
+- **train**: the loss path merges ``W + (alpha/r)·A·B`` inside the compute
+  cast (bf16 A·B is two small matmuls per layer stack — XLA fuses the add
+  into the consumer). The base leaves are wrapped in ``stop_gradient`` and
+  additionally pinned by the engine's frozen-param mask, so the optimizer
+  updates adapters ONLY — weight decay cannot drift the frozen base.
+- **generate**: the hybrid engine merges once up front and runs the plain
+  decode loop over the merged tree — "fused" generate with no module
+  surgery to unwind afterwards.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# matmul leaves eligible for adapters (attention + FFN projections — the
+# reference's LinearLayer_LoRA targets)
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate")
+
+
+class LoRAMixin:
+    """Model wrapper: params carry a ``lora`` subtree of (A, B) pairs."""
+
+    lora_rank: int = 8
+    lora_alpha: float = 16.0
+    lora_targets: tuple = LORA_TARGETS
+
+    @property
+    def _lora_scale(self) -> float:
+        return self.lora_alpha / self.lora_rank
+
+    def init(self, rng):
+        base = super().init(rng)
+        r = self.lora_rank
+        lora = {}
+        key = jax.random.fold_in(rng, 0x10F4)
+        for name in self.lora_targets:
+            w = base["layers"].get(name)
+            if w is None or w.ndim < 2:
+                continue
+            key, sub = jax.random.split(key)
+            *lead, d_in, d_out = w.shape
+            # standard LoRA init: A gaussian, B zero -> identity at step 0
+            lora[name] = {
+                "a": jax.random.normal(sub, (*lead, d_in, r), jnp.float32)
+                / math.sqrt(d_in),
+                "b": jnp.zeros((*lead, r, d_out), jnp.float32),
+            }
+        base["lora"] = lora
+        return base
+
+    def _abstract_params(self):
+        return jax.eval_shape(self.init, jax.random.PRNGKey(0))
+
+    def param_specs(self):
+        specs = super().param_specs()
+        # adapters are small: replicate (None spec) across the mesh
+        specs["lora"] = jax.tree.map(lambda _: None,
+                                     self._abstract_params()["lora"])
+        return specs
+
+    def frozen_param_mask(self):
+        """Static bool pytree over init(): True = the engine must not
+        update this leaf (every base leaf; adapters stay trainable)."""
+
+        def mark(path, _):
+            return not any(getattr(e, "key", None) == "lora" for e in path)
+
+        return jax.tree_util.tree_map_with_path(mark, self._abstract_params())
+
+    def merge_lora(self, params):
+        """Base tree with adapters folded in: W + (alpha/r)·A·B. The base
+        is stop_gradient'd — gradients exist only through A/B."""
+        if "lora" not in params:
+            return params
+        merged = dict(params)
+        lora = merged.pop("lora")
+        layers = dict(merged["layers"])
+        for name, ab in lora.items():
+            w = layers[name]
+            delta = jnp.einsum("...dr,...rk->...dk",
+                               ab["a"].astype(w.dtype),
+                               ab["b"].astype(w.dtype))
+            layers[name] = jax.lax.stop_gradient(w) + self._lora_scale * delta
+        merged["layers"] = layers
+        return merged
+
+    def loss(self, params, batch, **kw):
+        return super().loss(self.merge_lora(params), batch, **kw)
+
+    def apply(self, params, input_ids, **kw):
+        return super().apply(self.merge_lora(params), input_ids, **kw)
+
+
+def convert_to_lora(model, *, rank: int = 8, alpha: float = 16.0,
+                    targets=LORA_TARGETS):
+    """Wrap a built model with LoRA (same class-mixin mechanism as PLD)."""
+    cls = type(model)
+    new_cls = type(f"LoRA{cls.__name__}", (LoRAMixin, cls), {})
+    new = object.__new__(new_cls)
+    new.__dict__.update(model.__dict__)
+    new.lora_rank = int(rank)
+    new.lora_alpha = float(alpha)
+    new.lora_targets = tuple(targets)
+    return new

@@ -139,19 +139,35 @@ class HostOffloadOptimizer:
         """Host update over all leaves; returns the new device compute tree.
         Grads arrive clipped (the engine clips on-device in the grad step);
         with pinned-host grad outputs the D2H already happened inside the
-        compiled step, overlapped with backward."""
+        compiled step, overlapped with backward. ``SparseGradRows`` leaves
+        (engine ``sparse_gradients``) ship only the touched embedding rows
+        and are decompressed into the dense buffer the native step reads."""
+        from .sparse_grads import SparseGradRows, SparseRows, add_into
+
         self.count += 1
-        g_arrays = jax.tree_util.tree_leaves(grads_tree)
+        is_sparse = lambda x: isinstance(x, SparseGradRows)
+        g_arrays = jax.tree_util.tree_leaves(grads_tree, is_leaf=is_sparse)
         # start all device→host DMAs before the first blocking device_get
         # (no-op for grads already in pinned host memory)
         for g in g_arrays:
-            try:
-                g.copy_to_host_async()
-            except Exception:
-                pass
-        g_leaves = [np.ascontiguousarray(
-            np.asarray(jax.device_get(g), np.float32).reshape(-1))
-            for g in g_arrays]
+            for part in (g if is_sparse(g) else (g,)):
+                try:
+                    part.copy_to_host_async()
+                except Exception:
+                    pass
+
+        def to_dense(i, g):
+            if not is_sparse(g):
+                return np.ascontiguousarray(
+                    np.asarray(jax.device_get(g), np.float32).reshape(-1))
+            idx = np.asarray(jax.device_get(g.indices), np.int32)
+            val = np.asarray(jax.device_get(g.values), np.float32)
+            dense = np.zeros(self.shapes[i], np.float32)
+            add_into(dense, SparseRows(indices=idx, values=val,
+                                       shape=self.shapes[i]))
+            return np.ascontiguousarray(dense.reshape(-1))
+
+        g_leaves = [to_dense(i, g) for i, g in enumerate(g_arrays)]
         n = len(self.shapes)
         new_device = []
 

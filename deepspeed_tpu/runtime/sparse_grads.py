@@ -4,17 +4,30 @@ Analog of the reference's ``SparseTensor`` + sparse allreduce for embedding
 gradients (``runtime/sparse_tensor.py``, ``engine.py:2412-2480``): a batch
 touches only a small subset of a large vocabulary, so the embedding gradient
 is row-sparse. Under pure XLA data-parallel training the gradient reduction
-is compiler-managed and dense, so these helpers are a host-side utility for
-custom training loops and grad transports (the engine's offload path
-currently moves dense gradients; compressing there requires a device-side
-row-select before the transfer, which is future work) — the same role the
-reference's SparseTensor plays for its sparse-gradient embedding modules."""
+is compiler-managed and dense; where row sparsity PAYS on TPU is the
+offload path's device→host gradient transfer (``sparse_gradients: true`` in
+the engine config): the grad step top-k-selects the touched embedding rows
+on device (static bound: one row per batch token) and ships
+``(indices, values)`` over the wire instead of the dense (V, d) table —
+``HostOffloadOptimizer.step`` decompresses into the dense host buffer the
+native optimizer consumes. The reference flag of the same name gates its
+sparse embedding allreduce."""
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import numpy as np
+
+
+class SparseGradRows(NamedTuple):
+    """Device-side row-sparse gradient (a JAX pytree by NamedTuple):
+    ``values[i]`` is the grad row for vocab id ``indices[i]``. Produced by
+    the engine's grad step under ``sparse_gradients``; rows beyond the
+    actually-touched count carry zero values (top-k bound is static)."""
+
+    indices: Any               # (k,) int32 device array
+    values: Any                # (k, d) device array
 
 
 class SparseRows(NamedTuple):

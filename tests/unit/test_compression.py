@@ -125,3 +125,67 @@ def test_clean_params_export():
     # exported net still runs
     out = model.apply(cleaned, jnp.zeros((1, 8), jnp.int32))
     assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+# ---------------------------------------------------------------- MoQ (r4)
+def test_moq_scheduler_narrows_when_curvature_falls():
+    """MoQ semantics (reference engine.py:2116-2127): precision holds while
+    the loss landscape is sharp and narrows once the dominant Hessian
+    eigenvalue decays below threshold x its first probe."""
+    from deepspeed_tpu.compression.moq import MoQScheduler
+    from deepspeed_tpu.config import Config
+
+    cfg = Config.from_any({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "compression": {"weight_quantization": {
+            "enabled": True, "bits": 4, "start_bits": 16,
+            "quantize_period": 10, "eigenvalue": True,
+            "eigenvalue_threshold": 0.5}},
+    }).compression.weight_quantization
+    sched = MoQScheduler(cfg)
+    eigs = iter([10.0, 9.0, 8.0, 4.0, 3.0, 1.0])
+    assert sched.bits == 16
+    sched.maybe_step(10, lambda: next(eigs))    # anchors initial_eig=10
+    assert sched.bits == 16
+    sched.maybe_step(20, lambda: next(eigs))    # 9 > 5: hold
+    sched.maybe_step(30, lambda: next(eigs))    # 8 > 5: hold
+    assert sched.bits == 16
+    sched.maybe_step(40, lambda: next(eigs))    # 4 <= 5: narrow 16 -> 8
+    assert sched.bits == 8
+    sched.maybe_step(50, lambda: next(eigs))    # 3 <= 5: narrow 8 -> 4
+    assert sched.bits == 4
+    sched.maybe_step(60, lambda: next(eigs))    # at target: eig_fn not called
+    assert sched.bits == 4 and len(sched.history) == 5
+    # off-period steps never probe
+    sched2 = MoQScheduler(cfg)
+    sched2.maybe_step(13, lambda: (_ for _ in ()).throw(AssertionError))
+    assert sched2.bits == 16
+    assert sched.annotate(("weight_quantization", "row_pruning")) == (
+        "weight_quantization:4", "row_pruning")
+
+
+def test_moq_engine_end_to_end_narrows_and_trains():
+    """The engine wires the schedule: curvature probes run on the cached
+    probe batch, the annotated bit width reaches fake_quant (one retrace
+    per switch), and training continues through the narrowing."""
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "compression": {"weight_quantization": {
+            "enabled": True, "bits": 8, "start_bits": 16,
+            "quantize_period": 2, "eigenvalue": True,
+            # generous threshold: the tiny model's curvature needn't halve
+            # within 6 steps — the *semantics* test is the scheduler unit
+            # test above; this one proves the engine wiring end to end
+            "eigenvalue_threshold": 1e6}},
+    }, build_model(tiny_test(n_layer=2)))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(6)]
+    assert all(np.isfinite(losses)), losses
+    assert engine._moq is not None
+    assert len(engine._moq.history) >= 2        # probes actually ran
+    assert engine._moq.bits == 8                # narrowed to target

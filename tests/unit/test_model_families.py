@@ -119,8 +119,16 @@ def test_encoder_rejects_custom_attention_and_pipeline():
 
     with pytest.raises(ValueError, match="bidirectional"):
         TransformerLM(bert("tiny"), attention_fn=make_flash_attention())
+    # ALiBi + flash is ACCEPTED since the kernel grew a bias operand
+    # (round 4); only bias-less attention_fns still reject it
+    TransformerLM(bloom("tiny"), attention_fn=make_flash_attention())
+    from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                    make_sparse_attention_fn)
+
     with pytest.raises(ValueError, match="alibi"):
-        TransformerLM(bloom("tiny"), attention_fn=make_flash_attention())
+        TransformerLM(bloom("tiny"),
+                      attention_fn=make_sparse_attention_fn(
+                          FixedSparsityConfig()))
     with pytest.raises(ValueError, match="pipeline|MLM"):
         PipelinedTransformerLM(bert("tiny", n_layer=4), n_stages=2)
 
