@@ -7,8 +7,8 @@
 set -u
 cd "$(dirname "$0")"
 fails=0
-for b in bench.py bench_bert.py bench_inference.py bench_longseq.py \
-         bench_offload.py; do
+for b in bench.py bench_gpt_large.py bench_bert.py bench_inference.py \
+         bench_longseq.py bench_offload.py; do
   echo "=== $b $(date -u +%H:%M:%SZ) ==="
   python "$b" || { echo "[bench_all] $b failed (continuing)"; fails=$((fails+1)); }
   sleep 20   # let the tunnel grant drain between claimants

@@ -1,0 +1,204 @@
+"""Decoder-LM MFU at >=1B params on one chip (VERDICT r4 #2).
+
+The reference's own headline decoder row is GPT-2 1.5B training speed
+(``docs/_pages/training.md:49``) and BASELINE.md's north star is >=45% MFU
+on decoder LMs. GPT-2 350M measured 0.33 MFU in round 3 with the head
+slice (18 ms) and trunk bwd (166 ms of 246 ms) identified as where the
+points live; this bench runs the largest decoder that FITS a single v5e
+(16 GiB HBM), with the two levers that target those costs:
+
+- fused Pallas softmax-xent (no (B, S, V) fp32 logits cube), and
+- Lion optimizer for the 1B row (one fp32 moment: master+moment+compute+
+  grads = 14 bytes/param vs AdamW's 18 — the difference between 1.0B
+  fitting and not; GPT-2-XL width at 30 layers = 1.00B params).
+
+Candidates run best-first, each in its OWN child interpreter (the tunnel's
+remote-compile helper 500s/hangs on some graphs — a dead candidate must
+cost one child, not the bench; bench_longseq's pattern). The winning child
+also records a step decomposition (fwd / fwd+bwd / full step) so the
+artifact shows where the milliseconds go, and a 350M no-remat candidate
+measures the remat dimension where activations fit.
+
+Writes ``GPT_LARGE_BENCH.json``; cache ``GPT_LARGE_BENCH_TPU_CACHE.json``.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+import bench_common as bc
+
+_CHILD_MARK = "_DSTPU_GPTL_CHILD"
+_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 20 * 60))
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+_OUT = os.path.join(_ROOT, "GPT_LARGE_BENCH.json")
+_CACHE = os.path.join(_ROOT, "GPT_LARGE_BENCH_TPU_CACHE.json")
+
+# (tag, preset kwargs, optimizer, micro, seq, remat, fused)
+_CANDIDATES = [
+    ("1b_lion_mbs8", dict(size="1.5b", n_layer=30), "lion", 8, 1024, True, None),
+    ("1b_lion_mbs8_xla", dict(size="1.5b", n_layer=30), "lion", 8, 1024, True, False),
+    ("1b_lion_mbs4", dict(size="1.5b", n_layer=30), "lion", 4, 1024, True, None),
+    ("774m_adamw_mbs8", dict(size="774m"), "adamw", 8, 1024, True, None),
+    ("350m_lion_noremat", dict(size="350m"), "lion", 8, 512, False, None),
+    ("350m_adamw_mbs16", dict(size="350m"), "adamw", 16, 512, True, None),
+]
+
+
+def _run_candidate(tag: str):
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, gpt2
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+    from deepspeed_tpu.utils.timer import peak_flops_for
+
+    spec = dict((c[0], c) for c in _CANDIDATES)[tag]
+    _, kw, opt, micro, seq, remat, fused = spec
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if not on_tpu:   # CPU smoke: shrink to a tiny graph, keep the plumbing
+        kw, micro, seq = dict(size="125m", n_layer=2, d_model=128, n_head=4,
+                              vocab_size=1024), 2, 64
+    kw = dict(kw)
+    size = kw.pop("size")
+    model_cfg = gpt2(size, max_seq=seq, fused_xent=fused, **kw)
+    model = build_model(model_cfg)
+    engine = ds.initialize({
+        "train_batch_size": micro * len(devices),
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": opt, "params": {"lr": 1e-4}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 1},
+        "remat": {"enabled": remat, "policy": "dots_saveable"},
+        "steps_per_print": 10 ** 9,
+    }, model)
+    data = random_token_dataset(engine.train_batch_size, seq_len=seq,
+                                vocab_size=model_cfg.vocab_size)
+    batch = DataLoader(data, local_batch_size=engine.train_batch_size,
+                       shuffle=False).collate_fn(data[:engine.train_batch_size])
+
+    float(engine.train_batch(dict(batch))["loss"])       # compile + warmup
+    n_steps = 10 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        m = engine.train_batch(dict(batch))
+    final_loss = float(m["loss"])                        # host readback barrier
+    dt = (time.perf_counter() - t0) / n_steps
+    if not math.isfinite(final_loss):
+        raise RuntimeError(f"non-finite loss {final_loss}")
+
+    # step decomposition: fwd-only and fwd+bwd over the same micro-batch
+    import jax.numpy as jnp
+
+    cast = jax.jit(engine._cast_compute)
+    with engine.mesh:
+        cp = cast(engine.state.master_params)
+        mb = {k: jnp.asarray(np.asarray(v)[:micro]) for k, v in batch.items()}
+        fwd = jax.jit(lambda p, b: engine.model.loss(
+            p, b, remat_policy=engine.remat_policy))
+        bwd = jax.jit(lambda p, b: jax.grad(
+            lambda pp: engine.model.loss(
+                pp, b, remat_policy=engine.remat_policy).astype(
+                    jnp.float32))(p))
+
+        def timed(fn, reader, reps=6):
+            reader(fn(cp, mb))                            # compile
+            t = time.perf_counter()
+            for _ in range(reps):
+                out = fn(cp, mb)
+            reader(out)
+            return (time.perf_counter() - t) / reps
+
+        t_fwd = timed(fwd, lambda o: float(o))
+        t_bwd = timed(bwd, lambda o: float(
+            jax.tree.leaves(o)[0].reshape(-1)[0]))
+
+    tokens_per_sec = engine.train_batch_size * seq / dt
+    mfu = (tokens_per_sec * model_cfg.flops_per_token()
+           / (peak_flops_for(devices[0]) * len(devices)))
+    n_params = model_cfg.param_count()
+    result = {
+        "metric": f"gpt2_{size}{'' if size != '1.5b' else '_30L'}_"
+                  f"{opt}_mfu",
+        "value": round(mfu, 4),
+        # BASELINE.md north star: >=45% MFU on decoder LMs
+        "vs_baseline": round(mfu / 0.45, 4),
+        "unit": (f"MFU ({n_params / 1e9:.2f}B params, tokens/s="
+                 f"{tokens_per_sec:.0f}, step={dt * 1000:.1f}ms, seq={seq}, "
+                 f"mbs={micro}, opt={opt}, remat={'on' if remat else 'off'}, "
+                 f"xent={bc.xent_label(fused, on_tpu)}, "
+                 f"platform={devices[0].platform}"
+                 + ("" if on_tpu else ", CPU-FALLBACK") + ")"),
+        "decompose_ms": {
+            "fwd_micro": round(t_fwd * 1000, 1),
+            "fwd_bwd_micro": round(t_bwd * 1000, 1),
+            "bwd_only_micro": round((t_bwd - t_fwd) * 1000, 1),
+            "full_step_global": round(dt * 1000, 1),
+        },
+        "candidate": tag,
+    }
+    if on_tpu and n_params >= 1e9 and remat:
+        bc.save_tpu_cache(_CACHE, result)
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    if os.environ.get(_CHILD_MARK):
+        _run_candidate(os.environ[_CHILD_MARK])
+        return
+    bc.emit_cache_upfront(_CACHE, tag="gptl-bench", out_path=_OUT)
+    me = os.path.abspath(__file__)
+    deadline = time.monotonic() + _WINDOW_S
+    best = None
+    for tag, *_ in _CANDIDATES:
+        if time.monotonic() > deadline:
+            bc.log(f"window exhausted before {tag}", "gptl-bench")
+            break
+        env = dict(os.environ)
+        env[_CHILD_MARK] = tag
+        remaining = max(60.0, deadline - time.monotonic())
+        result, status = bc.run_with_tpu_window(
+            me, env, window_s=remaining, child_timeout=1500,
+            tag="gptl-bench", return_status=True)
+        if status == "never-claimed":
+            bc.log("tunnel never granted; stopping the candidate walk",
+                   "gptl-bench")
+            break
+        if result is not None:
+            best = result        # best-first order: first success wins
+            break
+    if best is not None and best.get("candidate") != "350m_lion_noremat" \
+            and time.monotonic() < deadline:
+        # the remat-dimension row: measured where activations fit (350M),
+        # attached to the artifact rather than replacing the headline
+        env = dict(os.environ)
+        env[_CHILD_MARK] = "350m_lion_noremat"
+        extra = bc.run_with_tpu_window(
+            me, env, window_s=max(60.0, deadline - time.monotonic()),
+            child_timeout=1500, tag="gptl-bench")
+        if extra is not None:
+            best = dict(best)
+            best["remat_off_350m"] = extra
+            if "platform=tpu" in best.get("unit", ""):
+                bc.save_tpu_cache(_CACHE, best)
+    if best is None:
+        best = bc.cached_result(_CACHE, tag="gptl-bench")
+    if best is None:
+        bc.log("falling back to virtual CPU", "gptl-bench")
+        env = dict(os.environ)
+        env[_CHILD_MARK] = _CANDIDATES[0][0]
+        best = bc.run_child(me, bc.cpu_fallback_env(env), timeout=1500,
+                            tag="gptl-bench")
+    if best is None:
+        raise SystemExit("gpt-large bench failed on TPU and CPU")
+    with open(_OUT, "w") as f:
+        json.dump(best, f, indent=2)
+    print(json.dumps(best), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,12 +47,54 @@ class Experiment:
     samples_per_sec: float = 0.0
     ok: bool = False
     error: str = ""
+    est_bytes: int = 0          # feasibility-model estimate (0 = not run)
 
     def label(self) -> str:
         mesh = "x".join(f"{k}{v}" for k, v in sorted(self.mesh.items())) or "dp"
         return (f"{mesh}_z{self.zero_stage}_mbs{self.micro_batch}"
                 f"{'_remat' if self.remat else ''}"
                 f"{'_off-' + self.offload if self.offload else ''}")
+
+
+def estimate_experiment_bytes(model_cfg, exp: Experiment, dp: int,
+                              compute_bytes: int = 2,
+                              seq: Optional[int] = None) -> dict:
+    """Per-device memory estimate for one experiment — the reference
+    autotuner's model-info pass (``autotuning/autotuner.py:404`` params +
+    optimizer-state arithmetic, ``:663`` activation estimate), rebuilt for
+    the sharding-based stages: compute params shard over model/pipe (and
+    dp at stage 3), fp32 master+moments shard over dp from stage 1,
+    gradients from stage 2. The activation term is deliberately
+    CONSERVATIVE (counts the fp32 logits slice and per-layer attention
+    probs for the no-remat case): over-pruning costs one missed candidate,
+    under-pruning costs an OOM'd child — and on the wedge-prone TPU
+    tunnel, a killed child can cost the whole session."""
+    n = model_cfg.param_count()
+    mp = int(np.prod([v for k, v in exp.mesh.items()
+                      if k in ("model", "pipe")])) or 1
+    params = n * compute_bytes // (mp * (dp if exp.zero_stage >= 3 else 1))
+    states = (0 if exp.offload else
+              3 * 4 * n // (mp * (dp if exp.zero_stage >= 1 else 1)))
+    grads = 4 * n // (mp * (dp if exp.zero_stage >= 2 else 1))
+    S = seq or getattr(model_cfg, "max_seq", 1024)
+    d = model_cfg.d_model
+    L = model_cfg.n_layer
+    # T5Config spells the FFN width d_ff and has no ffn_dim property
+    f = (getattr(model_cfg, "ffn_dim", None)
+         or getattr(model_cfg, "d_ff", None) or 4 * d)
+    h = model_cfg.n_head
+    tokens = exp.micro_batch * S
+    if exp.remat:
+        # saved carries + ~one live layer of intermediates
+        act = L * tokens * d * compute_bytes * 2
+    else:
+        per_tok = (12 * d + 2 * f) * compute_bytes  # qkv/o/mlp intermediates
+        probs = h * S * compute_bytes               # attention probs row
+        act = L * tokens * (per_tok + probs)
+    logits = tokens * model_cfg.vocab_size * 4      # fp32 loss slice
+    total = params + states + grads + act + logits
+    return {"params": params, "opt_states": states, "grads": grads,
+            "activations": act, "logits": logits, "total": total}
 
 
 class Autotuner:
@@ -71,7 +113,11 @@ class Autotuner:
                  offload_options: Sequence[Optional[str]] = (None,),
                  steps: int = 3, warmup: int = 1,
                  early_stop_margin: float = 0.05,
-                 results_path: Optional[str] = None):
+                 results_path: Optional[str] = None,
+                 model_spec: Optional[dict] = None,
+                 isolate: Optional[bool] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 child_timeout_s: float = 900.0):
         self.base_config = base_config
         self.model_builder = model_builder
         self.make_batch = make_batch
@@ -87,6 +133,30 @@ class Autotuner:
         self.warmup = warmup
         self.early_stop_margin = early_stop_margin
         self.results_path = results_path
+        # model_spec ({"family", "size", "overrides"}) enables BOTH
+        # hardening layers the in-process tuner lacked (round-3 review):
+        # the feasibility model (prune before touching the device) and
+        # child isolation (each surviving experiment in its own
+        # interpreter — a native CHECK-crash or OOM kills the child, not
+        # the tune). ``model_builder`` remains for in-process use with
+        # arbitrary models.
+        self.model_spec = model_spec
+        self.isolate = isolate if isolate is not None else model_spec is not None
+        if self.isolate and model_spec is None:
+            raise ValueError("isolate=True needs model_spec: engines and "
+                             "closures do not cross process boundaries")
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.child_timeout_s = child_timeout_s
+        self._model_cfg = None
+        self._probe_seq = None
+        if model_spec is not None:
+            from .worker import build_model_from_spec
+
+            _, self._model_cfg = build_model_from_spec(model_spec)
+            # the seq both the estimate AND the worker run at (they must
+            # judge the same workload)
+            self._probe_seq = min(getattr(self._model_cfg, "max_seq", 128),
+                                  512)
         self.experiments: list[Experiment] = []
 
     # ------------------------------------------------------------------ grid
@@ -152,8 +222,111 @@ class Autotuner:
         cfg.setdefault("steps_per_print", 10 ** 9)
         return cfg
 
+    # ----------------------------------------------------------- feasibility
+    def _probe_device(self) -> dict:
+        """(n_devices, bytes_limit) WITHOUT initializing jax in this
+        process when isolating: a parent that claims the TPU would starve
+        every worker child of the very device isolation exists to protect
+        (review r4). Cached; probed from a throwaway subprocess."""
+        if getattr(self, "_device_info", None) is not None:
+            return self._device_info
+        if not self.isolate:
+            try:
+                dev = jax.local_devices()[0]
+                stats = dev.memory_stats() or {}
+                self._device_info = {"n_dev": jax.device_count(),
+                                     "limit": stats.get("bytes_limit")}
+            except Exception:
+                self._device_info = {"n_dev": 1, "limit": None}
+            return self._device_info
+        import subprocess
+        import sys as _sys
+
+        code = ("import json, jax; d = jax.local_devices()[0]; "
+                "print(json.dumps({'n_dev': jax.device_count(), "
+                "'limit': (d.memory_stats() or {}).get('bytes_limit')}))")
+        try:
+            p = subprocess.run([_sys.executable, "-c", code], timeout=300,
+                               capture_output=True, text=True)
+            line = next(ln for ln in reversed(p.stdout.strip().splitlines())
+                        if ln.startswith("{"))
+            self._device_info = json.loads(line)
+        except Exception:
+            self._device_info = {"n_dev": 1, "limit": None}
+        return self._device_info
+
+    def _budget_bytes(self) -> Optional[int]:
+        if self.hbm_budget_bytes is not None:
+            return self.hbm_budget_bytes
+        limit = self._probe_device().get("limit")
+        return int(limit * 0.92) if limit else None
+
+    def _prune_infeasible(self, exp: Experiment, dp: int) -> bool:
+        """True = pruned (recorded as a failed experiment, never run)."""
+        if self._model_cfg is None:
+            return False
+        budget = self._budget_bytes()
+        if budget is None:
+            return False
+        est = estimate_experiment_bytes(self._model_cfg, exp, dp,
+                                        seq=self._probe_seq)
+        exp.est_bytes = int(est["total"])
+        if est["total"] <= budget:
+            return False
+        exp.ok = False
+        exp.error = (f"pruned: estimated {est['total'] / 2**30:.2f} GiB "
+                     f"> budget {budget / 2**30:.2f} GiB "
+                     f"(params {est['params'] / 2**30:.2f}, states "
+                     f"{est['opt_states'] / 2**30:.2f}, act "
+                     f"{est['activations'] / 2**30:.2f})")
+        self.experiments.append(exp)
+        log_dist(f"autotune: {exp.label()} {exp.error}", ranks=[0])
+        return True
+
     # --------------------------------------------------------------- measure
+    def _run_isolated(self, exp: Experiment, dp: int) -> Experiment:
+        """One experiment in a fresh child interpreter (reference
+        scheduler-job isolation): a crash/OOM/wedge costs the child."""
+        import os
+        import subprocess
+        import sys as _sys
+
+        payload = json.dumps({"config": self._experiment_config(exp, dp),
+                              "model_spec": self.model_spec,
+                              "seq": self._probe_seq,
+                              "steps": self.steps, "warmup": self.warmup})
+        try:
+            p = subprocess.run(
+                [_sys.executable, "-m", "deepspeed_tpu.autotuning.worker",
+                 payload],
+                capture_output=True, text=True, env=dict(os.environ),
+                timeout=self.child_timeout_s)
+        except subprocess.TimeoutExpired:
+            exp.error = f"child timeout after {self.child_timeout_s:.0f}s"
+            return exp
+        # guarded parse (bench_common.run_child's pattern): a child killed
+        # mid-flush can leave a truncated '{'-line — that is a failed
+        # experiment, never a crashed tune
+        result = None
+        for ln in reversed((p.stdout or "").strip().splitlines()):
+            if ln.startswith("{"):
+                try:
+                    result = json.loads(ln)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if result is None:
+            exp.error = (f"child rc={p.returncode}, no result line: "
+                         f"{(p.stderr or '')[-200:]!r}")
+            return exp
+        exp.ok = bool(result.get("ok"))
+        exp.samples_per_sec = float(result.get("samples_per_sec", 0.0))
+        exp.error = result.get("error", "")
+        return exp
+
     def _run_one(self, exp: Experiment, dp: int) -> Experiment:
+        if self.isolate:
+            return self._run_isolated(exp, dp)
         import deepspeed_tpu as ds
 
         cfg = self._experiment_config(exp, dp)
@@ -189,9 +362,7 @@ class Autotuner:
         """Run the grid; return the fastest config (base config if nothing
         succeeded). Results land in ``self.experiments`` +
         ``results_path`` JSON."""
-        from ..platform.accelerator import get_accelerator
-
-        n_dev = max(1, get_accelerator().device_count())
+        n_dev = max(1, int(self._probe_device().get("n_dev") or 1))
         best: Optional[Experiment] = None
         for mesh in self._mesh_candidates(n_dev):
             dp = self._dp_for_mesh(mesh, n_dev)
@@ -207,6 +378,8 @@ class Autotuner:
                         for mbs in self._candidate_micro_batches(dp):
                             exp = Experiment(stage, mbs, remat, mesh=mesh,
                                              offload=offload)
+                            if self._prune_infeasible(exp, dp):
+                                break  # larger micro-batches estimate bigger
                             log_dist(f"autotune: running {exp.label()}",
                                      ranks=[0])
                             exp = self._run_one(exp, dp)
@@ -227,7 +400,9 @@ class Autotuner:
                         if sweep_best and (not best or sweep_best.samples_per_sec
                                            > best.samples_per_sec):
                             best = sweep_best
-        if self.results_path and jax.process_index() == 0:
+        # isolate mode never touches jax in-process (the children own the
+        # device); the parent is then necessarily single-process
+        if self.results_path and (self.isolate or jax.process_index() == 0):
             with open(self.results_path, "w") as f:
                 json.dump([e.__dict__ for e in self.experiments], f, indent=2)
         if best is None:

@@ -348,10 +348,29 @@ class Engine:
                 self.param_count, int(self.mesh.shape["data"]))
         comm_err_shardings = {k: NamedSharding(self.mesh, P("data"))
                               for k in self._comm_err_shapes}
+        # Moment shardings follow the master EXCEPT for moments the
+        # optimizer doesn't keep (Lion's nu, momentum-SGD's...), which are
+        # (0,)-shaped placeholders: a rank-2 ZeRO spec on those fails the
+        # init jit's out_shardings before the old post-init fixup could
+        # ever run (found by the 1B Lion bench candidate).
+        abstract_opt = jax.eval_shape(self.optimizer.init,
+                                      jax.tree.map(
+                                          lambda shp: jax.ShapeDtypeStruct(
+                                              shp, jnp.float32),
+                                          self._shapes,
+                                          is_leaf=lambda x: isinstance(x, tuple)))
+
+        def _moment_shardings(mtree):
+            return jax.tree.map(
+                lambda s, x: (NamedSharding(self.mesh, P())
+                              if x.shape == (0,) else s),
+                self.master_shardings, mtree)
+
         self.state_shardings = TrainState(
             step=NamedSharding(self.mesh, P()),
             master_params=self.master_shardings,
-            opt_state=OptState(mu=self.master_shardings, nu=self.master_shardings,
+            opt_state=OptState(mu=_moment_shardings(abstract_opt.mu),
+                               nu=_moment_shardings(abstract_opt.nu),
                                count=NamedSharding(self.mesh, P())),
             loss_scale=LossScaleState(*(NamedSharding(self.mesh, P()),) * 3),
             skipped_steps=NamedSharding(self.mesh, P()),
@@ -367,10 +386,6 @@ class Engine:
                 init_fn = jax.jit(self._init_state,
                                   out_shardings=self.state_shardings)
                 self.state = init_fn(rng)
-
-        # opt_state moments for optimizers that don't use nu/mu are empty (0,)
-        # arrays; fix their shardings to replicated to avoid spec-rank mismatch.
-        self._fix_empty_moment_shardings()
 
         self._build_train_step()
         self._eval_step = jax.jit(self._eval_step_impl,
@@ -742,18 +757,6 @@ class Engine:
             comm_err={k: jnp.zeros(s, jnp.float32)
                       for k, s in self._comm_err_shapes.items()},
         )
-
-    def _fix_empty_moment_shardings(self):
-        def fix(shard_tree, state_tree):
-            return jax.tree.map(
-                lambda s, x: NamedSharding(self.mesh, P()) if x.ndim == 1 and x.shape == (0,) else s,
-                shard_tree, state_tree)
-
-        os = self.state.opt_state
-        self.state_shardings = self.state_shardings._replace(
-            opt_state=OptState(mu=fix(self.state_shardings.opt_state.mu, os.mu),
-                               nu=fix(self.state_shardings.opt_state.nu, os.nu),
-                               count=self.state_shardings.opt_state.count))
 
     # ------------------------------------------------------------- train step
     @staticmethod

@@ -118,3 +118,21 @@ def test_eval_batch(devices):
     batch = DataLoader(data, local_batch_size=16, shuffle=False).collate_fn(data)
     loss = engine.eval_batch(batch)
     assert np.isfinite(loss) and loss > 0
+
+
+def test_device_lion_with_sharded_zero_state():
+    """Single-moment optimizers (Lion: nu is a (0,) placeholder) must
+    initialize under ZeRO-sharded state shardings — the rank-2 master spec
+    must not be applied to the empty moment (found by the 1B Lion bench
+    candidate; the old post-init fixup ran too late to save the init)."""
+    engine = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "lion", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+    }, build_model(tiny_test(n_layer=2)))
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
