@@ -40,20 +40,31 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                    length=jnp.zeros((), jnp.int32))
 
 
-def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None):
+def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
+                  alibi=None):
     """q: (B, T, H, hd) vs cache (B, max_len, KV, hd); positions >= length
     masked. For prefill T = prompt len (with causal offset); decode T = 1.
 
-    ``bias`` is an additive (H, T, max_len) score bias (ALiBi).
-    ``flash_decode`` routes the T == 1 hot path to the Pallas streaming
-    kernel (ops/decode_attention.py) instead of materializing the full
-    (B, H, 1, max_len) score tensor."""
+    ``bias`` is an additive (H, T, max_len) score bias; ``alibi`` is the
+    (H,) slope vector — preferred over a materialized bias because the
+    streaming kernel reconstructs the distance ramp in-kernel, so Bloom
+    decode stays on the fused path. ``flash_decode`` routes the T == 1
+    hot path to the Pallas streaming kernel (ops/decode_attention.py)
+    instead of materializing the full (B, H, 1, max_len) score tensor."""
     B, T, H, hd = q.shape
     if (flash_decode and bias is None and T == 1
             and ck.shape[1] % min(128, ck.shape[1]) == 0):
         from ..ops.decode_attention import decode_attention
 
-        return decode_attention(q, ck, cv, length)
+        return decode_attention(q, ck, cv, length, alibi_slopes=alibi)
+    # query t sits at global position length - T + t; key at slot s —
+    # ONE set of position math drives both the alibi bias and the mask
+    t_pos = length - T + jnp.arange(T)[:, None]          # (T, 1)
+    s_pos = jnp.arange(ck.shape[1])[None, :]             # (1, max_len)
+    if alibi is not None:
+        rel = (s_pos - t_pos).astype(jnp.float32)        # (T, max_len)
+        ab = alibi[:, None, None] * rel[None]            # (H, T, max_len)
+        bias = ab if bias is None else bias + ab
     KV = ck.shape[2]
     if KV != H:
         ck = jnp.repeat(ck, H // KV, axis=2)
@@ -62,10 +73,6 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None):
     scores = scores / math.sqrt(hd)
     if bias is not None:
         scores = scores + bias[None]
-    # query t (global position length - T + t) may attend cache slot s
-    # iff s <= that position
-    t_pos = length - T + jnp.arange(T)[:, None]          # (T, 1)
-    s_pos = jnp.arange(ck.shape[1])[None, :]             # (1, max_len)
     keep = s_pos <= t_pos                                # (T, max_len)
     scores = jnp.where(keep[None, None], scores, BIG_NEG)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -96,20 +103,16 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
                                        (0, start, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                        (0, start, 0, 0))
-    bias = None
+    alibi = None
     if cfg.pos_embedding == "alibi":
-        # ALiBi distance bias, cache coordinates: query t sits at global
-        # position length-T+t, key at slot s (mirrors _attention_block's
-        # training-path bias; without it Bloom decodes with no positional
-        # signal at all).
+        # ALiBi positional signal (mirrors _attention_block's training
+        # bias): passed as SLOPES — the streaming decode kernel rebuilds
+        # the distance ramp in-kernel, the dense fallback materializes it.
         from ..models.transformer import alibi_slopes
 
-        t_pos = length - T + jnp.arange(T)[:, None]
-        s_pos = jnp.arange(cache_k.shape[1])[None, :]
-        rel = (s_pos - t_pos).astype(jnp.float32)        # (T, max_len)
-        bias = alibi_slopes(h)[:, None, None] * rel[None]
+        alibi = alibi_slopes(h)
     o = _cache_attend(q, cache_k, cache_v, length, flash_decode=flash_decode,
-                      bias=bias)
+                      alibi=alibi)
     o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
                           p, "bo")
     # MoE trunks expose a single-group no-drop dispatch (_mlp_block_infer,

@@ -35,9 +35,14 @@ BIG_NEG = -2.0 ** 30
 SUBLANES = 8
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block: int,
-                   scale: float):
+def _decode_kernel(*refs, block: int, scale: float, alibi: bool):
+    if alibi:
+        len_ref, slopes_ref, q_ref, k_ref, v_ref, o_ref = refs
+    else:
+        len_ref, q_ref, k_ref, v_ref, o_ref = refs
+        slopes_ref = None
     b = pl.program_id(0)
+    h = pl.program_id(1)
     L = len_ref[b]
     q = q_ref[...].astype(jnp.float32) * scale          # (SUBLANES, hd)
     S = k_ref.shape[0]
@@ -49,6 +54,12 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block: int,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (SUB, blk)
         col = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (SUBLANES, block), 1)
+        if slopes_ref is not None:
+            # ALiBi is a pure function of (slot, live length): slope·(s -
+            # t) with the query at global position t = L-1 — no (H, S)
+            # bias tensor ever exists (the dense fallback builds one per
+            # step; Bloom's positional signal costs one SMEM scalar here)
+            s = s + slopes_ref[h] * (col - (L - 1)).astype(jnp.float32)
         keep = col < L
         s = jnp.where(keep, s, BIG_NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -66,10 +77,13 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block: int,
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def decode_attention(q, ck, cv, length, *, block: int = 128,
-                     interpret: Optional[bool] = None):
+def decode_attention(q, ck, cv, length, *, alibi_slopes=None,
+                     block: int = 128, interpret: Optional[bool] = None):
     """q: (B, 1, H, hd) current-token queries; ck/cv: (B, max_len, KV, hd)
     cache; ``length`` scalar or (B,) live lengths (slots < length attended).
+    ``alibi_slopes``: optional (H,) per-head slopes — the ALiBi distance
+    bias is reconstructed in-kernel from the live length (Bloom decode
+    stays on the streaming kernel instead of the dense fallback).
 
     Returns (B, 1, H, hd)."""
     from jax.experimental.pallas import tpu as pltpu
@@ -85,28 +99,32 @@ def decode_attention(q, ck, cv, length, *, block: int = 128,
     group = H // KV
     scale = 1.0 / math.sqrt(hd)
     lengths = jnp.broadcast_to(jnp.asarray(length, jnp.int32).reshape(-1), (B,))
+    alibi = alibi_slopes is not None
 
     # (B, 1, H, hd) → (B, H, SUBLANES, hd): sublane-replicated single query
     qs = jnp.broadcast_to(q.swapaxes(1, 2), (B, H, SUBLANES, hd))
 
+    n_prefetch = 2 if alibi else 1
+    pre_args = ((lengths, jnp.asarray(alibi_slopes, jnp.float32))
+                if alibi else (lengths,))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=n_prefetch,
         grid=(B, H),
         in_specs=[
             pl.BlockSpec((None, None, SUBLANES, hd),
-                         lambda b, h, lens: (b, h, 0, 0)),
+                         lambda b, h, *pre: (b, h, 0, 0)),
             pl.BlockSpec((None, S, None, hd),
-                         lambda b, h, lens: (b, 0, h // group, 0)),
+                         lambda b, h, *pre: (b, 0, h // group, 0)),
             pl.BlockSpec((None, S, None, hd),
-                         lambda b, h, lens: (b, 0, h // group, 0)),
+                         lambda b, h, *pre: (b, 0, h // group, 0)),
         ],
         out_specs=pl.BlockSpec((None, None, SUBLANES, hd),
-                               lambda b, h, lens: (b, h, 0, 0)),
+                               lambda b, h, *pre: (b, h, 0, 0)),
     )
     out = pl.pallas_call(
-        partial(_decode_kernel, block=blk, scale=scale),
+        partial(_decode_kernel, block=blk, scale=scale, alibi=alibi),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, SUBLANES, hd), q.dtype),
         interpret=interpret,
-    )(lengths, qs, ck, cv)
+    )(*pre_args, qs, ck, cv)
     return out[:, :, :1, :].swapaxes(1, 2)               # (B, 1, H, hd)

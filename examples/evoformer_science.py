@@ -1,0 +1,83 @@
+"""DS4Science evoformer attention: streamed pair bias with gradients.
+
+The reference ships ~15 kLoC of CUTLASS for exactly this operation
+(``csrc/deepspeed4science/evoformer_attn/``, the DS4Science release):
+AlphaFold-style attention whose scores take an additive PAIR bias and
+whose output is sigmoid-gated — memory-efficient even though the bias is
+(B, H, S, S) and must receive gradients (the pair representation trains
+through it). Here the whole thing is the Pallas flash kernel's bias
+operand (`ops/flash_attention.py`): bias tiles stream through VMEM in the
+forward and both backwards, dbias comes back as ds tiles, and the (B, H,
+S, S) score/prob tensors never exist in HBM.
+
+This example trains a toy MSA-row-attention block: per-head linear maps
+produce the pair bias from a learned pair representation, attention runs
+gated, and the loss gradient must flow back into BOTH the sequence
+activations and the pair representation — the signature the CUTLASS
+kernels exist to provide.
+
+Run: DSTPU_EXAMPLE_SMOKE=1 python examples/evoformer_science.py
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.evoformer import evoformer_attention
+
+SMOKE = os.environ.get("DSTPU_EXAMPLE_SMOKE") == "1"
+B, S, H, hd = (2, 32, 4, 16) if SMOKE else (4, 256, 8, 32)
+D_PAIR = 8
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.standard_normal((B, S, H * hd)), jnp.float32)
+pair = jnp.asarray(rng.standard_normal((B, S, S, D_PAIR)), jnp.float32)
+target = jnp.asarray(rng.standard_normal((B, S, H * hd)), jnp.float32)
+
+params = {
+    "wq": jnp.asarray(rng.standard_normal((H * hd, H * hd)) * 0.05),
+    "wk": jnp.asarray(rng.standard_normal((H * hd, H * hd)) * 0.05),
+    "wv": jnp.asarray(rng.standard_normal((H * hd, H * hd)) * 0.05),
+    "w_gate": jnp.asarray(rng.standard_normal((H * hd, H * hd)) * 0.05),
+    "w_bias": jnp.asarray(rng.standard_normal((D_PAIR, H)) * 0.05),
+    "pair": pair,           # the pair representation itself is trainable
+}
+
+
+def block(p, x):
+    q = (x @ p["wq"]).reshape(B, S, H, hd)
+    k = (x @ p["wk"]).reshape(B, S, H, hd)
+    v = (x @ p["wv"]).reshape(B, S, H, hd)
+    gate = (x @ p["w_gate"]).reshape(B, S, H, hd)
+    # (B, S, S, D_PAIR) @ (D_PAIR, H) -> (B, H, S, S) full-shape bias:
+    # differentiable through the kernel's dbias tiles
+    bias = jnp.einsum("bstd,dh->bhst", p["pair"], p["w_bias"])
+    out = evoformer_attention(q, k, v, bias=bias, gate=gate)
+    return out.reshape(B, S, H * hd)
+
+
+def loss(p):
+    return jnp.mean((block(p, x) - target) ** 2)
+
+
+grad_fn = jax.jit(jax.value_and_grad(loss))
+lr = 0.05
+losses = []
+for step in range(6 if SMOKE else 50):
+    val, g = grad_fn(params)
+    params = jax.tree.map(lambda w, gw: w - lr * gw, params, g)
+    losses.append(float(val))
+    if step % (2 if SMOKE else 10) == 0:
+        gp = float(jnp.linalg.norm(g["pair"]))
+        gb = float(jnp.linalg.norm(g["w_bias"]))
+        print(f"step {step}: loss {val:.4f} |dpair| {gp:.2e} "
+              f"|dw_bias| {gb:.2e}", flush=True)
+
+assert losses[-1] < losses[0], losses
+final_g = grad_fn(params)[1]
+assert float(jnp.linalg.norm(final_g["pair"])) > 0, \
+    "pair representation received no gradient"
+print(f"evoformer block trained: {losses[0]:.4f} -> {losses[-1]:.4f} "
+      "(pair-bias gradients flow through the streamed kernel)")
