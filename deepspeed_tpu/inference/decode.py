@@ -52,8 +52,12 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
     hot path to the Pallas streaming kernel (ops/decode_attention.py)
     instead of materializing the full (B, H, 1, max_len) score tensor."""
     B, T, H, hd = q.shape
+    # TPU lane tiling wants full 128-wide blocks: generate_tokens pads the
+    # cache to a 128 multiple when flash_decode is on, so this gate only
+    # declines externally-built odd caches (which take the dense path
+    # rather than risking an unaligned Pallas tile on hardware).
     if (flash_decode and bias is None and T == 1
-            and ck.shape[1] % min(128, ck.shape[1]) == 0):
+            and ck.shape[1] % 128 == 0):
         from ..ops.decode_attention import decode_attention
 
         return decode_attention(q, ck, cv, length, alibi_slopes=alibi)
@@ -190,7 +194,13 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
             f"{objective!r} — use forward() (MLM logits / feature hidden "
             "states) instead")
     B, S = input_ids.shape
-    cache = init_cache(model.cfg, B, S + max_new, cache_dtype or model.cfg.dtype)
+    cache_len = S + max_new
+    if flash_decode:
+        # round up to the Pallas decode kernel's 128-lane block: the spare
+        # slots are masked by the live length, and every decode step stays
+        # on the streaming kernel regardless of prompt/output lengths
+        cache_len = -(-cache_len // 128) * 128
+    cache = init_cache(model.cfg, B, cache_len, cache_dtype or model.cfg.dtype)
     eos = eos_token_id
     mat = materialize if materialize is not None else (lambda p: p)
 
