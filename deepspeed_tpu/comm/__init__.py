@@ -1,6 +1,7 @@
 from .comm import (CommsLogger, all_gather, all_reduce, all_to_all, barrier,
-                   broadcast, comms_logger, get_world_size, ppermute,
+                   broadcast, comms_logger, get_rank, get_world_size, ppermute,
                    reduce_scatter)
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all", "broadcast",
-           "ppermute", "barrier", "get_world_size", "CommsLogger", "comms_logger"]
+           "ppermute", "barrier", "get_rank", "get_world_size", "CommsLogger",
+           "comms_logger"]

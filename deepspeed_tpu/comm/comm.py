@@ -145,3 +145,12 @@ def get_world_size(axis_name: str | Sequence[str]) -> int:
             size *= lax.axis_size(a)
         return size
     return lax.axis_size(axis_name)
+
+
+def get_rank(axis_name: str | None = None):
+    """This shard's index along ``axis_name`` (trace-time, inside a
+    shard_map body) — or the host process index when no axis is given
+    (the ``deepspeed.comm.get_rank()`` host-side meaning)."""
+    if axis_name is None:
+        return jax.process_index()
+    return lax.axis_index(axis_name)

@@ -63,3 +63,20 @@ t_engine = ds.init_inference(tower, tower.init(jax.random.key(1)),
                              {"dtype": "float32"})
 feats = np.asarray(t_engine.forward(prompt))
 print(f"feature tower hidden states {feats.shape}")
+
+# 4. MoE serving: expert dispatch inside the KV-cache decode scan ---------
+# (reference DeepSpeedMoEInference; decode uses a single-group no-drop
+# dispatch — models/moe.py _mlp_block_infer — and the router stays fp32
+# through the engine's compute cast; expert banks WOQ-quantize like any
+# other weight)
+from deepspeed_tpu.models import mixtral
+
+moe_cfg = mixtral("tiny", n_layer=2, n_head=4, n_kv_head=2, d_model=64,
+                  d_ff=128, num_experts=4, vocab_size=256, max_seq=64,
+                  moe_drop_tokens=False)
+moe = build_model(moe_cfg)
+moe_engine = ds.init_inference(moe, moe.init(jax.random.key(2)),
+                               {"dtype": "float32", "quantize": True})
+moe_out = np.asarray(moe_engine.generate(prompt, max_new_tokens=8,
+                                         greedy=True))
+print(f"MoE (4 experts, top-2, int8 banks) continuation shape {moe_out.shape}")

@@ -530,3 +530,20 @@ def test_tiled_head_flag_matches_dense_head():
     losses = [float(engine.train_batch(dict(batch))["loss"])
               for _ in range(3)]
     assert losses[-1] < losses[0]
+
+
+def test_comm_get_rank_both_modes():
+    """deepspeed.comm.get_rank parity: host process index with no axis,
+    shard index inside a shard_map body with one."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm import get_rank
+
+    assert get_rank() == jax.process_index()
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=8))
+    out = jax.jit(jax.shard_map(lambda: get_rank("data")[None],
+                                mesh=mesh, in_specs=(),
+                                out_specs=P("data")))()
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8))
