@@ -139,6 +139,12 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
             # so offload <-> device restores work in both directions.
             "layout": "host" if getattr(engine, "offload", False) else "device",
         }
+        moq = getattr(engine, "_moq", None)
+        if moq is not None:
+            # the MoQ schedule lives outside the jitted state (bit width is
+            # a static argument): resume must not restart QAT at start_bits
+            meta["moq"] = {"bits": moq.bits, "initial_eig": moq.initial_eig,
+                           "history": moq.history}
         (path / "meta.json").write_text(json.dumps(meta, indent=2))
         if not is_async:
             (base / "latest").write_text(tag)
@@ -243,5 +249,16 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
         engine.state = restored
         step_guess = int(restored.step)
     engine.global_steps = int(meta_pre.get("global_steps", step_guess))
+    moq_meta = meta_pre.get("moq")
+    if getattr(engine, "_moq", None) is not None:
+        if moq_meta:
+            engine._moq.bits = int(moq_meta["bits"])
+            engine._moq.initial_eig = moq_meta.get("initial_eig")
+            engine._moq.history = [tuple(h)
+                                   for h in moq_meta.get("history", [])]
+        else:
+            log_dist("load_checkpoint: MoQ enabled but the checkpoint "
+                     "carries no schedule (pre-MoQ save?) — QAT restarts "
+                     f"at start_bits={engine._moq.bits}", ranks=[0])
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})", ranks=[0])
     return str(path)

@@ -189,3 +189,37 @@ def test_moq_engine_end_to_end_narrows_and_trains():
     assert engine._moq is not None
     assert len(engine._moq.history) >= 2        # probes actually ran
     assert engine._moq.bits == 8                # narrowed to target
+
+
+def test_moq_schedule_survives_checkpoint_resume(tmp_path):
+    """The MoQ bit width lives OUTSIDE the jitted state (it's a static
+    argument): a resume that restarted QAT at start_bits would silently
+    undo the narrowing. Save/load must carry the schedule."""
+    def make():
+        return ds.initialize({
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+            "compression": {"weight_quantization": {
+                "enabled": True, "bits": 8, "start_bits": 16,
+                "quantize_period": 2, "eigenvalue": True,
+                "eigenvalue_threshold": 1e6}},
+        }, build_model(tiny_test(n_layer=2)))
+
+    engine = make()
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    # probes land at steps 2 (anchors the eigenvalue scale) and 4 (first
+    # narrowing): 6 steps reach the 8-bit target
+    for _ in range(6):
+        engine.train_batch(dict(batch))
+    assert engine._moq.bits == 8
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+
+    resumed = make()
+    assert resumed._moq.bits == 16          # fresh engine restarts wide...
+    resumed.load_checkpoint(str(tmp_path / "ckpt"))
+    assert resumed._moq.bits == 8           # ...until the resume restores
+    assert resumed._moq.history == engine._moq.history
+    loss = float(resumed.train_batch(dict(batch))["loss"])
+    assert np.isfinite(loss)
