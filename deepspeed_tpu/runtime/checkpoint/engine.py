@@ -257,8 +257,17 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
             engine._moq.history = [tuple(h)
                                    for h in moq_meta.get("history", [])]
         else:
+            # no schedule in the checkpoint (pre-MoQ save): RESET to the
+            # fresh state — keeping an already-narrowed in-process schedule
+            # would silently diverge from a fresh-process resume of the
+            # same checkpoint
+            moq = engine._moq
+            cfg_wq = engine.config.compression.weight_quantization
+            moq.bits = int(cfg_wq.start_bits or cfg_wq.bits)
+            moq.initial_eig = None
+            moq.history = []
             log_dist("load_checkpoint: MoQ enabled but the checkpoint "
                      "carries no schedule (pre-MoQ save?) — QAT restarts "
-                     f"at start_bits={engine._moq.bits}", ranks=[0])
+                     f"at start_bits={moq.bits}", ranks=[0])
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})", ranks=[0])
     return str(path)

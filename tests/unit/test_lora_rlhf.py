@@ -32,6 +32,16 @@ def _lora_cfg(**over):
     return cfg
 
 
+def _assert_base_frozen(before, after):
+    """Every non-lora leaf bit-identical (gradients AND decay masked)."""
+    for (path, b), a in zip(
+            jax.tree_util.tree_flatten_with_path(before)[0],
+            jax.tree.leaves(after)):
+        name = jax.tree_util.keystr(path)
+        if "lora" not in name:
+            np.testing.assert_array_equal(b, a, err_msg=name)
+
+
 def test_lora_trains_adapters_only_base_bit_frozen():
     engine = ds.initialize(_lora_cfg(), build_model(tiny_test(n_layer=2)))
     before = jax.tree.map(np.asarray, engine.state.master_params)
@@ -42,14 +52,7 @@ def test_lora_trains_adapters_only_base_bit_frozen():
               for _ in range(4)]
     assert losses[-1] < losses[0], losses
     after = jax.tree.map(np.asarray, engine.state.master_params)
-    # every base leaf bit-identical (gradients AND weight decay masked)
-    for (path, b), a in zip(
-            jax.tree_util.tree_flatten_with_path(before)[0],
-            jax.tree.leaves(after)):
-        name = jax.tree_util.keystr(path)
-        if "lora" in name:
-            continue
-        np.testing.assert_array_equal(b, a, err_msg=name)
+    _assert_base_frozen(before, after)
     # adapters actually moved (B starts at zero)
     moved = [float(np.abs(l).max())
              for l in jax.tree.leaves(after["lora"])]
@@ -157,3 +160,21 @@ def test_lora_checkpoint_roundtrip(tmp_path):
     assert trained                        # and they are the TRAINED values
     l_resume = float(resumed.train_batch(dict(batch))["loss"])
     np.testing.assert_allclose(l_resume, l_cont, rtol=1e-4)
+
+
+def test_lora_composes_with_zero3():
+    """Adapters (replicated) over a ZeRO-3-sharded frozen base: the
+    LoRA merge happens on the gathered compute params inside the scan,
+    the update mask composes with the stage-3 master sharding."""
+    engine = ds.initialize(_lora_cfg(zero_optimization={
+        "stage": 3, "param_persistence_threshold": 0}),
+        build_model(tiny_test(n_layer=2)))
+    before = jax.tree.map(np.asarray, engine.state.master_params)
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+    _assert_base_frozen(before,
+                        jax.tree.map(np.asarray, engine.state.master_params))
