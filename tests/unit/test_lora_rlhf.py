@@ -178,3 +178,24 @@ def test_lora_composes_with_zero3():
     assert losses[-1] < losses[0], losses
     _assert_base_frozen(before,
                         jax.tree.map(np.asarray, engine.state.master_params))
+
+
+def test_lora_composes_with_moe_expert_mesh():
+    """Adapters over stacked expert banks: (L, E, d, f) targets get
+    (L, E, d, r)x(L, E, r, f) factors through the same einsum; router and
+    banks stay frozen, adapters train, on a data x expert mesh."""
+    engine = ds.initialize(_lora_cfg(
+        zero_optimization={"stage": 2},
+        mesh={"data": 4, "expert": 2}),
+        build_model(tiny_test(n_layer=2, num_experts=2)))
+    lora = engine.state.master_params["lora"]
+    assert lora["w_in"]["a"].shape == (2, 2, 64, 4)   # (L, E, d, r)
+    before = jax.tree.map(np.asarray, engine.state.master_params)
+    data = random_token_dataset(16, 32, 256, learnable=True)
+    batch = DataLoader(data, local_batch_size=8,
+                       shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+    _assert_base_frozen(before,
+                        jax.tree.map(np.asarray, engine.state.master_params))
