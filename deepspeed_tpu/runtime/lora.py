@@ -26,8 +26,21 @@ import jax
 import jax.numpy as jnp
 
 # matmul leaves eligible for adapters (attention + FFN projections — the
-# reference's LinearLayer_LoRA targets)
-LORA_TARGETS = ("wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate")
+# reference's LinearLayer_LoRA targets; cq/ck/cv/co are T5 cross-attention)
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "w_in", "w_out", "w_gate",
+                "cq", "ck", "cv", "co")
+
+
+def _layer_groups(params):
+    """Yield (group_path, layers_dict) for every layer stack a model
+    carries: decoder trunks have a top-level ``layers``; T5 has
+    ``enc.layers`` and ``dec.layers``."""
+    if isinstance(params.get("layers"), dict):
+        yield ("layers",), params["layers"]
+    for side in ("enc", "dec"):
+        sub = params.get(side)
+        if isinstance(sub, dict) and isinstance(sub.get("layers"), dict):
+            yield (side, "layers"), sub["layers"]
 
 
 class LoRAMixin:
@@ -46,18 +59,28 @@ class LoRAMixin:
         r = self.lora_rank
         lora = {}
         key = jax.random.fold_in(rng, 0x10F4)
-        for name in self.lora_targets:
-            w = base["layers"].get(name)
-            if w is None or w.ndim < 2:
-                continue
-            key, sub = jax.random.split(key)
-            *lead, d_in, d_out = w.shape
-            # standard LoRA init: A gaussian, B zero -> identity at step 0
-            lora[name] = {
-                "a": jax.random.normal(sub, (*lead, d_in, r), jnp.float32)
-                / math.sqrt(d_in),
-                "b": jnp.zeros((*lead, r, d_out), jnp.float32),
-            }
+        groups = list(_layer_groups(base))
+        if not groups:
+            raise ValueError(
+                "lora: this model exposes no layer stack "
+                "(params['layers'] / params['enc'|'dec']['layers'])")
+        for gpath, layers in groups:
+            bank = lora
+            for k in gpath[:-1]:
+                bank = bank.setdefault(k, {})
+            bank = bank.setdefault(gpath[-1], {})
+            for name in self.lora_targets:
+                w = layers.get(name)
+                if w is None or w.ndim < 2:
+                    continue
+                key, sub = jax.random.split(key)
+                *lead, d_in, d_out = w.shape
+                # standard LoRA init: A gaussian, B zero → identity at step 0
+                bank[name] = {
+                    "a": jax.random.normal(sub, (*lead, d_in, r), jnp.float32)
+                    / math.sqrt(d_in),
+                    "b": jnp.zeros((*lead, r, d_out), jnp.float32),
+                }
         base["lora"] = lora
         return base
 
@@ -87,21 +110,41 @@ class LoRAMixin:
             return params
         merged = dict(params)
         lora = merged.pop("lora")
-        layers = dict(merged["layers"])
-        for name, ab in lora.items():
-            w = layers[name]
-            delta = jnp.einsum("...dr,...rk->...dk",
-                               ab["a"].astype(w.dtype),
-                               ab["b"].astype(w.dtype))
-            layers[name] = jax.lax.stop_gradient(w) + self._lora_scale * delta
-        merged["layers"] = layers
+
+        def merge_bank(layers, bank):
+            layers = dict(layers)
+            for name, ab in bank.items():
+                w = layers[name]
+                delta = jnp.einsum("...dr,...rk->...dk",
+                                   ab["a"].astype(w.dtype),
+                                   ab["b"].astype(w.dtype))
+                layers[name] = (jax.lax.stop_gradient(w)
+                                + self._lora_scale * delta)
+            return layers
+
+        # walk the SAME groups init() created banks for (one source of
+        # truth: a stack known to _layer_groups but skipped here would
+        # train its adapters as a silent no-op)
+        for gpath, layers in _layer_groups(merged):
+            bank = lora
+            for k in gpath:
+                bank = bank.get(k, {})
+            if not bank:
+                continue
+            if len(gpath) == 1:
+                merged["layers"] = merge_bank(layers, bank)
+            else:
+                sub = dict(merged[gpath[0]])
+                sub["layers"] = merge_bank(layers, bank)
+                merged[gpath[0]] = sub
         return merged
 
     def loss(self, params, batch, **kw):
         return super().loss(self.merge_lora(params), batch, **kw)
 
-    def apply(self, params, input_ids, **kw):
-        return super().apply(self.merge_lora(params), input_ids, **kw)
+    def apply(self, params, input_ids, *args, **kw):
+        # *args: T5's apply takes decoder_input_ids positionally
+        return super().apply(self.merge_lora(params), input_ids, *args, **kw)
 
 
 def convert_to_lora(model, *, rank: int = 8, alpha: float = 16.0,

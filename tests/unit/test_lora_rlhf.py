@@ -189,11 +189,33 @@ def test_lora_composes_with_moe_expert_mesh():
         mesh={"data": 4, "expert": 2}),
         build_model(tiny_test(n_layer=2, num_experts=2)))
     lora = engine.state.master_params["lora"]
-    assert lora["w_in"]["a"].shape == (2, 2, 64, 4)   # (L, E, d, r)
+    assert lora["layers"]["w_in"]["a"].shape == (2, 2, 64, 4)  # (L,E,d,r)
     before = jax.tree.map(np.asarray, engine.state.master_params)
     data = random_token_dataset(16, 32, 256, learnable=True)
     batch = DataLoader(data, local_batch_size=8,
                        shuffle=False).collate_fn(data[:8])
+    losses = [float(engine.train_batch(dict(batch))["loss"])
+              for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+    _assert_base_frozen(before,
+                        jax.tree.map(np.asarray, engine.state.master_params))
+
+
+def test_lora_on_t5_enc_dec():
+    """Adapters generalize to the encoder-decoder trunk: enc/dec layer
+    stacks each get their own bank (dec includes cross-attention cq/ck/
+    cv/co), the shared table and all base weights stay frozen."""
+    from deepspeed_tpu.models import t5
+
+    engine = ds.initialize(_lora_cfg(), build_model(
+        t5("small", d_model=64, d_ff=128, n_layer=2, n_dec_layer=2,
+           n_head=4, d_kv=16, vocab_size=512, max_src=32, max_tgt=16)))
+    lora = engine.state.master_params["lora"]
+    assert "cq" in lora["dec"]["layers"] and "cq" not in lora["enc"]["layers"]
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 512, (8, 32)).astype(np.int32),
+             "labels": rng.integers(0, 512, (8, 16)).astype(np.int32)}
+    before = jax.tree.map(np.asarray, engine.state.master_params)
     losses = [float(engine.train_batch(dict(batch))["loss"])
               for _ in range(3)]
     assert losses[-1] < losses[0], losses
