@@ -1179,6 +1179,14 @@ class Engine:
             if self.monitor:
                 events = [(f"Train/loss", metrics["loss"], self.global_steps),
                           (f"Train/lr", metrics["lr"], self.global_steps)]
+                if self._moq is not None and any(
+                        n.startswith("weight_quantization")
+                        for n in comp_active):
+                    # observability for the quantization schedule (the
+                    # reference logs its quantizer's bit switches too);
+                    # only while QAT is actually active per its offset
+                    events.append(("Train/moq_bits", self._moq.bits,
+                                   self.global_steps))
                 if stats:
                     events.append(("Train/samples_per_sec",
                                    stats["samples_per_sec"], self.global_steps))
