@@ -261,6 +261,16 @@ def alibi_slopes(n_head: int) -> jnp.ndarray:
     return jnp.asarray(slopes, jnp.float32)
 
 
+def alibi_bias(slopes, S: int) -> jnp.ndarray:
+    """Dense (H, S, S) ALiBi distance bias: slope·(key_pos − query_pos).
+    ONE definition of the ramp convention — the flash/ring/decode kernels
+    rebuild the same ramp from positions instead of taking this tensor
+    (it is O(S²); only the dense fallbacks materialize it)."""
+    rel = (jnp.arange(S)[None, :] - jnp.arange(S)[:, None])
+    return (jnp.asarray(slopes, jnp.float32)[:, None, None]
+            * rel[None].astype(jnp.float32))
+
+
 def _token_nll_impl(logits, targets):
     """Per-token NLL in fp32 without materializing a (B, S, V) fp32 tensor:
     nll = logsumexp(logits) - logit[target]. The bf16→fp32 cast and exp
@@ -368,12 +378,13 @@ class TransformerLM:
                 "attention: the flash/sparse/Ulysses attention_fns apply a "
                 "causal mask and would silently break bidirectionality")
         if attention_fn is not None and config.pos_embedding == "alibi" \
-                and not getattr(attention_fn, "accepts_bias", False):
+                and not (getattr(attention_fn, "accepts_bias", False)
+                         or getattr(attention_fn, "accepts_alibi_slopes",
+                                    False)):
             raise ValueError(
                 "alibi needs an additive score bias; this attention_fn "
-                "does not accept one (flash attention does — "
-                "make_flash_attention() — since the bias operand landed; "
-                "sparse/Ulysses still do not)")
+                "accepts neither a bias nor alibi slopes (flash and ring "
+                "attention do; sparse/Ulysses still do not)")
         self.attention_fn = attention_fn or partial(causal_attention,
                                                     causal=config.causal)
 
@@ -528,15 +539,19 @@ class TransformerLM:
         attn_kw = {}
         if cfg.pos_embedding == "alibi":
             # ALiBi (Bloom): linear distance bias on the scores instead of
-            # any positional embedding (custom attention_fns are rejected at
-            # construction — they can't take a score bias).
-            rel = (jnp.arange(S)[None, :] - jnp.arange(S)[:, None])
-            attn_kw["bias"] = (alibi_slopes(h)[:, None, None]
-                               * rel[None].astype(jnp.float32))
+            # any positional embedding. Attention fns that take slopes
+            # build the ramp themselves (flash: in-kernel from block
+            # indices; ring: from the global ring-step positions) — no
+            # (H, S, S) bias ever materializes, which is what makes ALiBi
+            # long-context viable; the dense path gets the explicit bias.
+            if getattr(self.attention_fn, "accepts_alibi_slopes", False):
+                attn_kw["alibi_slopes"] = alibi_slopes(h)
+            else:
+                attn_kw["bias"] = alibi_bias(alibi_slopes(h), S)
         if getattr(self.attention_fn, "handles_sharding", False):
             # Explicit-collective attention (sequence/layer.py Ulysses or
             # ring): the wrapper does its own shard_map resharding.
-            o = self.attention_fn(q, kk, vv, mask=attn_mask)
+            o = self.attention_fn(q, kk, vv, mask=attn_mask, **attn_kw)
         else:
             # Ulysses via GSPMD: trade the sequence shard for a head shard
             # around attention (reference sequence/layer.py all_to_all pair).

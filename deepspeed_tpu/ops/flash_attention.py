@@ -38,17 +38,20 @@ SUBLANES = 8  # fp32 sublane tile: lse/delta rows replicated to (8, S)
 
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool,
-                biased: bool):
+                biased: bool, alibi: bool = False):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     i = 3
-    mask_ref = bias_ref = None
+    mask_ref = bias_ref = slopes_ref = None
     if masked:
         mask_ref = refs[i]; i += 1
     if biased:
         bias_ref = refs[i]; i += 1
+    if alibi:
+        slopes_ref = refs[i]; i += 1
     o_ref, lse_ref = refs[i:]
     iq = pl.program_id(2)
+    h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
     q = q_ref[...].astype(jnp.float32) * scale          # (blk, hd)
     nkb = k_ref.shape[0] // block
     q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
@@ -63,6 +66,8 @@ def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool,
             # (blk, S) row slice this q-block owns — never a full (S, S)
             # materialization (the whole point vs the dense path)
             s = s + bias_ref[:, pl.ds(jk * block, block)].astype(jnp.float32)
+        if slopes_ref is not None:
+            s = s + h_slope * _alibi_rel(iq, jk, block)
         keep = None
         if causal:
             kpos = jk * block + jax.lax.broadcasted_iota(
@@ -104,6 +109,27 @@ def _mask_operand(mask, S):
     return jnp.broadcast_to(m, (mask.shape[0], SUBLANES, S))
 
 
+def _alibi_rel(iq, jk, block):
+    """(blk, blk) signed key−query distance for q block iq vs k block jk —
+    the ALiBi ramp built IN-kernel, so long sequences never materialize an
+    (H, S, S) bias operand (at 64k seq that operand alone would be 100+
+    GB; the decode kernel does the same from the live length)."""
+    q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    k_pos = jk * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    return (k_pos - q_pos).astype(jnp.float32)
+
+
+def _slopes_operand(slopes):
+    """(H,) → (1, H) fp32 operand; each grid program receives ITS head's
+    slope as a (1, 1) block via a static index map — no dynamic lane
+    extract for Mosaic to lower."""
+    return jnp.asarray(slopes, jnp.float32).reshape(1, -1)
+
+
+def _slopes_spec(H):
+    return pl.BlockSpec((1, 1), lambda b, h, i: (0, h))
+
+
 def _bias_row_spec(bias_shape, B, H, block):
     """(blk, S) row-slice BlockSpec for a (BB, HH, S, S) bias with BB in
     {1, B} and HH in {1, H} (broadcast handled by the index map, NOT by
@@ -123,13 +149,13 @@ def _bias_col_spec(bias_shape, B, H, block):
 
 
 def _fwd_call(q, k, v, mask, bias, *, block: int, causal: bool,
-              interpret: bool):
+              interpret: bool, alibi=None):
     B, H, S, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     grid = (B, H, S // block)
     masked, biased = mask is not None, bias is not None
     kernel = partial(_fwd_kernel, block=block, scale=scale, causal=causal,
-                     masked=masked, biased=biased)
+                     masked=masked, biased=biased, alibi=alibi is not None)
     in_specs = [
         pl.BlockSpec((None, None, block, hd), lambda b, h, i: (b, h, i, 0)),
         pl.BlockSpec((None, None, S, hd), lambda b, h, i: (b, h, 0, 0)),
@@ -143,6 +169,9 @@ def _fwd_call(q, k, v, mask, bias, *, block: int, causal: bool,
     if biased:
         in_specs.append(_bias_row_spec(bias.shape, B, H, block))
         args.append(bias)
+    if alibi is not None:
+        in_specs.append(_slopes_spec(H))
+        args.append(alibi)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -162,17 +191,21 @@ def _fwd_call(q, k, v, mask, bias, *, block: int, causal: bool,
 
 # ---------------------------------------------------------------- backward
 def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
-                        biased: bool = False, grad_bias: bool = False):
+                        biased: bool = False, grad_bias: bool = False,
+                        alibi: bool = False):
 
     def kernel(*refs):
         refs = list(refs)
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
         i = 6
-        mask_ref = bias_ref = dbias_ref = None
+        mask_ref = bias_ref = dbias_ref = slopes_ref = None
         if masked:
             mask_ref = refs[i]; i += 1
         if biased:
             bias_ref = refs[i]; i += 1
+        if alibi:
+            slopes_ref = refs[i]; i += 1
+        h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
         dq_ref = refs[i]; i += 1
         if grad_bias:
             dbias_ref = refs[i]
@@ -195,6 +228,8 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
             if bias_ref is not None:
                 s = s + bias_ref[:, pl.ds(jk * block, block)].astype(
                     jnp.float32)
+            if slopes_ref is not None:
+                s = s + h_slope * _alibi_rel(iq, jk, block)
             keep = None
             if causal:
                 kpos = jk * block + jax.lax.broadcasted_iota(
@@ -227,17 +262,20 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
 
 
 def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
-                         biased: bool = False):
+                         biased: bool = False, alibi: bool = False):
     def kernel(*refs):
         refs = list(refs)
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
         i = 6
-        mask_ref = bias_ref = None
+        mask_ref = bias_ref = slopes_ref = None
         if masked:
             mask_ref = refs[i]; i += 1
         if biased:
             bias_ref = refs[i]; i += 1
+        if alibi:
+            slopes_ref = refs[i]; i += 1
         dk_ref, dv_ref = refs[i:]
+        h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
         jk = pl.program_id(2)
         k = k_ref[...].astype(jnp.float32)               # (blk, hd)
         v = v_ref[...].astype(jnp.float32)
@@ -259,6 +297,8 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
                 # (S, blk) column slice of the bias: rows iq-block
                 s = s + bias_ref[pl.ds(iq * block, block), :].astype(
                     jnp.float32)
+            if slopes_ref is not None:
+                s = s + h_slope * _alibi_rel(iq, jk, block)
             keep = None
             if causal:
                 q_pos = iq * block + jax.lax.broadcasted_iota(
@@ -287,7 +327,7 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
 
 
 def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
-              interpret: bool, grad_bias: bool = False):
+              interpret: bool, grad_bias: bool = False, alibi=None):
     B, H, S, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -305,14 +345,22 @@ def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
     row_full = pl.BlockSpec((None, None, SUBLANES, S),
                             lambda b, h, i: (b, h, 0, 0))
     mask_spec = pl.BlockSpec((None, SUBLANES, S), lambda b, h, i: (b, 0, 0))
-    extra_args = ([mask] if masked else []) + ([bias] if biased else [])
+    extra_args = ([mask] if masked else []) + ([bias] if biased else []) \
+        + ([alibi] if alibi is not None else [])
+    extra_row = ([mask_spec] if masked else []) \
+        + ([_bias_row_spec(bias.shape, B, H, block)] if biased else []) \
+        + ([_slopes_spec(H)] if alibi is not None else [])
+    extra_col = ([mask_spec] if masked else []) \
+        + ([_bias_col_spec(bias.shape, B, H, block)] if biased else []) \
+        + ([_slopes_spec(H)] if alibi is not None else [])
+    has_alibi = alibi is not None
 
     dq_outs = pl.pallas_call(
-        _make_bwd_dq_kernel(block, scale, causal, masked, biased, grad_bias),
+        _make_bwd_dq_kernel(block, scale, causal, masked, biased, grad_bias,
+                            has_alibi),
         grid=grid,
         in_specs=[blk_spec, full_spec, full_spec, blk_spec, row_blk, row_blk]
-                 + ([mask_spec] if masked else [])
-                 + ([_bias_row_spec(bias.shape, B, H, block)] if biased else []),
+                 + extra_row,
         out_specs=[blk_spec] + ([_bias_row_spec(bias.shape, B, H, block)]
                                 if grad_bias else []),
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)]
@@ -324,11 +372,10 @@ def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
     dbias = dq_outs[1] if grad_bias else None
 
     dk, dv = pl.pallas_call(
-        _make_bwd_dkv_kernel(block, scale, causal, masked, biased),
+        _make_bwd_dkv_kernel(block, scale, causal, masked, biased, has_alibi),
         grid=grid,
         in_specs=[full_spec, blk_spec, blk_spec, full_spec, row_full, row_full]
-                 + ([mask_spec] if masked else [])
-                 + ([_bias_col_spec(bias.shape, B, H, block)] if biased else []),
+                 + extra_col,
         out_specs=[blk_spec, blk_spec],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
@@ -416,10 +463,37 @@ def _flash_biased_bwd(block, causal, interpret, grad_bias, res, g):
 _flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash_alibi(block, causal, interpret, q, k, v, slopes, mask):
+    o, _ = _fwd_call(q, k, v, mask, None, block=block, causal=causal,
+                     interpret=interpret, alibi=slopes)
+    return o
+
+
+def _flash_alibi_fwd(block, causal, interpret, q, k, v, slopes, mask):
+    o, lse = _fwd_call(q, k, v, mask, None, block=block, causal=causal,
+                       interpret=interpret, alibi=slopes)
+    return o, (q, k, v, o, lse, slopes, mask)
+
+
+def _flash_alibi_bwd(block, causal, interpret, res, g):
+    q, k, v, o, lse, slopes, mask = res
+    dq, dk, dv, _ = _bwd_call(q, k, v, o, lse, g, mask, None, block=block,
+                              causal=causal, interpret=interpret,
+                              alibi=slopes)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    # slopes are deterministic positional constants: zero cotangent
+    return dq, dk, dv, jnp.zeros_like(slopes), dmask
+
+
+_flash_alibi.defvjp(_flash_alibi_fwd, _flash_alibi_bwd)
+
+
 # ------------------------------------------------------------- public API
 def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                     bias: Optional[jnp.ndarray] = None,
                     bias_is_constant: bool = False,
+                    alibi_slopes: Optional[jnp.ndarray] = None,
                     causal: bool = True, block: int = 128,
                     interpret: Optional[bool] = None):
     """Fused causal attention. q: (B, S, H, hd); k/v: (B, S, KV, hd).
@@ -446,13 +520,22 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
       cheaper than the dense path, which adds scores+probs on top; pass
       ``bias_is_constant=True`` to opt out when the bias isn't trained).
 
+    ``alibi_slopes``: (H,) per-head slopes — the ALiBi distance ramp is
+    built IN-kernel from block indices (an (H, S, S) bias operand at 64k
+    seq would be 100+ GB; slopes cost H floats). Mutually exclusive with
+    ``bias``.
+
     The only remaining fallback is S not divisible by the block tile.
     """
     B, S, H, hd = q.shape
+    assert bias is None or alibi_slopes is None, \
+        "pass either bias or alibi_slopes, not both"
     blk = min(block, S)
     if S % blk != 0:
-        from ..models.transformer import causal_attention
+        from ..models.transformer import alibi_bias, causal_attention
 
+        if alibi_slopes is not None:
+            bias = alibi_bias(alibi_slopes, S)
         return causal_attention(q, k, v, mask=mask, causal=causal, bias=bias)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -462,7 +545,11 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
         v = jnp.repeat(v, H // KV, axis=2)
     # (B, S, H, hd) -> (B, H, S, hd)
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    if bias is not None:
+    if alibi_slopes is not None:
+        o = _flash_alibi(blk, causal, interpret, qt, kt, vt,
+                         _slopes_operand(alibi_slopes),
+                         _mask_operand(mask, S) if mask is not None else None)
+    elif bias is not None:
         bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
         if bias.shape[:2] != (B, H):
             if bias_is_constant:
@@ -487,12 +574,15 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
 def make_flash_attention(block: int = 128, interpret: Optional[bool] = None):
     """attention_fn factory for :class:`TransformerLM`."""
 
-    def attn(q, k, v, *, mask=None, bias=None):
+    def attn(q, k, v, *, mask=None, bias=None, alibi_slopes=None):
         # model-path biases are ALiBi distance ramps: positional
         # constants, streamed via index-map broadcast at zero HBM cost
+        # (slopes preferred: the ramp is built in-kernel)
         return flash_attention(q, k, v, mask=mask, bias=bias,
+                               alibi_slopes=alibi_slopes,
                                bias_is_constant=True, block=block,
                                interpret=interpret)
 
-    attn.accepts_bias = True   # ALiBi models may route through this fn
+    attn.accepts_bias = True          # ALiBi models may route through this fn
+    attn.accepts_alibi_slopes = True  # in-kernel ramp: no (H,S,S) operand
     return attn

@@ -74,18 +74,31 @@ def _shard_mapped(mesh: Mesh, axis: str, body: Callable, q, k, v, mask):
 
 
 # ---------------------------------------------------------------- ring attn
-def ring_attention_local(q, k, v, kmask, *, axis_name: str, n_chunks: int):
+def ring_attention_local(q, k, v, kmask, *, axis_name: str, n_chunks: int,
+                         alibi_slopes=None):
     """Per-shard ring attention body (callable under an existing shard_map).
 
     q: (B, S/p, H, hd); k/v: (B, S/p, KV, hd) local sequence chunks (GQA kv
     stays un-repeated on the wire — the ring moves KV heads, not H). kmask:
     (B, S/p) key padding mask chunk or None. Causal.
+
+    ``alibi_slopes``: (H,) — the ALiBi distance bias is rebuilt per ring
+    step from the global (q_pos, k_pos) the ring already tracks, so
+    long-context ALiBi costs H floats instead of an (H, S, S) operand.
     """
     idx = lax.axis_index(axis_name)
     B, Sc, H, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
     qf = q.astype(jnp.float32) * scale
     q_pos = idx * Sc + jnp.arange(Sc)
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        if slopes.shape[0] != H:
+            # heads are sharded over the model axis: take THIS shard's
+            # slice of the full (H_global,) slope vector
+            h0 = lax.axis_index("model") * H
+            slopes = lax.dynamic_slice(slopes, (h0,), (H,))
+        alibi_slopes = slopes
 
     m = jnp.full((B, H, Sc), BIG_NEG, jnp.float32)
     l = jnp.zeros((B, H, Sc), jnp.float32)
@@ -100,6 +113,10 @@ def ring_attention_local(q, k, v, kmask, *, axis_name: str, n_chunks: int):
         k_pos = src * Sc + jnp.arange(Sc)
         kb, vb = _repeat_kv(k, v, H)               # expand GQA locally, post-wire
         scores = jnp.einsum("bshd,bthd->bhst", qf, kb.astype(jnp.float32))
+        if alibi_slopes is not None:
+            rel = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+            scores = scores + (jnp.asarray(alibi_slopes, jnp.float32)
+                               [None, :, None, None] * rel[None, None])
         keep = (q_pos[:, None] >= k_pos[None, :])[None, None]
         if kmask is not None:
             keep = keep & kmask[:, None, None, :].astype(bool)
@@ -129,20 +146,26 @@ def make_ring_attention(mesh: Mesh, axis: str = SEQ_AXIS) -> Callable:
     """
     n = int(mesh.shape.get(axis, 1))
 
-    def attn(q, k, v, *, mask: Optional[jnp.ndarray] = None):
+    def attn(q, k, v, *, mask: Optional[jnp.ndarray] = None,
+             alibi_slopes=None):
         if n == 1:
-            from ..models.transformer import causal_attention
+            from ..models.transformer import alibi_bias, causal_attention
 
-            return causal_attention(q, k, v, mask=mask)
+            bias = (alibi_bias(alibi_slopes, q.shape[1])
+                    if alibi_slopes is not None else None)
+            return causal_attention(q, k, v, mask=mask, bias=bias)
         assert q.shape[1] % n == 0, (
             f"seq len {q.shape[1]} not divisible by ring size {n}")
         tp = int(mesh.shape.get("model", 1))
         if tp > 1 and k.shape[2] % tp != 0:
             k, v = _repeat_kv(k, v, q.shape[2])   # make kv shardable over tp
-        body = partial(ring_attention_local, axis_name=axis, n_chunks=n)
+        # slopes close over the shard_map body as a tiny constant
+        body = partial(ring_attention_local, axis_name=axis, n_chunks=n,
+                       alibi_slopes=alibi_slopes)
         return _shard_mapped(mesh, axis, body, q, k, v, mask)
 
     attn.handles_sharding = True
+    attn.accepts_alibi_slopes = True   # ramp rebuilt from ring positions
     return attn
 
 

@@ -305,3 +305,40 @@ def test_alibi_model_routes_through_flash():
     got = flash_model.apply(params, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_alibi_slopes_in_kernel_match_dense_bias():
+    """The in-kernel ALiBi ramp (slopes operand; no (H, S, S) bias ever
+    materialized) must equal the dense-bias path in fwd AND grads — the
+    long-context ALiBi mechanism."""
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    B, S, H, hd = 2, 64, 4, 16
+    q, k, v = _qkv(B=B, S=S, H=H, hd=hd)
+    slopes = alibi_slopes(H)
+    rel = (jnp.arange(S)[None, :] - jnp.arange(S)[:, None])
+    bias = slopes[:, None, None] * rel[None].astype(jnp.float32)
+
+    def loss(f):
+        return lambda qq, kk, vv: jnp.sum(jnp.square(f(qq, kk, vv)))
+
+    dense = lambda qq, kk, vv: _dense_biased(qq, kk, vv, bias[None])
+    flash = lambda qq, kk, vv: flash_attention(
+        qq, kk, vv, alibi_slopes=slopes, block=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(dense(q, k, v)),
+                               rtol=3e-5, atol=3e-5)
+    want = jax.grad(loss(dense), argnums=(0, 1, 2))(q, k, v)
+    got = jax.jit(jax.grad(loss(flash), argnums=(0, 1, 2)))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name} mismatch")
+    # with a padding mask too
+    mask = jnp.ones((B, S), jnp.float32).at[:, 48:].set(0.0)
+    got_m = flash_attention(q, k, v, mask=mask, alibi_slopes=slopes,
+                            block=16, interpret=True)
+    want_m = _dense_biased(q, k, v, bias[None], mask=mask)
+    np.testing.assert_allclose(np.asarray(got_m[:, :48]),
+                               np.asarray(want_m[:, :48]),
+                               rtol=3e-5, atol=3e-5)
