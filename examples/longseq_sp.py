@@ -41,9 +41,19 @@ else:
 mesh = build_mesh(MeshSpec(data=-1, seq=4))
 dp = mesh.shape["data"]
 
-for name, factory in (("ulysses", make_ulysses_attention),
-                      ("ring", make_ring_attention)):
-    model = build_model(cfg, attention_fn=factory(mesh))
+# Long-context ALiBi (Bloom-style) rides the same ring: the distance
+# bias is rebuilt from the ring's global per-step positions, so no
+# O(S^2) bias tensor ever exists — position generalization at ring-scale
+# context for free.
+alibi_cfg = (tiny_test(n_layer=2, max_seq=128, pos_embedding="alibi")
+             if smoke else gpt2("350m", max_seq=16384,
+                                pos_embedding="alibi"))
+
+for name, model_cfg, factory in (("ulysses", cfg, make_ulysses_attention),
+                                 ("ring", cfg, make_ring_attention),
+                                 ("alibi-ring", alibi_cfg,
+                                  make_ring_attention)):
+    model = build_model(model_cfg, attention_fn=factory(mesh))
     engine = ds.initialize({
         "train_batch_size": micro * dp,
         "train_micro_batch_size_per_gpu": micro,
@@ -53,7 +63,8 @@ for name, factory in (("ulysses", make_ulysses_attention),
     }, model, mesh=mesh)
 
     data = random_token_dataset(engine.train_batch_size * steps, seq_len=seq,
-                                vocab_size=cfg.vocab_size, learnable=smoke)
+                                vocab_size=model_cfg.vocab_size,
+                                learnable=smoke)
     loader = DataLoader(data, local_batch_size=engine.train_batch_size,
                         shuffle=False)
     losses = [float(engine.train_batch(batch)["loss"]) for batch in loader]
