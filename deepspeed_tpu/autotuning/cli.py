@@ -1,0 +1,98 @@
+"""``dstpu_autotune``: config search from the command line.
+
+The reference's autotuner is CLI-first (``deepspeed --autotuning run``,
+``autotuning/autotuner.py:404``): point it at a model + base config, it
+prunes/runs a grid and writes the best config. Same shape here, built on
+the isolated tuner — the feasibility model prunes OOM points before they
+touch the device and every surviving experiment runs in its own child
+interpreter (this process never claims the accelerator).
+
+    dstpu_autotune --model gpt2:125m --config ds_config.json \\
+        --stages 3,2,1 --mesh auto --out best_config.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .autotuner import Autotuner
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="dstpu_autotune",
+        description="measured config search (feasibility-pruned, "
+                    "child-isolated)")
+    p.add_argument("--model", required=True,
+                   help="preset spec: family[:size], e.g. gpt2:125m, "
+                        "llama2:7b, bert:large, tiny_test")
+    p.add_argument("--config", default=None,
+                   help="base ds_config JSON file (default: a minimal "
+                        "adamw config)")
+    p.add_argument("--stages", default="3,2,1,0",
+                   help="comma-separated ZeRO stages to sweep")
+    p.add_argument("--micro-batches", default=None,
+                   help="comma-separated micro-batch candidates "
+                        "(default: powers of two up to the global batch)")
+    p.add_argument("--mesh", default=None, choices=[None, "auto"],
+                   help="'auto' sweeps model/seq mesh splits too")
+    p.add_argument("--remat", action="store_true",
+                   help="sweep remat on/off (default: off only)")
+    p.add_argument("--offload", action="store_true",
+                   help="include offload_optimizer=cpu in the sweep")
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--budget-gb", type=float, default=None,
+                   help="per-device memory budget for the feasibility "
+                        "pruner (default: probed from the device)")
+    p.add_argument("--out", default="autotune_best.json",
+                   help="where the winning config is written")
+    p.add_argument("--results", default="autotune_results.json",
+                   help="full ranked experiment ledger")
+    args = p.parse_args(argv)
+
+    family, _, size = args.model.partition(":")
+    spec = {"family": family}
+    if size:
+        spec["size"] = size
+    base = {"train_batch_size": 32,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}}}
+    if args.config:
+        with open(args.config) as f:
+            base = json.load(f)
+
+    tuner = Autotuner(
+        base, None, None, model_spec=spec,
+        stages=tuple(int(s) for s in args.stages.split(",")),
+        # ascending: the sweep early-stops on the first pruned/failed/
+        # slower candidate, which assumes micro-batches grow
+        micro_batches=(sorted(int(m) for m in args.micro_batches.split(","))
+                       if args.micro_batches else None),
+        remat_options=(False, True) if args.remat else (False,),
+        mesh_options=args.mesh,
+        offload_options=(None, "cpu") if args.offload else (None,),
+        steps=args.steps,
+        hbm_budget_bytes=(int(args.budget_gb * 2**30)
+                          if args.budget_gb else None),
+        results_path=args.results)
+    best = tuner.tune()
+    with open(args.out, "w") as f:
+        json.dump(best, f, indent=2)
+    ok = sum(1 for e in tuner.experiments if e.ok)
+    pruned = sum(1 for e in tuner.experiments
+                 if e.error.startswith("pruned"))
+    print(f"dstpu_autotune: {len(tuner.experiments)} experiments "
+          f"({ok} ran, {pruned} pruned by the memory model) — best config "
+          f"written to {args.out}, ledger to {args.results}", flush=True)
+    if ok == 0:
+        # nothing measured: the written config is just the base config —
+        # a consuming script must be able to tell that from a real tune
+        print("dstpu_autotune: NO experiment succeeded; wrote the "
+              "unmodified base config", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

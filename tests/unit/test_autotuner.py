@@ -165,3 +165,28 @@ def test_estimate_scales_with_stage_and_remat():
     off = estimate_experiment_bytes(
         cfg, Experiment(1, 8, True, offload="cpu"), dp=8)
     assert off["opt_states"] == 0
+
+
+def test_cli_writes_best_config_and_ledger(tmp_path, capsys):
+    """dstpu_autotune end to end: model spec from the command line, a grid
+    with a deliberately-infeasible point, best config + ledger on disk."""
+    from deepspeed_tpu.autotuning.cli import main
+
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}))
+    out = tmp_path / "best.json"
+    ledger = tmp_path / "ledger.json"
+    # unsorted on purpose: the CLI must sort before the ascending sweep
+    rc = main(["--model", "tiny_test", "--config", str(base),
+               "--stages", "1", "--micro-batches", f"{1 << 22},1",
+               "--steps", "1", "--budget-gb", "2",
+               "--out", str(out), "--results", str(ledger)])
+    assert rc == 0
+    best = json.loads(out.read_text())
+    assert best["train_micro_batch_size_per_gpu"] == 1
+    rows = json.loads(ledger.read_text())
+    assert len(rows) == 2
+    assert any(r["error"].startswith("pruned") for r in rows)
+    assert "pruned by the memory model" in capsys.readouterr().out
