@@ -56,9 +56,29 @@ class Experiment:
                 f"{'_off-' + self.offload if self.offload else ''}")
 
 
+#: fp32 optimizer-moment tensors per parameter, by optimizer type. Lion and
+#: momentum-SGD carry one; plain SGD none; Adam-family two. Used by the
+#: feasibility model so a 1B-Lion config is not pruned for Adam-sized state.
+OPTIMIZER_MOMENTS = {
+    "adam": 2, "adamw": 2, "fusedadam": 2, "lamb": 2, "fusedlamb": 2,
+    "onebitadam": 2, "onebitlamb": 2, "zerooneadam": 2, "adagrad": 1,
+    "lion": 1, "fusedlion": 1, "momentum": 1, "sgd": 0,
+}
+
+
+def optimizer_moment_count(config: Optional[dict]) -> int:
+    """Moments/param implied by a ds_config's optimizer block (default 2)."""
+    try:
+        name = str(config["optimizer"]["type"]).lower().replace("_", "")
+    except (TypeError, KeyError):
+        return 2
+    return OPTIMIZER_MOMENTS.get(name, 2)
+
+
 def estimate_experiment_bytes(model_cfg, exp: Experiment, dp: int,
                               compute_bytes: int = 2,
-                              seq: Optional[int] = None) -> dict:
+                              seq: Optional[int] = None,
+                              opt_moments: int = 2) -> dict:
     """Per-device memory estimate for one experiment — the reference
     autotuner's model-info pass (``autotuning/autotuner.py:404`` params +
     optimizer-state arithmetic, ``:663`` activation estimate), rebuilt for
@@ -74,7 +94,8 @@ def estimate_experiment_bytes(model_cfg, exp: Experiment, dp: int,
                       if k in ("model", "pipe")])) or 1
     params = n * compute_bytes // (mp * (dp if exp.zero_stage >= 3 else 1))
     states = (0 if exp.offload else
-              3 * 4 * n // (mp * (dp if exp.zero_stage >= 1 else 1)))
+              (1 + opt_moments) * 4 * n
+              // (mp * (dp if exp.zero_stage >= 1 else 1)))
     grads = 4 * n // (mp * (dp if exp.zero_stage >= 2 else 1))
     S = seq or getattr(model_cfg, "max_seq", 1024)
     d = model_cfg.d_model
@@ -268,8 +289,9 @@ class Autotuner:
         budget = self._budget_bytes()
         if budget is None:
             return False
-        est = estimate_experiment_bytes(self._model_cfg, exp, dp,
-                                        seq=self._probe_seq)
+        est = estimate_experiment_bytes(
+            self._model_cfg, exp, dp, seq=self._probe_seq,
+            opt_moments=optimizer_moment_count(self.base_config))
         exp.est_bytes = int(est["total"])
         if est["total"] <= budget:
             return False

@@ -149,8 +149,21 @@ def get_world_size(axis_name: str | Sequence[str]) -> int:
 
 def get_rank(axis_name: str | None = None):
     """This shard's index along ``axis_name`` (trace-time, inside a
-    shard_map body) — or the host process index when no axis is given
-    (the ``deepspeed.comm.get_rank()`` host-side meaning)."""
+    shard_map body) — or the host PROCESS index when no axis is given.
+
+    .. warning:: the no-axis form is NOT the reference's global per-device
+       rank: ``deepspeed.comm.get_rank()`` counts devices, this counts
+       host processes, and they diverge whenever a host drives more than
+       one chip. Ported rank arithmetic (rank→device maps, per-rank file
+       names) should use :func:`get_process_rank` explicitly for host
+       identity, or an axis-scoped ``get_rank(axis)`` for device identity.
+    """
     if axis_name is None:
         return jax.process_index()
     return lax.axis_index(axis_name)
+
+
+def get_process_rank() -> int:
+    """Host process index (explicit spelling of ``get_rank()``'s no-axis
+    form — see the warning there)."""
+    return jax.process_index()

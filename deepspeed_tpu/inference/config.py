@@ -22,6 +22,8 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
 class InferenceConfig:
     dtype: str = "bfloat16"            # compute dtype for decode
     tensor_parallel: int = 1           # reference tensor_parallel.tp_size
+    expert_parallel: int = 1           # reference moe.ep_size: experts served
+                                       # sharded over the mesh 'expert' axis
     max_out_tokens: int = 256          # reference max_out_tokens
     quantize: bool = False             # weight-only quant (WOQ)
     quant_group_size: int = 128
@@ -58,6 +60,18 @@ class InferenceConfig:
         tp = flat.get("tensor_parallel")
         if isinstance(tp, dict):
             flat["tensor_parallel"] = int(tp.get("tp_size", 1))
+        # accept the reference's {"moe": {"ep_size": N}} nesting — with the
+        # same strictness as top-level keys (a typo'd sub-key must raise,
+        # not silently serve with expert_parallel=1)
+        moe = flat.pop("moe", None)
+        if moe is not None:
+            if not isinstance(moe, dict):
+                raise ValueError("inference config 'moe' must be a dict "
+                                 f"like {{'ep_size': N}}, got {moe!r}")
+            unknown_moe = set(moe) - {"ep_size"}
+            if unknown_moe:
+                raise ValueError(f"unknown moe config keys: {sorted(unknown_moe)}")
+            flat.setdefault("expert_parallel", int(moe.get("ep_size", 1)))
         unknown = set(flat) - known
         if unknown:
             raise ValueError(f"unknown inference config keys: {sorted(unknown)}")

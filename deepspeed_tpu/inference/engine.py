@@ -25,7 +25,8 @@ from ..platform.mesh import MeshSpec, build_mesh
 from ..utils.logging import log_dist
 from .config import InferenceConfig
 from .decode import generate_tokens
-from .quantization import dequantize_params, quantize_params, quantized_bytes
+from .quantization import (dequantize_params, quantize_params,
+                           quantized_bytes, quantized_shardings)
 from .sampling import sample_logits
 
 # Compiled generate programs kept per engine (each pins an executable).
@@ -60,7 +61,18 @@ class InferenceEngine:
             if self.model is model:
                 self.model = copy.copy(model)
             self.model.moe_eval_mode = True
-        self.mesh = mesh or build_mesh(MeshSpec(data=-1, model=cfg.tensor_parallel))
+        num_experts = int(getattr(self.model.cfg, "num_experts", 1) or 1)
+        if cfg.expert_parallel > 1:
+            # reference expert-parallel serving (moe_inference.py:159 builds
+            # the ep group); here the serving mesh carries an 'expert' axis
+            # and the MoE dispatch's sharding constraints do the all-to-all
+            if num_experts % cfg.expert_parallel != 0:
+                raise ValueError(
+                    f"expert_parallel={cfg.expert_parallel} must divide "
+                    f"num_experts={num_experts} (dense models serve with "
+                    "expert_parallel=1)")
+        self.mesh = mesh or build_mesh(MeshSpec(
+            data=-1, expert=cfg.expert_parallel, model=cfg.tensor_parallel))
 
         # Same fp32 exemptions as the training engine's compute cast
         # (runtime/engine.py _cast_compute): leaves the model names — MoE
@@ -75,17 +87,23 @@ class InferenceEngine:
             return p.astype(self.compute_dtype)
 
         cast = jax.tree_util.tree_map_with_path(_cast, params)
+        specs = self.model.param_specs()
         if cfg.quantize:
-            assert cfg.tensor_parallel == 1, \
-                "WOQ + TP: not yet supported together"
-            self.params = jax.jit(partial(quantize_params,
-                                          group_size=cfg.quant_group_size,
-                                          bits=cfg.quant_bits))(cast)
+            # WOQ x TP: quantize straight into the sharded layout — the
+            # shardings for the quantized tree come from the same
+            # param_specs the dense path uses (scales follow their weights;
+            # quantized_shardings docs). eval_shape first so nothing is
+            # ever materialized unsharded.
+            quant = partial(quantize_params, group_size=cfg.quant_group_size,
+                            bits=cfg.quant_bits)
+            q_shapes = jax.eval_shape(quant, cast)
+            shardings = quantized_shardings(specs, q_shapes, self.mesh)
+            with self.mesh:
+                self.params = jax.jit(quant, out_shardings=shardings)(cast)
             log_dist(f"inference: int{cfg.quant_bits} WOQ, "
                      f"{quantized_bytes(self.params)/2**20:.0f}"
-                     " MiB weights", ranks=[0])
+                     f" MiB weights, tp={cfg.tensor_parallel}", ranks=[0])
         else:
-            specs = self.model.param_specs()
             shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s if s is not None else P()),
                 specs, is_leaf=lambda x: x is None or isinstance(x, P))

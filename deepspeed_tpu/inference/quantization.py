@@ -16,6 +16,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 @jax.tree_util.register_pytree_node_class
@@ -121,6 +122,39 @@ def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
         lambda leaf: dequantize(leaf, dtype)
         if isinstance(leaf, QuantizedTensor) else leaf,
         params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def quantized_shardings(specs: Any, qtree: Any, mesh) -> Any:
+    """Map a model's ``param_specs()`` tree onto the quantized pytree.
+
+    The reference composes int8 with mp_size by splitting each quantized
+    shard's scales alongside its weights
+    (``module_inject/replace_module.py:43`` GroupQuantizer over mp ranks);
+    here the same composition is a sharding rule: ``q`` takes the original
+    leaf's PartitionSpec verbatim, and ``scale`` — shaped
+    ``orig[:-1] + (n_groups, 1)`` — takes the same entries with the last
+    dim's entry moved to the groups dim. Group boundaries align with model
+    shards whenever the per-shard last dim is group-divisible (the usual
+    case: d % (tp*group) == 0); when a leaf degraded to one whole-row group
+    the scale is replicated over the trailing dims, which is still correct
+    under GSPMD — just a broadcast at dequant."""
+    def leaf_shardings(spec, q_or_leaf):
+        spec = spec if spec is not None else P()
+        if not isinstance(q_or_leaf, QuantizedTensor):
+            return NamedSharding(mesh, spec)
+        rank = len(q_or_leaf.q.shape)
+        entries = tuple(spec) + (None,) * (rank - len(tuple(spec)))
+        # one whole-tensor group (degraded gs): scale has a single group —
+        # shard entries on a size-1 dim would be invalid, so replicate it
+        n_groups = q_or_leaf.scale.shape[-2]
+        scale_last = entries[-1] if n_groups > 1 else None
+        return QuantizedTensor(
+            q=NamedSharding(mesh, P(*entries)),
+            scale=NamedSharding(mesh, P(*entries[:-1], scale_last, None)),
+            group_size=q_or_leaf.group_size, bits=q_or_leaf.bits)
+
+    return jax.tree.map(leaf_shardings, specs, qtree,
+                        is_leaf=lambda x: x is None or isinstance(x, P))
 
 
 def quantized_bytes(params: Any) -> int:

@@ -571,8 +571,14 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     return o.swapaxes(1, 2)
 
 
-def make_flash_attention(block: int = 128, interpret: Optional[bool] = None):
-    """attention_fn factory for :class:`TransformerLM`."""
+def make_flash_attention(block: int = 128, interpret: Optional[bool] = None,
+                         bias_is_constant: bool = True):
+    """attention_fn factory for :class:`TransformerLM`.
+
+    ``bias_is_constant=True`` (the model-path default) stop-gradients a
+    broadcast-shaped bias — correct for ALiBi ramps, WRONG for a learned
+    bias. Callers training through the bias (e.g. evoformer pair bias)
+    must pass ``bias_is_constant=False`` to get true dbias tiles."""
 
     def attn(q, k, v, *, mask=None, bias=None, alibi_slopes=None):
         # model-path biases are ALiBi distance ramps: positional
@@ -580,9 +586,12 @@ def make_flash_attention(block: int = 128, interpret: Optional[bool] = None):
         # (slopes preferred: the ramp is built in-kernel)
         return flash_attention(q, k, v, mask=mask, bias=bias,
                                alibi_slopes=alibi_slopes,
-                               bias_is_constant=True, block=block,
+                               bias_is_constant=bias_is_constant, block=block,
                                interpret=interpret)
 
-    attn.accepts_bias = True          # ALiBi models may route through this fn
+    # capability flags: constant-bias only under the default factory args —
+    # learned-bias callers must rebuild with bias_is_constant=False
+    attn.accepts_bias = True
+    attn.bias_is_constant = bias_is_constant
     attn.accepts_alibi_slopes = True  # in-kernel ramp: no (H,S,S) operand
     return attn

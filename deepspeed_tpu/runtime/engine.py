@@ -1094,6 +1094,14 @@ class Engine:
         params = jax.tree.map(lambda a: a.astype(jnp.float32),
                               self.state.master_params)
         probe = {k: jnp.asarray(v) for k, v in self._moq_probe_batch.items()}
+        if jax.process_count() > 1:
+            # the captured probe is host-local (one addressable shard per
+            # process, different data on each): agree on process 0's copy
+            # so every host schedules the same bit widths — divergent
+            # comp_active tuples would desync the SPMD programs
+            from jax.experimental import multihost_utils
+
+            probe = multihost_utils.broadcast_one_to_all(probe)
         with self.mesh:
             eig, _ = max_eigenvalue(lambda p: self.model.loss(p, probe),
                                     params, iters=4)
@@ -1110,6 +1118,11 @@ class Engine:
             batch = self._make_global(batch)
         comp_active = tuple(sorted(
             n for n, off in self._comp if self.global_steps >= off))
+        if self._moq is not None and "weight_quantization" in comp_active:
+            # mirror train_batch: compile the program that will actually run
+            # (current scheduled bit-width), so the memory numbers describe
+            # it and the cached executable is reusable
+            comp_active = self._moq.annotate(comp_active)
         warm = (in_warmup(self.onebit, self.global_steps)
                 if self.onebit is not None else False)
         with self.mesh:
@@ -1149,10 +1162,17 @@ class Engine:
             rows = max(1, mesh_dp_world(self.mesh))
 
             def probe_rows(v):
-                a = np.asarray(v)
+                # read only host-local shards: np.asarray on a globalized
+                # array raises on a multi-process mesh (remote shards)
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    a = np.asarray(v.addressable_shards[0].data)
+                else:
+                    a = np.asarray(v)
                 if a.ndim >= 2:
                     a = a.reshape((-1,) + a.shape[2:])
-                return a[:min(rows, len(a))]
+                if len(a) < rows:        # tiny shard: tile up to dp rows
+                    a = np.resize(a, (rows,) + a.shape[1:])
+                return a[:rows]
 
             self._moq_probe_batch = {k: probe_rows(v)
                                      for k, v in batch.items()}

@@ -174,6 +174,35 @@ def test_moe_woq_generation_router_stays_full_precision():
     assert bf.params["layers"]["wq"].dtype == jnp.bfloat16
 
 
+def test_moe_expert_parallel_serving(devices):
+    """Expert-PARALLEL serving (reference ``moe_inference.py:159`` ep
+    groups): the engine's own mesh carries an ``expert`` axis sized by the
+    ``expert_parallel`` config knob, experts shard across it, and greedy
+    decode equals single-group serving."""
+    cfg, model, params = _moe_model_and_params(moe_drop_tokens=False)
+    ids = _prompt(vocab=cfg.vocab_size)
+    want = np.asarray(
+        ds.init_inference(model, params,
+                          {"dtype": "float32"}).generate(ids, 4, greedy=True))
+    ep = ds.init_inference(model, params,
+                           {"dtype": "float32", "expert_parallel": 4})
+    assert ep.mesh.shape["expert"] == 4
+    # the expert bank is genuinely sharded over the expert axis
+    w_in = ep.params["layers"]["w_in"]
+    spec = w_in.sharding.spec
+    assert "expert" in jax.tree.leaves(tuple(spec)), spec
+    got = np.asarray(ep.generate(ids, 4, greedy=True))
+    np.testing.assert_array_equal(got, want)
+
+    # reference accepts the nested {"moe": {"ep_size": N}} spelling
+    nested = ds.init_inference(model, params,
+                               {"dtype": "float32", "moe": {"ep_size": 2}})
+    assert nested.mesh.shape["expert"] == 2
+
+    with pytest.raises(ValueError, match="must divide"):
+        ds.init_inference(model, params, {"expert_parallel": 3})
+
+
 def test_moe_decode_on_expert_mesh(devices):
     """The single-group dispatch's expert-axis constraints compose with an
     expert-sharded mesh: decode on data x expert equals the unmeshed run."""
@@ -235,6 +264,36 @@ def test_tp_generation(devices):
                                            "tensor_parallel": 4})
     got = np.asarray(tp.generate(ids, 5, greedy=True))
     np.testing.assert_array_equal(got, want)
+
+
+def test_woq_tp_matches_tp1(devices):
+    """WOQ x TP (reference GroupQuantizer over mp ranks,
+    ``module_inject/replace_module.py:43``): int8 weights + group scales
+    shard over the model axis; generation equals the tp=1 quantized run."""
+    cfg, model, params = _model_and_params()
+    ids = _prompt()
+    woq1 = ds.init_inference(model, params, {"dtype": "float32",
+                                             "quantize": True,
+                                             "quant_group_size": 16})
+    want = np.asarray(woq1.generate(ids, 5, greedy=True))
+    woq2 = ds.init_inference(model, params, {"dtype": "float32",
+                                             "quantize": True,
+                                             "quant_group_size": 16,
+                                             "tensor_parallel": 2})
+    qt = woq2.params["layers"]["wq"]
+    assert isinstance(qt, QuantizedTensor)
+    assert "model" in jax.tree.leaves(tuple(qt.q.sharding.spec)), \
+        qt.q.sharding.spec
+    got = np.asarray(woq2.generate(ids, 5, greedy=True))
+    np.testing.assert_array_equal(got, want)
+
+    # int4 nibble-packed weights shard the same way
+    woq4 = ds.init_inference(model, params, {"dtype": "float32",
+                                             "quantize": True, "quant_bits": 4,
+                                             "quant_group_size": 16,
+                                             "tensor_parallel": 2})
+    out4 = np.asarray(woq4.generate(ids, 5, greedy=True))
+    assert out4.shape == (2, 5)
 
 
 # ------------------------------------------------------------------ hybrid
