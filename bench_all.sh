@@ -14,6 +14,10 @@ for b in bench.py bench_gpt_large.py bench_bert.py bench_inference.py \
   sleep 20   # let the tunnel grant drain between claimants
 done
 echo "=== probes ==="
+python bench_params_ceiling.py || { echo "[bench_all] params ceiling failed"; fails=$((fails+1)); }
+sleep 20
+python bench_tpu_smokes.py || { echo "[bench_all] tpu smokes failed"; fails=$((fails+1)); }
+sleep 20
 python bench_woq_probe.py || { echo "[bench_all] woq probe failed"; fails=$((fails+1)); }
 sleep 20
 python bench_decompose.py || { echo "[bench_all] decompose failed"; fails=$((fails+1)); }

@@ -66,11 +66,12 @@ def probe_backend(timeout: float = PROBE_TIMEOUT_S, tag: str = "bench"):
     caller must back off long after a timeout rather than immediately
     stacking another claim attempt (round-3 postmortem: a 30s-backoff
     probe loop kept the tunnel wedged for hours by SIGKILLing its own
-    probes every 2.5 minutes)."""
-    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    probes every 2.5 minutes). run_with_tpu_window no longer uses this —
+    its patient probe (never killed) is the safer primitive; this remains
+    for one-shot health checks."""
     try:
-        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True)
+        p = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                           timeout=timeout, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         log(f"backend probe timed out after {timeout}s (tunnel wedged; the "
             "kill re-wedges it — backing off long)", tag)
@@ -83,18 +84,96 @@ def probe_backend(timeout: float = PROBE_TIMEOUT_S, tag: str = "bench"):
     return True
 
 
-def warn_strays(tag: str = "bench") -> None:
-    """The tunnel admits one process; list other pythons that may hold it."""
+def _ps_rows():
+    """[(pid, ppid, etime, args)] from ps, or [] if ps is unavailable."""
     try:
-        out = subprocess.run(["ps", "-eo", "pid,etime,cmd"], capture_output=True,
-                             text=True, timeout=10).stdout
+        out = subprocess.run(["ps", "-eo", "pid,ppid,etime,args"],
+                             capture_output=True, text=True, timeout=10).stdout
     except Exception:
-        return
-    me = str(os.getpid())
-    for line in out.splitlines():
-        if "python" in line and "bench" not in line and me not in line.split()[:1]:
-            if any(k in line for k in ("jax", "pytest", "graft_entry", "deepspeed")):
-                log(f"possible TPU-holding stray: {line.strip()}", tag)
+        return []
+    rows = []
+    for line in out.splitlines()[1:]:
+        parts = line.split(None, 3)
+        if len(parts) == 4:
+            try:
+                rows.append((int(parts[0]), int(parts[1]), parts[2], parts[3]))
+            except ValueError:
+                continue
+    return rows
+
+
+def _find_strays(tag: str = "bench", rows=None):
+    """Python processes outside our own ancestor chain and our own subtree
+    that look like TPU claimants (the tunnel admits one process at a time).
+
+    "Related" = the bare ancestor CHAIN (self → parent → ... → init) plus
+    descendants of SELF only. Expanding descendants from every ancestor
+    would absorb pid 1's whole subtree — i.e. every process on a systemd
+    host — and make stray detection permanently blind (round-5 review)."""
+    if rows is None:
+        rows = _ps_rows()
+    ppid_of = {pid: ppid for pid, ppid, _, _ in rows}
+    related = set()
+    p = os.getpid()                      # ancestor chain only, incl. self
+    while p:
+        related.add(p)
+        p = ppid_of.get(p, 0)
+    own = {os.getpid()}                  # descendants of SELF, to a fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for pid, ppid, _, _ in rows:
+            if ppid in own and pid not in own:
+                own.add(pid)
+                changed = True
+    related |= own
+    strays = []
+    for pid, _, etime, args in rows:
+        if pid in related or "python" not in args or _COOP_MARK in args:
+            continue
+        if any(k in args for k in ("jax", "pytest", "graft_entry",
+                                   "deepspeed", "bench")):
+            strays.append((pid, etime, args.strip()))
+    return strays
+
+
+def warn_strays(tag: str = "bench") -> None:
+    """List other pythons that may hold the single-claimant tunnel."""
+    for pid, etime, args in _find_strays(tag):
+        log(f"possible TPU-holding stray: pid={pid} etime={etime} "
+            f"{args[:160]}", tag)
+
+
+def kill_stray_claimants(tag: str = "bench") -> int:
+    """Wedge recovery (operations playbook): a stray claimant outside our
+    process tree blocks every grant FOREVER, which is strictly worse than
+    the tens-of-minutes wedge its death may cause — so when the window has
+    been refused for a long stretch and a stray exists, kill it (TERM,
+    then KILL after a grace period) and let the server-side grant timeout
+    clear. Returns the number of processes signalled."""
+    import signal
+
+    strays = _find_strays(tag)
+    for pid, etime, args in strays:
+        log(f"wedge recovery: SIGTERM stray claimant pid={pid} "
+            f"(etime={etime}) {args[:120]}", tag)
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    if strays:
+        time.sleep(10)
+        for pid, _, _ in strays:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                continue
+            log(f"wedge recovery: SIGKILL pid={pid} (survived TERM)", tag)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    return len(strays)
 
 
 def run_child(script_path: str, env: dict, timeout: float,
@@ -119,46 +198,164 @@ def run_child(script_path: str, env: dict, timeout: float,
     return None
 
 
+# After this long with zero grants AND a visible stray claimant, the stray
+# is assumed to be holding the tunnel and is killed (kill_stray_claimants).
+_STRAY_KILL_AFTER_S = 480.0
+# The marker comment exempts cooperative probes from stray-claimant
+# killing: a patient probe belonging to ANOTHER bench/waiter is waiting,
+# not holding — it exits seconds after its grant — and TERMing it
+# mid-claim is exactly the re-wedge the patient design exists to avoid.
+_COOP_MARK = "dstpu-cooperative-probe"
+_PROBE_CODE = (f"# {_COOP_MARK}\n"
+               "import jax; d = jax.devices(); print(d[0].platform, len(d))")
+
+
+def _start_probe():
+    """One patient claim attempt in a child interpreter (separable for
+    tests; see run_with_tpu_window for the never-kill discipline).
+
+    Output goes to unlinked temp FILES, not pipes: a wedged tunnel makes
+    jax/grpc spew retry warnings, and a full 64 KiB stderr pipe would
+    deadlock the child in write() — poll() would then read as 'patiently
+    waiting' forever (round-5 review)."""
+    import tempfile
+
+    f_out = tempfile.TemporaryFile(mode="w+")
+    f_err = tempfile.TemporaryFile(mode="w+")
+    p = subprocess.Popen([sys.executable, "-c", _PROBE_CODE],
+                         stdout=f_out, stderr=f_err, text=True)
+    p._out_file, p._err_file = f_out, f_err
+    return p
+
+
+def _read_probe_file(f) -> str:
+    if f is None:
+        return ""
+    try:
+        f.seek(0)
+        return (f.read() or "").strip()
+    except Exception:
+        return ""
+
+
+# Module-level probe state shared by every run_with_tpu_window call in
+# this process (round-5 review): candidate loops call the window function
+# repeatedly, and per-call probes would stack claims on the single-slot
+# tunnel while per-call timers could never reach the stray-kill or
+# long-wait thresholds across small window slices.
+_probe = None                 # the ONE outstanding patient probe
+_probe_started = 0.0
+_zero_grant_since = None      # monotonic start of the current no-grant streak
+_strays_killed = False        # at most one kill sweep per no-grant streak
+
+
+def _reap_probe():
+    """Collect the finished probe (avoid zombies / leaked temp-file fds)."""
+    global _probe
+    p, _probe = _probe, None
+    out = _read_probe_file(getattr(p, "_out_file", None))
+    err = _read_probe_file(getattr(p, "_err_file", None))
+    try:
+        p.wait(timeout=5)
+    except Exception:
+        pass
+    for f in (getattr(p, "_out_file", None), getattr(p, "_err_file", None)):
+        try:
+            f.close()
+        except Exception:
+            pass
+    return out, err
+
+
 def run_with_tpu_window(script_path: str, child_env: dict, *,
                         window_s: float, child_timeout: float,
                         probe_timeout: float = PROBE_TIMEOUT_S,
                         tag: str = "bench", return_status: bool = False):
-    """Probe → backoff → retry across the window; None if it never comes up.
+    """Patient probe → claim → run child, across the window; None if the
+    tunnel never comes up.
+
+    Round-5 rework (wedge recovery, operations playbook): the probe child
+    is NEVER killed — a killed probe mid-claim orphans the grant and
+    re-wedges the tunnel (the round-3/4 failure loop: kill → wedge →
+    timeout → kill). Instead ONE outstanding probe (module-level: shared
+    across calls, so candidate loops don't stack claimants) waits as long
+    as it needs; a wedged tunnel makes it block, and the same blocked
+    probe is then first in line when the wedge clears. While no grant
+    arrives, a stray claimant outside our process tree (the other way a
+    "wedge" happens — something is HOLDING the single slot) is killed
+    once the CUMULATIVE no-grant streak exceeds ``_STRAY_KILL_AFTER_S``
+    (the streak persists across window slices).
+
+    ``child_timeout`` bounds the granted workload child and is NOT capped
+    by the window remainder: hard-killing a live-claim child because the
+    probing budget ran out is exactly the re-wedge this design avoids —
+    the window bounds WAITING, not a granted run.
 
     With ``return_status`` the caller also learns HOW the window failed:
     ``"never-claimed"`` (the TPU was never granted — the workload is
     unjudged, retry it) vs ``"child-failed"`` (the workload ran on a live
     claim and died — a real failure, fall back/demote). Candidate loops
-    need the distinction to avoid demoting a config the hardware never saw."""
+    need the distinction to avoid demoting a config the hardware never saw.
+
+    ``probe_timeout`` is accepted for call-site compatibility but IGNORED:
+    the patient probe is deliberately unbounded (the bound was the kill,
+    the kill was the wedge)."""
+    global _probe, _probe_started, _zero_grant_since, _strays_killed
+    del probe_timeout
     warn_strays(tag)
     deadline = time.monotonic() + window_s
-    attempt = 0
-    backoff = 0.0
     claimed = False
     result = None
+    logged_wait = 0.0
     while time.monotonic() < deadline:
-        if attempt:
-            remaining = deadline - time.monotonic()
-            if remaining < backoff + probe_timeout:
-                log(f"window exhausted ({remaining:.0f}s left)", tag)
-                break
-            log(f"retrying in {backoff:.0f}s (attempt {attempt + 1}, "
-                f"{remaining / 60:.1f} min left in window)", tag)
-            time.sleep(backoff)
-        attempt += 1
-        status = probe_backend(probe_timeout, tag)
-        if status is True:
+        if _probe is None:
+            _probe = _start_probe()
+            _probe_started = time.monotonic()
+        if _zero_grant_since is None:
+            _zero_grant_since = time.monotonic()
+        rc = _probe.poll()
+        if rc is None:
+            waited = time.monotonic() - _probe_started
+            if waited - logged_wait >= 120:
+                logged_wait = waited
+                log(f"probe waiting {waited / 60:.1f} min for a grant "
+                    f"(patient: killing it would re-wedge; "
+                    f"{(deadline - time.monotonic()) / 60:.1f} min left)", tag)
+            if (not _strays_killed
+                    and time.monotonic() - _zero_grant_since
+                    > _STRAY_KILL_AFTER_S):
+                _strays_killed = True
+                if kill_stray_claimants(tag):
+                    log("wedge recovery: strays signalled; waiting for the "
+                        "server-side grant timeout to free the slot", tag)
+            time.sleep(min(20.0, max(1.0, deadline - time.monotonic())))
+            continue
+        out, err = _reap_probe()
+        if rc == 0:
+            log(f"backend probe ok: {out}", tag)
             claimed = True
+            _zero_grant_since = None
+            _strays_killed = False
             result = run_child(script_path, child_env, child_timeout, tag)
             if result is not None:
                 break
-            backoff = 120.0   # child failed after a good claim: brief pause
-        elif status == "timeout":
-            # our kill just re-wedged the grant: stay quiet long enough for
-            # the server-side grant timeout to clear before touching it again
-            backoff = 600.0
+            log("child failed on a live claim; pausing 120s before "
+                "re-probing", tag)
+            time.sleep(min(120.0, max(0.0, deadline - time.monotonic())))
         else:
-            backoff = 60.0    # fast failure (chip busy): cheap to re-ask
+            tail = err.splitlines()[-1:] if err else []
+            log(f"backend probe refused rc={rc}: {tail}", tag)
+            # refusal (UNAVAILABLE / chip busy): re-ask after the playbook's
+            # refusal backoff — short enough to catch a draining tunnel,
+            # long enough not to hammer it
+            time.sleep(min(150.0, max(1.0, deadline - time.monotonic())))
+    if _probe is not None and _probe.poll() is None:
+        # window over with the probe still blocked: LEAVE it running (and
+        # registered) — it exits on its own at the eventual grant/refusal
+        # and the next run_with_tpu_window call picks it up right where
+        # this one left off (never kill: re-wedge)
+        log("window exhausted with probe still waiting; leaving it to "
+            "drain on its own (killing would re-wedge the tunnel)", tag)
     if not return_status:
         return result
     status = ("ok" if result is not None

@@ -22,7 +22,7 @@ import time
 import bench_common as bc
 
 _CHILD_MARK = "_DSTPU_LONGSEQ_CHILD"
-_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 15 * 60))
+_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 25 * 60))
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "LONGSEQ_BENCH.json")
 _CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -47,7 +47,10 @@ def _run_workload():
         seq, blk = (int(x) for x in
                     os.environ.get("DSTPU_LONGSEQ_TRY", "4096:512").split(":"))
         signal.signal(signal.SIGALRM, _alarm)
-        signal.alarm(420)
+        # long-seq compiles are slower; the alarm must fire (clean raise,
+        # cache-preserving fall-through) before the parent's child_timeout
+        # kill (which risks re-wedging the tunnel)
+        signal.alarm(600 if seq >= 16384 else 420)
         try:
             _measure(seq, blk, devices, on_tpu)
         finally:
@@ -68,7 +71,9 @@ def _measure(seq, blk, devices, on_tpu):
     from deepspeed_tpu.utils.timer import peak_flops_for
 
     if on_tpu:
-        micro, n_steps, size = 2, 5, "125m"
+        # 16-32k rows (the Ulysses-story lengths, VERDICT r5 leg): one
+        # sample per step — the attention term dominates tokens/step anyway
+        micro, n_steps, size = (1 if seq >= 16384 else 2), 5, "125m"
         attn = make_flash_attention(block=blk)
     else:
         micro, n_steps, size = 1, 2, "125m"
@@ -124,8 +129,12 @@ def main():
     env[_CHILD_MARK] = "1"
     me = os.path.abspath(__file__)
     env_seq = os.environ.get("DSTPU_LONGSEQ")
+    # best-first: credible long-context lengths (32k/16k) lead, the
+    # round-3-proven 4096 and shorter rows close the chain so a
+    # long-compile failure still records a TPU number
     candidates = ([f"{int(env_seq)}:512"] if env_seq else
-                  ["4096:512", "2048:512", "1024:256"])
+                  ["32768:512", "16384:512", "4096:512", "2048:512",
+                   "1024:256"])
     # One child process per candidate: a native-code compile hang can only
     # be bounded from OUTSIDE the process (see _run_workload docstring).
     # The window budget is split across the remaining candidates.
@@ -142,7 +151,7 @@ def main():
         env["DSTPU_LONGSEQ_TRY"] = cand
         result, status = bc.run_with_tpu_window(
             me, env, window_s=remaining / (len(candidates) - idx),
-            child_timeout=600, tag="longseq-bench", return_status=True)
+            child_timeout=900, tag="longseq-bench", return_status=True)
         if result is not None:
             break
         if status == "child-failed":

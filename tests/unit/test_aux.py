@@ -474,7 +474,8 @@ def test_sparse_gradients_offload_matches_dense():
     # the plan kicked in: 8*16=128 tokens < 1024/2 vocab rows
     assert eng_s._sparse_plan == {"tok_embed": 128}, eng_s._sparse_plan
     gbatch = {k: jnp.asarray(v)[None] for k, v in batch.items()}
-    grads, _ = eng_s._grad_step(eng_s.compute_params, gbatch)
+    grads, _ = eng_s._grad_step(eng_s.compute_params, gbatch,
+                                jnp.float32(1.0))
     sp = grads["tok_embed"]
     assert isinstance(sp, SparseGradRows)
     assert sp.values.shape == (128, 64) and sp.indices.shape == (128,)

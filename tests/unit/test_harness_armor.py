@@ -49,20 +49,34 @@ def test_tpu_window_distinguishes_never_claimed_from_child_failed(monkeypatch):
     hardware never saw: run_with_tpu_window's return_status reports
     'never-claimed' when no probe ever succeeded vs 'child-failed' when
     a live claim ran the workload and it died."""
+    import io
+
     if _ROOT not in sys.path:       # bench_common lives at the repo root
         sys.path.insert(0, _ROOT)
     import bench_common as bc
 
-    # never-claimed: every probe fails fast
-    monkeypatch.setattr(bc, "probe_backend", lambda *a, **k: "failed")
+    class FakeProbe:
+        """Already-exited probe child (the patient probe is Popen-shaped)."""
+
+        def __init__(self, rc):
+            self._rc = rc
+            self._out_file = io.StringIO("cpu 1")
+            self._err_file = io.StringIO("refused")
+
+        def poll(self):
+            return self._rc
+
     monkeypatch.setattr(bc, "warn_strays", lambda *a, **k: None)
+
+    # never-claimed: every probe is refused fast
+    monkeypatch.setattr(bc, "_start_probe", lambda: FakeProbe(1))
     r, status = bc.run_with_tpu_window("/nonexistent.py", {}, window_s=0.2,
                                        child_timeout=1, probe_timeout=0.01,
                                        return_status=True)
     assert r is None and status == "never-claimed"
 
-    # child-failed: probe ok, child produces no JSON
-    monkeypatch.setattr(bc, "probe_backend", lambda *a, **k: True)
+    # child-failed: probe granted, child produces no JSON
+    monkeypatch.setattr(bc, "_start_probe", lambda: FakeProbe(0))
     monkeypatch.setattr(bc, "run_child", lambda *a, **k: None)
     r, status = bc.run_with_tpu_window("/nonexistent.py", {}, window_s=0.2,
                                        child_timeout=1, probe_timeout=0.01,
@@ -74,3 +88,51 @@ def test_tpu_window_distinguishes_never_claimed_from_child_failed(monkeypatch):
     r = bc.run_with_tpu_window("/nonexistent.py", {}, window_s=0.2,
                                child_timeout=1, probe_timeout=0.01)
     assert r == {"metric": "m"}
+
+
+def test_stray_finder_spares_own_tree():
+    """kill_stray_claimants must never target this process or its
+    ancestors/descendants — only true third-party claimants."""
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import bench_common as bc
+
+    # a child of ours that matches the claimant pattern must NOT be listed
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "import time; time.sleep(30)  # jax deepspeed bench marker"],
+    )
+    try:
+        stray_pids = [pid for pid, _, _ in bc._find_strays()]
+        assert child.pid not in stray_pids
+        assert os.getpid() not in stray_pids
+    finally:
+        child.kill()
+        child.wait()
+
+
+def test_stray_finder_detects_third_party_claimant():
+    """Positive case (round-5 review: the spare-own-tree assertion alone is
+    satisfied by a finder that never finds anything): a claimant-looking
+    process OUTSIDE our tree — including one descending from pid 1, the
+    systemd case — must be listed; our own chain must not."""
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import bench_common as bc
+
+    me = os.getpid()
+    rows = [
+        (1, 0, "10-00:00:00", "/sbin/init"),
+        # our ancestor chain: init -> shell -> me, and a child of ours
+        (50, 1, "01:00", "bash -lc pytest"),
+        (me, 50, "01:00", "python -m pytest tests/unit"),
+        (me + 1, me, "00:10", "python -c 'import jax; bench'"),
+        # third-party claimants hanging off init and off another shell
+        (900, 1, "02:00", "python bench.py  # jax claimant"),
+        (60, 1, "05:00", "bash other-session"),
+        (901, 60, "03:00", "python -c 'import jax; jax.devices()'"),
+        # third-party non-claimant python: not listed
+        (902, 60, "03:00", "python -c 'print(1)'"),
+    ]
+    found = {pid for pid, _, _ in bc._find_strays(rows=rows)}
+    assert found == {900, 901}, found

@@ -104,8 +104,8 @@ def test_aio_async_overlap(tmp_path):
 
 
 # ----------------------------------------------------------- engine offload
-def _train_losses(config, steps=4):
-    model = build_model(tiny_test(max_seq=32))
+def _train_losses(config, steps=4, **model_overrides):
+    model = build_model(tiny_test(max_seq=32, **model_overrides))
     engine = ds.initialize(config, model)
     data = random_token_dataset(16, seq_len=32, vocab_size=256, learnable=True)
     batch = DataLoader(data, local_batch_size=8, shuffle=False).collate_fn(data[:8])
@@ -156,6 +156,51 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     l_resume = float(eng2.train_batch(batch)["loss"])
     l_cont = float(eng.train_batch(batch)["loss"])
     np.testing.assert_allclose(l_resume, l_cont, rtol=1e-4)
+
+
+def test_fp16_offload_trains_with_loss_scaling():
+    """fp16 dynamic loss scaling composes with the host optimizer
+    (reference CPU Adam under fp16, stage_1_and_2.py:1096): the grad step
+    unscales before the host update, and loss still decreases."""
+    cfg = _cfg("cpu")
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    eng, batch, losses = _train_losses(cfg, steps=4, dtype=jnp.float16)
+    assert losses[-1] < losses[0], losses
+    m = eng.train_batch(batch)
+    assert m["loss_scale"] == 2.0 ** 8 and m["skipped"] == 0
+
+
+def test_fp16_offload_overflow_skips_and_backs_off():
+    """A non-finite gradient must skip the host step (master params
+    unchanged) and halve the scale once hysteresis is exhausted."""
+    cfg = _cfg("cpu")
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 4,
+                   "hysteresis": 1}
+    eng, batch, _ = _train_losses(cfg, steps=1, dtype=jnp.float16)
+    master_before = jax.tree.map(np.copy, eng.host_opt.master_tree())
+    # poison by overflowing the loss scale itself: a huge scale makes fp16
+    # grads overflow deterministically
+    from deepspeed_tpu.runtime.loss_scaler import LossScaleState
+    eng._offload_ls = LossScaleState(scale=jnp.float32(2.0 ** 40),
+                                     good_steps=jnp.int32(0),
+                                     hysteresis=jnp.int32(1))
+    out = eng.train_batch(batch)
+    assert out["skipped"] == 1, out
+    after = eng.host_opt.master_tree()
+    for a, b in zip(jax.tree.leaves(master_before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(eng._offload_ls.scale) == 2.0 ** 39   # halved
+
+
+def test_fp16_offload_scale_survives_checkpoint(tmp_path):
+    cfg = _cfg("cpu")
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 6}
+    eng, batch, _ = _train_losses(cfg, steps=2)
+    want = float(eng._offload_ls.scale)
+    eng.save_checkpoint(str(tmp_path / "ckpt"))
+    eng2, _, _ = _train_losses(cfg, steps=1)
+    eng2.load_checkpoint(str(tmp_path / "ckpt"))
+    assert float(eng2._offload_ls.scale) == want
 
 
 # ------------------------------------------------- ZeRO-Infinity param offload
