@@ -131,10 +131,41 @@ def _find_strays(tag: str = "bench", rows=None):
     for pid, _, etime, args in rows:
         if pid in related or "python" not in args or _COOP_MARK in args:
             continue
+        # The agent harness ("claude -p ...", incl. its sh/bash wrapper
+        # rows) embeds this whole build brief in argv — including the words
+        # "python"/"pytest"/"bench" — but never imports jax itself. Killing
+        # it would kill the build session, the exact opposite of wedge
+        # recovery (round-5 incident: the harness chain was flagged within
+        # a minute of a clean launch). Match the harness invocation
+        # specifically, NOT any argv containing the substring "claude" —
+        # a stray `python /home/claude/bench.py` must stay killable.
+        first = args.split(None, 1)[0]
+        if first.rsplit("/", 1)[-1] == "claude" or "claude -p" in args:
+            continue
         if any(k in args for k in ("jax", "pytest", "graft_entry",
                                    "deepspeed", "bench")):
+            # A process pinned to the CPU backend cannot hold the tunnel —
+            # the test suite (conftest forces JAX_PLATFORMS=cpu) runs for
+            # ~20 min and must never be collateral of wedge recovery.
+            if _proc_is_cpu_pinned(pid):
+                continue
             strays.append((pid, etime, args.strip()))
     return strays
+
+
+def _proc_is_cpu_pinned(pid: int) -> bool:
+    """True if /proc/<pid>/environ shows a JAX_PLATFORMS without tpu/axon
+    (such a process can never claim the tunnel). Unreadable → False."""
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            env = f.read().split(b"\0")
+    except OSError:
+        return False
+    for kv in env:
+        if kv.startswith(b"JAX_PLATFORMS="):
+            val = kv.split(b"=", 1)[1].lower()
+            return b"axon" not in val and b"tpu" not in val and val != b""
+    return False
 
 
 def warn_strays(tag: str = "bench") -> None:
@@ -270,7 +301,8 @@ def _reap_probe():
 def run_with_tpu_window(script_path: str, child_env: dict, *,
                         window_s: float, child_timeout: float,
                         probe_timeout: float = PROBE_TIMEOUT_S,
-                        tag: str = "bench", return_status: bool = False):
+                        tag: str = "bench", return_status: bool = False,
+                        max_claimed_attempts: int | None = None):
     """Patient probe → claim → run child, across the window; None if the
     tunnel never comes up.
 
@@ -299,12 +331,21 @@ def run_with_tpu_window(script_path: str, child_env: dict, *,
 
     ``probe_timeout`` is accepted for call-site compatibility but IGNORED:
     the patient probe is deliberately unbounded (the bound was the kill,
-    the kill was the wedge)."""
+    the kill was the wedge).
+
+    ``max_claimed_attempts`` bounds how many times the workload child may
+    RUN on a live claim before the call gives up with "child-failed".
+    Candidate walks pass 1: a deterministic failure (compile OOM) must
+    demote to the next candidate, not be retried for the whole window
+    (round-5 incident: the 1B OOM candidate was retried for 25 min while
+    five viable fallbacks waited). None = unbounded (single-workload
+    benches where a child crash is tunnel weather, not a config verdict)."""
     global _probe, _probe_started, _zero_grant_since, _strays_killed
     del probe_timeout
     warn_strays(tag)
     deadline = time.monotonic() + window_s
     claimed = False
+    attempts = 0
     result = None
     logged_wait = 0.0
     while time.monotonic() < deadline:
@@ -338,6 +379,14 @@ def run_with_tpu_window(script_path: str, child_env: dict, *,
             _strays_killed = False
             result = run_child(script_path, child_env, child_timeout, tag)
             if result is not None:
+                break
+            attempts += 1
+            if max_claimed_attempts is not None \
+                    and attempts >= max_claimed_attempts:
+                log(f"child failed on a live claim (attempt {attempts}/"
+                    f"{max_claimed_attempts}); giving this workload up "
+                    "after a 30s settle", tag)
+                time.sleep(30.0)
                 break
             log("child failed on a live claim; pausing 120s before "
                 "re-probing", tag)

@@ -36,25 +36,39 @@ _ROOT = os.path.dirname(os.path.abspath(__file__))
 _OUT = os.path.join(_ROOT, "GPT_LARGE_BENCH.json")
 _CACHE = os.path.join(_ROOT, "GPT_LARGE_BENCH_TPU_CACHE.json")
 
-# (tag, preset kwargs, optimizer, micro, seq, remat, fused, flash)
-# flash=True routes attention through the Pallas kernel: under
-# dots_saveable remat the XLA path saves per-layer (B, H, S, S) probs
-# (round-3 decompose: trunk bwd is 2/3 of the step — that traffic is the
-# prime suspect); the flash custom-VJP recomputes probs in-kernel from
-# (q, k, v, lse) instead. Both variants run so the artifact records the
-# measured delta, flash first on the hypothesis it wins.
+# (tag, preset kwargs, optimizer, micro, seq, remat_policy, fused, flash)
+# remat_policy None = remat off. flash=True routes attention through the
+# Pallas kernel; save_names saves only the tagged layer-boundary residuals
+# (layer_in/attn_out) instead of every dot output. Memory arithmetic on the
+# 15.75 GiB v5e (round-5 measurement: 1B lion mbs8 seq1024 flash under
+# dots_saveable compiles to 18.31 GiB — params 14.1 GiB (lion: fp32
+# master+moment, bf16 compute, fp32 grads = 14 B/param at 1.004 B params)
+# + ~4.2 GiB of saved dots): save_names keeps ~52 MiB/layer at mbs8
+# (~1.6 GiB total) — the only policy that fits 1B on-chip; the mbs4 twin
+# follows in case workspace pushes mbs8 over the line.
 _CANDIDATES = [
-    ("1b_lion_mbs8_flash", dict(size="1.5b", n_layer=30), "lion", 8, 1024, True, None, True),
-    ("1b_lion_mbs8", dict(size="1.5b", n_layer=30), "lion", 8, 1024, True, None, False),
-    ("1b_lion_mbs8_xla", dict(size="1.5b", n_layer=30), "lion", 8, 1024, True, False, False),
-    ("1b_lion_mbs4", dict(size="1.5b", n_layer=30), "lion", 4, 1024, True, None, False),
-    ("774m_adamw_mbs8_flash", dict(size="774m"), "adamw", 8, 1024, True, None, True),
-    ("350m_lion_noremat", dict(size="350m"), "lion", 8, 512, False, None, False),
-    ("350m_adamw_mbs16", dict(size="350m"), "adamw", 16, 512, True, None, False),
+    ("1b_lion_mbs8_flash_savenames", dict(size="1.5b", n_layer=30), "lion", 8, 1024, "save_names", None, True),
+    ("1b_lion_mbs4_flash_savenames", dict(size="1.5b", n_layer=30), "lion", 4, 1024, "save_names", None, True),
+    ("774m_lion_mbs16_flash_savenames", dict(size="774m"), "lion", 16, 1024, "save_names", None, True),
+    ("774m_lion_mbs8_flash", dict(size="774m"), "lion", 8, 1024, "dots_saveable", None, True),
+    ("350m_lion_mbs16_flash", dict(size="350m"), "lion", 16, 512, "dots_saveable", None, True),
+    ("350m_adamw_mbs16", dict(size="350m"), "adamw", 16, 512, "dots_saveable", False, False),
 ]
 
+# A/B twins run AFTER the headline lands, each isolating one lever on the
+# winner's config (VERDICT r5 priorities (a)/(b)): fused-vs-XLA xent,
+# flash-vs-XLA attention (XLA twin under save_names so probs are
+# recomputed, not saved — dots_saveable at 1B is a known OOM), and the
+# remat dimension on the 350M shape where activations fit outright.
+_TWINS = {
+    "xla_xent": dict(fused=False),
+    "xla_attn": dict(flash=False),
+}
+_REMAT_OFF_TWIN = ("350m_lion_noremat", dict(size="350m"), "lion", 8, 512,
+                   None, None, False)
 
-def _run_candidate(tag: str):
+
+def _run_candidate(spec_json: str):
     import jax
     import numpy as np
 
@@ -63,8 +77,8 @@ def _run_candidate(tag: str):
     from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
     from deepspeed_tpu.utils.timer import peak_flops_for
 
-    spec = dict((c[0], c) for c in _CANDIDATES)[tag]
-    _, kw, opt, micro, seq, remat, fused, flash = spec
+    tag, kw, opt, micro, seq, remat_policy, fused, flash = json.loads(spec_json)
+    remat = remat_policy is not None
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
     if not on_tpu:   # CPU smoke: shrink to a tiny graph, keep the plumbing
@@ -89,7 +103,8 @@ def _run_candidate(tag: str):
         "optimizer": {"type": opt, "params": {"lr": 1e-4}},
         "gradient_clipping": 1.0,
         "zero_optimization": {"stage": 1},
-        "remat": {"enabled": remat, "policy": "dots_saveable"},
+        "remat": {"enabled": remat,
+                  "policy": remat_policy or "dots_saveable"},
         "steps_per_print": 10 ** 9,
     }, model)
     data = random_token_dataset(engine.train_batch_size, seq_len=seq,
@@ -147,7 +162,8 @@ def _run_candidate(tag: str):
         "vs_baseline": round(mfu / 0.45, 4),
         "unit": (f"MFU ({n_params_str} params, tokens/s="
                  f"{tokens_per_sec:.0f}, step={dt * 1000:.1f}ms, seq={seq}, "
-                 f"mbs={micro}, opt={opt}, remat={'on' if remat else 'off'}, "
+                 f"mbs={micro}, opt={opt}, "
+                 f"remat={remat_policy if remat else 'off'}, "
                  f"attn={'flash' if flash else 'xla'}, "
                  f"xent={bc.xent_label(fused, on_tpu)}, "
                  f"platform={devices[0].platform}"
@@ -165,6 +181,29 @@ def _run_candidate(tag: str):
     print(json.dumps(result), flush=True)
 
 
+def _twin_spec(spec, key: str):
+    """Derive an A/B twin from a winning spec, isolating one lever."""
+    tag, kw, opt, micro, seq, policy, fused, flash = spec
+    mods = _TWINS[key]
+    if "fused" in mods:
+        fused = mods["fused"]
+        tag = f"{tag}_xlaxent"
+    if "flash" in mods:
+        flash = mods["flash"]
+        tag = tag.replace("_flash", "") + "_xlaattn"
+    return [tag, kw, opt, micro, seq, policy, fused, flash]
+
+
+def _launch(me, spec, deadline, status_too=False):
+    env = dict(os.environ)
+    env[_CHILD_MARK] = json.dumps(spec)
+    window = max(60.0, deadline - time.monotonic())
+    return bc.run_with_tpu_window(me, env, window_s=window,
+                                  child_timeout=1500, tag="gptl-bench",
+                                  return_status=status_too,
+                                  max_claimed_attempts=1)
+
+
 def main():
     if os.environ.get(_CHILD_MARK):
         _run_candidate(os.environ[_CHILD_MARK])
@@ -172,46 +211,41 @@ def main():
     bc.emit_cache_upfront(_CACHE, tag="gptl-bench", out_path=_OUT)
     me = os.path.abspath(__file__)
     deadline = time.monotonic() + _WINDOW_S
-    best = None
-    for tag, *_ in _CANDIDATES:
+    best, best_spec = None, None
+    for spec in _CANDIDATES:
         if time.monotonic() > deadline:
-            bc.log(f"window exhausted before {tag}", "gptl-bench")
+            bc.log(f"window exhausted before {spec[0]}", "gptl-bench")
             break
-        env = dict(os.environ)
-        env[_CHILD_MARK] = tag
-        remaining = max(60.0, deadline - time.monotonic())
-        result, status = bc.run_with_tpu_window(
-            me, env, window_s=remaining, child_timeout=1500,
-            tag="gptl-bench", return_status=True)
+        result, status = _launch(me, list(spec), deadline, status_too=True)
         if status == "never-claimed":
             bc.log("tunnel never granted; stopping the candidate walk",
                    "gptl-bench")
             break
         if result is not None:
-            best = result        # best-first order: first success wins
+            best, best_spec = result, list(spec)   # best-first: first win
             break
     # secondary rows attached to the artifact (not replacing the headline):
-    # the paired attention variant (the flash-vs-xla delta the candidate
-    # list exists to measure), the fused-vs-XLA xent delta (VERDICT r5
-    # priority (b)), and the 350M no-remat remat-dimension row.
-    extras = {"1b_lion_mbs8_flash": [("xla_attn_1b", "1b_lion_mbs8"),
-                                     ("xla_xent_1b", "1b_lion_mbs8_xla")],
-              "1b_lion_mbs8": [("flash_attn_1b", "1b_lion_mbs8_flash"),
-                               ("xla_xent_1b", "1b_lion_mbs8_xla")]}
+    # A/B twins isolating the xent and attention levers on the winner's
+    # exact config (VERDICT r5 priorities (a)/(b)) + the 350M no-remat row
+    # measuring the remat dimension where activations fit outright.
     if best is not None:
-        for key, extra_tag in (extras.get(best.get("candidate"), [])
-                               + [("remat_off_350m", "350m_lion_noremat")]):
-            if key is None or best.get("candidate") == extra_tag \
-                    or time.monotonic() > deadline:
-                continue
-            env = dict(os.environ)
-            env[_CHILD_MARK] = extra_tag
-            extra = bc.run_with_tpu_window(
-                me, env, window_s=max(60.0, deadline - time.monotonic()),
-                child_timeout=1500, tag="gptl-bench")
+        if "platform=tpu" in best.get("unit", ""):
+            bc.save_tpu_cache(_CACHE, best)      # headline first, twins later
+        for key in ("xla_xent", "xla_attn"):
+            if time.monotonic() > deadline:
+                break
+            twin = _twin_spec(best_spec, key)
+            if twin[1:] == list(best_spec)[1:]:
+                continue     # winner already has this lever off: A/A noise
+            extra = _launch(me, twin, deadline)
             if extra is not None:
                 best = dict(best)
                 best[key] = extra
+        if time.monotonic() <= deadline:
+            extra = _launch(me, list(_REMAT_OFF_TWIN), deadline)
+            if extra is not None:
+                best = dict(best)
+                best["remat_off_350m"] = extra
         if "platform=tpu" in best.get("unit", ""):
             bc.save_tpu_cache(_CACHE, best)
     if best is None:
@@ -219,7 +253,7 @@ def main():
     if best is None:
         bc.log("falling back to virtual CPU", "gptl-bench")
         env = dict(os.environ)
-        env[_CHILD_MARK] = _CANDIDATES[0][0]
+        env[_CHILD_MARK] = json.dumps(list(_CANDIDATES[0]))
         best = bc.run_child(me, bc.cpu_fallback_env(env), timeout=1500,
                             tag="gptl-bench")
     if best is None:

@@ -137,7 +137,7 @@ class RematConfig(ConfigModel):
 
     enabled: bool = False
     policy: Literal["none", "full", "dots_saveable", "save_nothing",
-                    "offload_dots"] = "dots_saveable"
+                    "save_names", "offload_dots"] = "dots_saveable"
     offload: bool = False
 
 

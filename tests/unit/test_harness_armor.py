@@ -12,6 +12,7 @@ running a child pytest with the poison applied.
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -121,6 +122,9 @@ def test_stray_finder_detects_third_party_claimant():
     import bench_common as bc
 
     me = os.getpid()
+    # fake pids near pid_max so /proc/<pid>/environ (the cpu-pinned probe)
+    # cannot accidentally hit a real process on the host
+    p0, p1, p2, p3 = 4193900, 4193901, 4193902, 4193903
     rows = [
         (1, 0, "10-00:00:00", "/sbin/init"),
         # our ancestor chain: init -> shell -> me, and a child of ours
@@ -128,11 +132,57 @@ def test_stray_finder_detects_third_party_claimant():
         (me, 50, "01:00", "python -m pytest tests/unit"),
         (me + 1, me, "00:10", "python -c 'import jax; bench'"),
         # third-party claimants hanging off init and off another shell
-        (900, 1, "02:00", "python bench.py  # jax claimant"),
+        (p0, 1, "02:00", "python bench.py  # jax claimant"),
         (60, 1, "05:00", "bash other-session"),
-        (901, 60, "03:00", "python -c 'import jax; jax.devices()'"),
+        (p1, 60, "03:00", "python -c 'import jax; jax.devices()'"),
         # third-party non-claimant python: not listed
-        (902, 60, "03:00", "python -c 'print(1)'"),
+        (p2, 60, "03:00", "python -c 'print(1)'"),
+        # the agent harness: argv embeds the build brief (contains
+        # "python"/"bench"/"jax" words) but it is never a tunnel claimant —
+        # killing it kills the build session (round-5 incident)
+        (p3, 1, "00:44", "claude -p --output-format stream-json ... run "
+                         "python -m pytest tests/ and bench.py with jax"),
+        # NOT exempt: a stray whose argv merely CONTAINS "claude" (path
+        # component) is still a killable claimant
+        (p3 + 1, 1, "01:00", "python /home/claude/bench.py  # jax"),
     ]
     found = {pid for pid, _, _ in bc._find_strays(rows=rows)}
-    assert found == {900, 901}, found
+    assert found == {p0, p1, p3 + 1}, found
+
+
+def test_stray_finder_spares_cpu_pinned_process():
+    """A claimant-looking process whose environ pins JAX_PLATFORMS=cpu can
+    never hold the tunnel (the 20-min CPU test suite) — must not be listed,
+    while the same cmdline with no such pin must be."""
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import bench_common as bc
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"], env=env)
+    try:
+        # /proc/<pid>/environ races the child's execve (reads empty/parent
+        # state mid-exec under load) — poll until the probe stabilizes
+        deadline = time.monotonic() + 10
+        while not bc._proc_is_cpu_pinned(child.pid) \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert bc._proc_is_cpu_pinned(child.pid)
+        # full path: a synthetic row for this real pid, parented off init so
+        # the related-set exemption can't be what spares it
+        rows = [(1, 0, "10-00:00:00", "/sbin/init"),
+                (child.pid, 1, "00:05", "python -m pytest tests/ -x -q")]
+        assert bc._find_strays(rows=rows) == []
+    finally:
+        child.kill()
+        child.wait()
+    # no JAX_PLATFORMS at all -> not provably cpu-pinned
+    env.pop("JAX_PLATFORMS")
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"], env=env)
+    try:
+        assert not bc._proc_is_cpu_pinned(child.pid)
+    finally:
+        child.kill()
+        child.wait()

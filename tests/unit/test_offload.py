@@ -196,11 +196,20 @@ def test_fp16_offload_scale_survives_checkpoint(tmp_path):
     cfg = _cfg("cpu")
     cfg["fp16"] = {"enabled": True, "initial_scale_power": 6}
     eng, batch, _ = _train_losses(cfg, steps=2)
-    want = float(eng._offload_ls.scale)
+    # poison the scale state away from its init value BEFORE saving: with
+    # the default config two finite steps leave scale at exactly 2^6, so a
+    # fresh engine would pass the assert even if restore were deleted
+    from deepspeed_tpu.runtime.loss_scaler import LossScaleState
+    eng._offload_ls = LossScaleState(scale=jnp.float32(2.0 ** 11),
+                                     good_steps=jnp.int32(7),
+                                     hysteresis=jnp.int32(2))
     eng.save_checkpoint(str(tmp_path / "ckpt"))
     eng2, _, _ = _train_losses(cfg, steps=1)
+    assert float(eng2._offload_ls.scale) != 2.0 ** 11
     eng2.load_checkpoint(str(tmp_path / "ckpt"))
-    assert float(eng2._offload_ls.scale) == want
+    assert float(eng2._offload_ls.scale) == 2.0 ** 11
+    assert int(eng2._offload_ls.good_steps) == 7
+    assert int(eng2._offload_ls.hysteresis) == 2
 
 
 # ------------------------------------------------- ZeRO-Infinity param offload
