@@ -36,36 +36,64 @@ _ROOT = os.path.dirname(os.path.abspath(__file__))
 _OUT = os.path.join(_ROOT, "GPT_LARGE_BENCH.json")
 _CACHE = os.path.join(_ROOT, "GPT_LARGE_BENCH_TPU_CACHE.json")
 
-# (tag, preset kwargs, optimizer, micro, seq, remat_policy, fused, flash)
-# remat_policy None = remat off. flash=True routes attention through the
-# Pallas kernel; save_names saves only the tagged layer-boundary residuals
-# (layer_in/attn_out) instead of every dot output. Memory arithmetic on the
-# 15.75 GiB v5e (round-5 measurement: 1B lion mbs8 seq1024 flash under
-# dots_saveable compiles to 18.31 GiB — params 14.1 GiB (lion: fp32
-# master+moment, bf16 compute, fp32 grads = 14 B/param at 1.004 B params)
-# + ~4.2 GiB of saved dots): save_names keeps ~52 MiB/layer at mbs8
-# (~1.6 GiB total) — the only policy that fits 1B on-chip; the mbs4 twin
-# follows in case workspace pushes mbs8 over the line.
+# Candidate spec (JSON-serializable dict). policy None = remat off;
+# flash routes attention through the Pallas kernel; gas = gradient
+# accumulation steps (amortizes the measured 46 ms optimizer tail over
+# gas micro-steps); grad_dtype "bfloat16" halves the grad buffer
+# (data_types.grad_accum_dtype). Memory arithmetic on the 15.75 GiB v5e,
+# all round-5 MEASURED: 1B lion mbs8 seq1024 flash dots_saveable = 18.31
+# GiB (params+state 14.1 = fp32 master+moment, bf16 compute, fp32 grads
+# at 1.004 B params; ~4.2 GiB saved dots); save_names mbs4 = fits
+# (0.3151 MFU, twins: xla attn 0.3299 > flash, xla xent 0.3248 > fused);
+# save_names mbs8 fp32-grads = OOM by a hair. Hence this order:
+# bf16 grads buy mbs8 back (12.1 + 1.6 GiB), gas2 halves the optimizer
+# tail, save_names_mlp skips the w_in recompute where it fits.
 _CANDIDATES = [
-    ("1b_lion_mbs8_flash_savenames", dict(size="1.5b", n_layer=30), "lion", 8, 1024, "save_names", None, True),
-    ("1b_lion_mbs4_flash_savenames", dict(size="1.5b", n_layer=30), "lion", 4, 1024, "save_names", None, True),
-    ("774m_lion_mbs16_flash_savenames", dict(size="774m"), "lion", 16, 1024, "save_names", None, True),
-    ("774m_lion_mbs8_flash", dict(size="774m"), "lion", 8, 1024, "dots_saveable", None, True),
-    ("350m_lion_mbs16_flash", dict(size="350m"), "lion", 16, 512, "dots_saveable", None, True),
-    ("350m_adamw_mbs16", dict(size="350m"), "adamw", 16, 512, "dots_saveable", False, False),
+    dict(tag="1b_lion_mbs8_gas2_xla_bf16g", kw=dict(size="1.5b", n_layer=30),
+         opt="lion", micro=8, seq=1024, policy="save_names", fused=False,
+         flash=False, gas=2, grad_dtype="bfloat16"),
+    dict(tag="1b_lion_mbs4_mlph_xla_bf16g", kw=dict(size="1.5b", n_layer=30),
+         opt="lion", micro=4, seq=1024, policy="save_names_mlp", fused=False,
+         flash=False, gas=2, grad_dtype="bfloat16"),
+    dict(tag="1b_lion_mbs4_gas4_xla", kw=dict(size="1.5b", n_layer=30),
+         opt="lion", micro=4, seq=1024, policy="save_names", fused=False,
+         flash=False, gas=4, grad_dtype=None),
+    dict(tag="1b_lion_mbs4_flash_savenames", kw=dict(size="1.5b", n_layer=30),
+         opt="lion", micro=4, seq=1024, policy="save_names", fused=None,
+         flash=True, gas=1, grad_dtype=None),
+    dict(tag="774m_lion_mbs16_flash_savenames", kw=dict(size="774m"),
+         opt="lion", micro=16, seq=1024, policy="save_names", fused=None,
+         flash=True, gas=1, grad_dtype=None),
+    dict(tag="350m_lion_mbs16_flash", kw=dict(size="350m"), opt="lion",
+         micro=16, seq=512, policy="dots_saveable", fused=None, flash=True,
+         gas=1, grad_dtype=None),
+    dict(tag="350m_adamw_mbs16", kw=dict(size="350m"), opt="adamw",
+         micro=16, seq=512, policy="dots_saveable", fused=False, flash=False,
+         gas=1, grad_dtype=None),
 ]
 
-# A/B twins run AFTER the headline lands, each isolating one lever on the
-# winner's config (VERDICT r5 priorities (a)/(b)): fused-vs-XLA xent,
-# flash-vs-XLA attention (XLA twin under save_names so probs are
-# recomputed, not saved — dots_saveable at 1B is a known OOM), and the
-# remat dimension on the 350M shape where activations fit outright.
-_TWINS = {
-    "xla_xent": dict(fused=False),
-    "xla_attn": dict(flash=False),
-}
-_REMAT_OFF_TWIN = ("350m_lion_noremat", dict(size="350m"), "lion", 8, 512,
-                   None, None, False)
+# A/B twins run AFTER the headline lands, each TOGGLING one lever on the
+# winner's exact config (VERDICT r5 priorities (a)/(b)): fused-vs-XLA
+# xent and flash-vs-XLA attention, whichever direction the winner isn't;
+# plus the remat dimension on the 350M shape where activations fit.
+_REMAT_OFF_TWIN = dict(tag="350m_lion_noremat", kw=dict(size="350m"),
+                       opt="lion", micro=8, seq=512, policy=None, fused=None,
+                       flash=False, gas=1, grad_dtype=None)
+
+
+def _twin_spec(spec, key: str):
+    """Derive an A/B twin from a winning spec by flipping one lever.
+    fused: None (auto → Pallas-fused on TPU) <-> False (XLA loss path)."""
+    s = dict(spec, kw=dict(spec["kw"]))
+    if key == "xent":
+        to_xla = s["fused"] is None or s["fused"] is True
+        s["fused"] = False if to_xla else None
+        s["tag"] += "_xlaxent" if to_xla else "_fusedxent"
+    elif key == "attn":
+        s["flash"] = not s["flash"]
+        s["tag"] = (s["tag"].replace("_flash", "") + "_xlaattn"
+                    if not s["flash"] else s["tag"] + "_flashattn")
+    return s
 
 
 def _run_candidate(spec_json: str):
@@ -77,7 +105,11 @@ def _run_candidate(spec_json: str):
     from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
     from deepspeed_tpu.utils.timer import peak_flops_for
 
-    tag, kw, opt, micro, seq, remat_policy, fused, flash = json.loads(spec_json)
+    spec = json.loads(spec_json)
+    tag, kw, opt, micro, seq = (spec["tag"], spec["kw"], spec["opt"],
+                                spec["micro"], spec["seq"])
+    remat_policy, fused, flash = spec["policy"], spec["fused"], spec["flash"]
+    gas, grad_dtype = spec.get("gas", 1), spec.get("grad_dtype")
     remat = remat_policy is not None
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -98,13 +130,15 @@ def _run_candidate(spec_json: str):
         attn = make_flash_attention()
     model = build_model(model_cfg, attention_fn=attn)
     engine = ds.initialize({
-        "train_batch_size": micro * len(devices),
+        "train_batch_size": micro * gas * len(devices),
         "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": opt, "params": {"lr": 1e-4}},
         "gradient_clipping": 1.0,
         "zero_optimization": {"stage": 1},
         "remat": {"enabled": remat,
                   "policy": remat_policy or "dots_saveable"},
+        "data_types": {"grad_accum_dtype": grad_dtype},
         "steps_per_print": 10 ** 9,
     }, model)
     data = random_token_dataset(engine.train_batch_size, seq_len=seq,
@@ -162,7 +196,8 @@ def _run_candidate(spec_json: str):
         "vs_baseline": round(mfu / 0.45, 4),
         "unit": (f"MFU ({n_params_str} params, tokens/s="
                  f"{tokens_per_sec:.0f}, step={dt * 1000:.1f}ms, seq={seq}, "
-                 f"mbs={micro}, opt={opt}, "
+                 f"mbs={micro}, gas={gas}, opt={opt}, "
+                 f"grads={grad_dtype or 'fp32'}, "
                  f"remat={remat_policy if remat else 'off'}, "
                  f"attn={'flash' if flash else 'xla'}, "
                  f"xent={bc.xent_label(fused, on_tpu)}, "
@@ -179,19 +214,6 @@ def _run_candidate(spec_json: str):
     if on_tpu and n_params >= 1e9 and remat:
         bc.save_tpu_cache(_CACHE, result)
     print(json.dumps(result), flush=True)
-
-
-def _twin_spec(spec, key: str):
-    """Derive an A/B twin from a winning spec, isolating one lever."""
-    tag, kw, opt, micro, seq, policy, fused, flash = spec
-    mods = _TWINS[key]
-    if "fused" in mods:
-        fused = mods["fused"]
-        tag = f"{tag}_xlaxent"
-    if "flash" in mods:
-        flash = mods["flash"]
-        tag = tag.replace("_flash", "") + "_xlaattn"
-    return [tag, kw, opt, micro, seq, policy, fused, flash]
 
 
 def _launch(me, spec, deadline, status_too=False):
@@ -214,35 +236,33 @@ def main():
     best, best_spec = None, None
     for spec in _CANDIDATES:
         if time.monotonic() > deadline:
-            bc.log(f"window exhausted before {spec[0]}", "gptl-bench")
+            bc.log(f"window exhausted before {spec['tag']}", "gptl-bench")
             break
-        result, status = _launch(me, list(spec), deadline, status_too=True)
+        result, status = _launch(me, spec, deadline, status_too=True)
         if status == "never-claimed":
             bc.log("tunnel never granted; stopping the candidate walk",
                    "gptl-bench")
             break
         if result is not None:
-            best, best_spec = result, list(spec)   # best-first: first win
+            best, best_spec = result, spec         # best-first: first win
             break
     # secondary rows attached to the artifact (not replacing the headline):
-    # A/B twins isolating the xent and attention levers on the winner's
+    # A/B twins toggling the xent and attention levers on the winner's
     # exact config (VERDICT r5 priorities (a)/(b)) + the 350M no-remat row
     # measuring the remat dimension where activations fit outright.
     if best is not None:
         if "platform=tpu" in best.get("unit", ""):
             bc.save_tpu_cache(_CACHE, best)      # headline first, twins later
-        for key in ("xla_xent", "xla_attn"):
+        for key in ("xent", "attn"):
             if time.monotonic() > deadline:
                 break
             twin = _twin_spec(best_spec, key)
-            if twin[1:] == list(best_spec)[1:]:
-                continue     # winner already has this lever off: A/A noise
             extra = _launch(me, twin, deadline)
             if extra is not None:
                 best = dict(best)
-                best[key] = extra
+                best[f"{key}_flip"] = extra
         if time.monotonic() <= deadline:
-            extra = _launch(me, list(_REMAT_OFF_TWIN), deadline)
+            extra = _launch(me, dict(_REMAT_OFF_TWIN), deadline)
             if extra is not None:
                 best = dict(best)
                 best["remat_off_350m"] = extra
@@ -253,7 +273,7 @@ def main():
     if best is None:
         bc.log("falling back to virtual CPU", "gptl-bench")
         env = dict(os.environ)
-        env[_CHILD_MARK] = json.dumps(list(_CANDIDATES[0]))
+        env[_CHILD_MARK] = json.dumps(_CANDIDATES[0])
         best = bc.run_child(me, bc.cpu_fallback_env(env), timeout=1500,
                             tag="gptl-bench")
     if best is None:

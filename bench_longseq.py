@@ -151,7 +151,8 @@ def main():
         env["DSTPU_LONGSEQ_TRY"] = cand
         result, status = bc.run_with_tpu_window(
             me, env, window_s=remaining / (len(candidates) - idx),
-            child_timeout=900, tag="longseq-bench", return_status=True)
+            child_timeout=900, tag="longseq-bench", return_status=True,
+            max_claimed_attempts=1)
         if result is not None:
             break
         if status == "child-failed":

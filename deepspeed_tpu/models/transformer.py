@@ -570,8 +570,16 @@ class TransformerLM:
         cfg = self.cfg
         u = self._maybe_bias(y @ p["w_in"].astype(y.dtype), p, "b_in")
         if cfg.is_glu:
+            # GLU: tag the gated product — bwd still recomputes the gate
+            # matmul for the silu grad, but w_out's input is saved
             u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
+            u = checkpoint_name(u, "mlp_h")
         else:
+            # Tag the PRE-activation: under save_names_mlp the bwd then
+            # recomputes only the elementwise nonlinearity (for both the
+            # activation grad and w_out's input) — the w_in matmul, the
+            # largest single dot in the layer, is never recomputed
+            u = checkpoint_name(u, "mlp_h")
             u = _activation(u, cfg.activation)
         u = constrain(u, P(B_AXES, "seq", "model"))
         out = self._maybe_bias(u @ p["w_out"].astype(y.dtype), p, "b_out")

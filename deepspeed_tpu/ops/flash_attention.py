@@ -54,31 +54,20 @@ def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool,
     h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
     q = q_ref[...].astype(jnp.float32) * scale          # (blk, hd)
     nkb = k_ref.shape[0] // block
-    q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
 
     def body(jk, carry):
         m, l, acc = carry
         k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
         v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        if bias_ref is not None:
-            # additive score bias tile (blk, blk), streamed from the
-            # (blk, S) row slice this q-block owns — never a full (S, S)
-            # materialization (the whole point vs the dense path)
-            s = s + bias_ref[:, pl.ds(jk * block, block)].astype(jnp.float32)
-        if slopes_ref is not None:
-            s = s + h_slope * _alibi_rel(iq, jk, block)
-        keep = None
-        if causal:
-            kpos = jk * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            keep = q_pos >= kpos
-        if mask_ref is not None:
-            # key-padding mask row for this k block: (blk,) of {0., 1.}
-            mk = mask_ref[0, pl.ds(jk * block, block)] > 0.5
-            keep = mk[None, :] if keep is None else (keep & mk[None, :])
-        if keep is not None:
-            s = jnp.where(keep, s, BIG_NEG)
+        # additive score bias tile (blk, blk), streamed from the (blk, S)
+        # row slice this q-block owns — never a full (S, S)
+        # materialization; key-padding mask row for this k block
+        bias_tile = (bias_ref[:, pl.ds(jk * block, block)]
+                     if bias_ref is not None else None)
+        mk = (mask_ref[0, pl.ds(jk * block, block)] > 0.5
+              if mask_ref is not None else None)
+        s, keep = _masked_scores(q, k, iq, jk, block, causal, mk, h_slope,
+                                 bias_tile)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         if keep is not None:
@@ -119,6 +108,39 @@ def _alibi_rel(iq, jk, block):
     return (k_pos - q_pos).astype(jnp.float32)
 
 
+def _masked_scores(q, k, iq, jk, block, causal, mk, h_slope, bias_tile=None):
+    """Shared (blk, blk) score tile for ALL six kernels (baseline and
+    streamed, fwd and bwd): s = q·kᵀ (+bias tile) (+ALiBi ramp), with
+    causal / key-padding positions forced to BIG_NEG BEFORE any exp (for
+    all-masked rows lse ~ BIG_NEG and a raw exp(s − lse) would overflow
+    to inf — the round-4 fix, now in exactly one place). Returns
+    (s, keep) where keep is None when nothing is masked."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    if bias_tile is not None:
+        s = s + bias_tile.astype(jnp.float32)
+    if h_slope is not None:
+        s = s + h_slope * _alibi_rel(iq, jk, block)
+    keep = None
+    if causal:
+        q_pos = iq * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0)
+        k_pos = jk * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1)
+        keep = q_pos >= k_pos
+    if mk is not None:
+        keep = mk[None, :] if keep is None else (keep & mk[None, :])
+    if keep is not None:
+        s = jnp.where(keep, s, BIG_NEG)
+    return s, keep
+
+
+def _probs_from_lse(s, keep, lse):
+    """Backward-pass probabilities recomputed from the saved logsumexp,
+    masked positions zeroed — shared by all four backward kernels."""
+    p = jnp.exp(s - lse[:, None])
+    return jnp.where(keep, p, 0.0) if keep is not None else p
+
+
 def _slopes_operand(slopes):
     """(H,) → (1, H) fp32 operand; each grid program receives ITS head's
     slope as a (1, 1) block via a static index map — no dynamic lane
@@ -151,6 +173,9 @@ def _bias_col_spec(bias_shape, B, H, block):
 def _fwd_call(q, k, v, mask, bias, *, block: int, causal: bool,
               interpret: bool, alibi=None):
     B, H, S, hd = q.shape
+    if bias is None and _use_streamed(S, hd, q.dtype.itemsize, False):
+        return _fwd_call_streamed(q, k, v, mask, block=block, causal=causal,
+                                  interpret=interpret, alibi=alibi)
     scale = 1.0 / math.sqrt(hd)
     grid = (B, H, S // block)
     masked, biased = mask is not None, bias is not None
@@ -218,33 +243,17 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
         lse = lse_ref[0]
         delta = delta_ref[0]
         nkb = k_ref.shape[0] // block
-        q_pos = iq * block + jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 0)
 
         def body(jk, dq):
             k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
             v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-            if bias_ref is not None:
-                s = s + bias_ref[:, pl.ds(jk * block, block)].astype(
-                    jnp.float32)
-            if slopes_ref is not None:
-                s = s + h_slope * _alibi_rel(iq, jk, block)
-            keep = None
-            if causal:
-                kpos = jk * block + jax.lax.broadcasted_iota(
-                    jnp.int32, (block, block), 1)
-                keep = q_pos >= kpos
-            if mask_ref is not None:
-                mk = mask_ref[0, pl.ds(jk * block, block)] > 0.5
-                keep = mk[None, :] if keep is None else (keep & mk[None, :])
-            # mask BEFORE exp: for all-masked rows lse ~ BIG_NEG and a raw
-            # exp(s - lse) would overflow to inf
-            if keep is not None:
-                s = jnp.where(keep, s, BIG_NEG)
-            p = jnp.exp(s - lse[:, None])
-            if keep is not None:
-                p = jnp.where(keep, p, 0.0)
+            bias_tile = (bias_ref[:, pl.ds(jk * block, block)]
+                         if bias_ref is not None else None)
+            mk = (mask_ref[0, pl.ds(jk * block, block)] > 0.5
+                  if mask_ref is not None else None)
+            s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
+                                     h_slope, bias_tile)
+            p = _probs_from_lse(s, keep, lse)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
             if dbias_ref is not None:
@@ -280,8 +289,6 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
         k = k_ref[...].astype(jnp.float32)               # (blk, hd)
         v = v_ref[...].astype(jnp.float32)
         nqb = q_ref.shape[0] // block
-        k_pos = jk * block + jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 1)
         mk = None
         if mask_ref is not None:
             mk = mask_ref[0, pl.ds(jk * block, block)] > 0.5  # this k block
@@ -292,25 +299,12 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
             do = do_ref[pl.ds(iq * block, block), :].astype(jnp.float32)
             lse = lse_ref[0, pl.ds(iq * block, block)]
             delta = delta_ref[0, pl.ds(iq * block, block)]
-            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-            if bias_ref is not None:
-                # (S, blk) column slice of the bias: rows iq-block
-                s = s + bias_ref[pl.ds(iq * block, block), :].astype(
-                    jnp.float32)
-            if slopes_ref is not None:
-                s = s + h_slope * _alibi_rel(iq, jk, block)
-            keep = None
-            if causal:
-                q_pos = iq * block + jax.lax.broadcasted_iota(
-                    jnp.int32, (block, block), 0)
-                keep = q_pos >= k_pos
-            if mk is not None:
-                keep = mk[None, :] if keep is None else (keep & mk[None, :])
-            if keep is not None:
-                s = jnp.where(keep, s, BIG_NEG)
-            p = jnp.exp(s - lse[:, None])
-            if keep is not None:
-                p = jnp.where(keep, p, 0.0)
+            # (S, blk) column slice of the bias: rows iq-block
+            bias_tile = (bias_ref[pl.ds(iq * block, block), :]
+                         if bias_ref is not None else None)
+            s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
+                                     h_slope, bias_tile)
+            p = _probs_from_lse(s, keep, lse)
             dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
@@ -329,6 +323,10 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
 def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
               interpret: bool, grad_bias: bool = False, alibi=None):
     B, H, S, hd = q.shape
+    if bias is None and _use_streamed(S, hd, q.dtype.itemsize, False):
+        return _bwd_call_streamed(q, k, v, o, lse, do, mask, block=block,
+                                  causal=causal, interpret=interpret,
+                                  alibi=alibi)
     scale = 1.0 / math.sqrt(hd)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, :, None, :], (B, H, SUBLANES, S))
@@ -382,6 +380,294 @@ def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
         interpret=interpret,
     )(q, k, v, do, lse, delta, *extra_args)
     return dq, dk, dv, dbias
+
+
+# ----------------------------------------------- streamed (long-seq) kernels
+# The baseline kernels above stage the ENTIRE (S, hd) K/V (fwd, dq) or
+# Q/dO (dkv) operand in VMEM and fori_loop over it — simple and fast up
+# to ~8k tokens, but the staged operand grows linearly with S and blows
+# the ~16 MiB scoped-VMEM budget near 16-32k (round-5 measurement: the
+# 32k fwd wants a 32.5 MiB stack allocation). Past _STREAM_VMEM_BYTES
+# the calls switch to a 4D grid (B, H, nq, nk) that streams the inner
+# operand block-by-block through the grid's innermost dimension, carrying
+# the online-softmax state (fwd: m/l/acc; bwd: grad accumulators) in VMEM
+# scratch across inner steps — constant VMEM in S, the canonical TPU
+# flash-attention shape. Causal skipping is a pl.when guard (idle DMA for
+# the never-visible triangle, no compute). Bias operands stay on the
+# baseline path: learned-bias callers (evoformer pair stacks) are
+# short-sequence by construction.
+_STREAM_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def _use_streamed(S, hd, itemsize, biased: bool) -> bool:
+    # 2 operands (k+v or q+do) x double buffering
+    return not biased and 2 * S * hd * itemsize * 2 > _STREAM_VMEM_BYTES
+
+
+def _vmem_scratch(block, hd):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [pltpu.VMEM((block, 128), jnp.float32),     # m (lane-replicated)
+            pltpu.VMEM((block, 128), jnp.float32),     # l
+            pltpu.VMEM((block, hd), jnp.float32)]      # acc
+
+
+def _fwd_kernel_streamed(*refs, block: int, scale: float, causal: bool,
+                         masked: bool, alibi: bool, nk: int):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    i = 3
+    mask_ref = slopes_ref = None
+    if masked:
+        mask_ref = refs[i]; i += 1
+    if alibi:
+        slopes_ref = refs[i]; i += 1
+    o_ref, lse_ref = refs[i:i + 2]
+    m_scr, l_scr, acc_scr = refs[i + 2:]
+    iq, jk = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, BIG_NEG, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        mk = mask_ref[0, :] > 0.5 if mask_ref is not None else None
+        h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
+        s, keep = _masked_scores(q, k, iq, jk, block, causal, mk, h_slope)
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l, l_scr.shape)
+
+    if causal:
+        pl.when(jk <= iq)(_step)
+    else:
+        _step()
+
+    @pl.when(jk == (iq if causal else nk - 1))
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.maximum(l, jnp.float32(1e-30))
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        m_col = m_scr[:, 0]
+        lse_ref[...] = jnp.broadcast_to(
+            (m_col + jnp.log(l_safe[:, 0]))[None, :], (SUBLANES, block))
+
+
+def _fwd_call_streamed(q, k, v, mask, *, block: int, causal: bool,
+                       interpret: bool, alibi=None):
+    B, H, S, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    nq = nk = S // block
+    masked = mask is not None
+    kernel = partial(_fwd_kernel_streamed, block=block, scale=scale,
+                     causal=causal, masked=masked, alibi=alibi is not None,
+                     nk=nk)
+    in_specs = [
+        pl.BlockSpec((None, None, block, hd), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((None, None, block, hd), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((None, None, block, hd), lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    args = [q, k, v]
+    if masked:
+        in_specs.append(pl.BlockSpec((None, SUBLANES, block),
+                                     lambda b, h, i, j: (b, 0, j)))
+        args.append(mask)
+    if alibi is not None:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, h, i, j: (0, h)))
+        args.append(alibi)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((None, None, block, hd),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((None, None, SUBLANES, block),
+                         lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, SUBLANES, S), jnp.float32),
+        ],
+        scratch_shapes=_vmem_scratch(block, hd),
+        interpret=interpret,
+    )(*args)
+
+
+def _make_bwd_dq_kernel_streamed(block: int, scale: float, causal: bool,
+                                 masked: bool, alibi: bool, nk: int):
+    def kernel(*refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        i = 6
+        mask_ref = slopes_ref = None
+        if masked:
+            mask_ref = refs[i]; i += 1
+        if alibi:
+            slopes_ref = refs[i]; i += 1
+        dq_ref = refs[i]
+        dq_scr = refs[i + 1]
+        iq, jk = pl.program_id(2), pl.program_id(3)
+
+        @pl.when(jk == 0)
+        def _init():
+            dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+        def _step():
+            q = q_ref[...].astype(jnp.float32) * scale
+            do = do_ref[...].astype(jnp.float32)
+            lse = lse_ref[0]
+            delta = delta_ref[0]
+            k = k_ref[...].astype(jnp.float32)
+            v = v_ref[...].astype(jnp.float32)
+            mk = mask_ref[0, :] > 0.5 if mask_ref is not None else None
+            h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
+            s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
+                                     h_slope)
+            p = _probs_from_lse(s, keep, lse)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None])
+            dq_scr[...] = dq_scr[...] + jnp.dot(
+                ds, k, preferred_element_type=jnp.float32)
+
+        if causal:
+            pl.when(jk <= iq)(_step)
+        else:
+            _step()
+
+        @pl.when(jk == (iq if causal else nk - 1))
+        def _finalize():
+            dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_bwd_dkv_kernel_streamed(block: int, scale: float, causal: bool,
+                                  masked: bool, alibi: bool, nq: int):
+    def kernel(*refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        i = 6
+        mask_ref = slopes_ref = None
+        if masked:
+            mask_ref = refs[i]; i += 1
+        if alibi:
+            slopes_ref = refs[i]; i += 1
+        dk_ref, dv_ref = refs[i:i + 2]
+        dk_scr, dv_scr = refs[i + 2:]
+        jk, iq = pl.program_id(2), pl.program_id(3)   # iq innermost
+
+        @pl.when(iq == 0)
+        def _init():
+            dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+            dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+        def _step():
+            k = k_ref[...].astype(jnp.float32)
+            v = v_ref[...].astype(jnp.float32)
+            q = q_ref[...].astype(jnp.float32) * scale
+            do = do_ref[...].astype(jnp.float32)
+            lse = lse_ref[0]
+            delta = delta_ref[0]
+            mk = mask_ref[0, :] > 0.5 if mask_ref is not None else None
+            h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
+            s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
+                                     h_slope)
+            p = _probs_from_lse(s, keep, lse)
+            dv_scr[...] = dv_scr[...] + jnp.dot(
+                p.T, do, preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None])
+            dk_scr[...] = dk_scr[...] + jnp.dot(
+                ds.T, q, preferred_element_type=jnp.float32)
+
+        if causal:
+            pl.when(iq >= jk)(_step)
+        else:
+            _step()
+
+        @pl.when(iq == nq - 1)
+        def _finalize():
+            dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+            dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _bwd_call_streamed(q, k, v, o, lse, do, mask, *, block: int, causal: bool,
+                       interpret: bool, alibi=None):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None, :], (B, H, SUBLANES, S))
+    nq = nk = S // block
+    masked = mask is not None
+    q_blk = pl.BlockSpec((None, None, block, hd),
+                         lambda b, h, i, j: (b, h, i, 0))
+    kv_blk = pl.BlockSpec((None, None, block, hd),
+                          lambda b, h, i, j: (b, h, j, 0))
+    row_q = pl.BlockSpec((None, None, SUBLANES, block),
+                         lambda b, h, i, j: (b, h, 0, i))
+    mask_kv = pl.BlockSpec((None, SUBLANES, block),
+                           lambda b, h, i, j: (b, 0, j))
+    slope_spec = pl.BlockSpec((1, 1), lambda b, h, i, j: (0, h))
+    extra_args = ([mask] if masked else []) \
+        + ([alibi] if alibi is not None else [])
+    extra_dq = ([mask_kv] if masked else []) \
+        + ([slope_spec] if alibi is not None else [])
+
+    dq = pl.pallas_call(
+        _make_bwd_dq_kernel_streamed(block, scale, causal, masked,
+                                     alibi is not None, nk),
+        grid=(B, H, nq, nk),
+        in_specs=[q_blk, kv_blk, kv_blk, q_blk, row_q, row_q] + extra_dq,
+        out_specs=[q_blk],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, *extra_args)[0]
+
+    # dkv grid: iq runs innermost so dk/dv accumulate across q blocks
+    q_blk2 = pl.BlockSpec((None, None, block, hd),
+                          lambda b, h, j, i: (b, h, i, 0))
+    kv_blk2 = pl.BlockSpec((None, None, block, hd),
+                           lambda b, h, j, i: (b, h, j, 0))
+    row_q2 = pl.BlockSpec((None, None, SUBLANES, block),
+                          lambda b, h, j, i: (b, h, 0, i))
+    mask_kv2 = pl.BlockSpec((None, SUBLANES, block),
+                            lambda b, h, j, i: (b, 0, j))
+    slope2 = pl.BlockSpec((1, 1), lambda b, h, j, i: (0, h))
+    extra_dkv = ([mask_kv2] if masked else []) \
+        + ([slope2] if alibi is not None else [])
+    dk, dv = pl.pallas_call(
+        _make_bwd_dkv_kernel_streamed(block, scale, causal, masked,
+                                      alibi is not None, nq),
+        grid=(B, H, nk, nq),
+        in_specs=[q_blk2, kv_blk2, kv_blk2, q_blk2, row_q2, row_q2]
+                 + extra_dkv,
+        out_specs=[kv_blk2, kv_blk2],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)] * 2,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, *extra_args)
+    return dq, dk, dv, None
 
 
 # ------------------------------------------------------------- custom VJP

@@ -82,6 +82,12 @@ def _remat_policy(cfg: Config):
         # keeps every projection/MLP dot output) — the policy that lets a
         # 1B-param decoder train on one 16 GiB chip without host offload.
         "save_names": cp.save_only_these_names(*OFFLOAD_ACTIVATION_NAMES),
+        # save_names + the pre-activation MLP intermediate (~3x the saved
+        # bytes of save_names, still ~40% of dots_saveable): trades ~1 GiB
+        # of HBM at 1B/mbs4 for skipping the w_in matmul recompute in the
+        # backward — the largest single dot in the layer.
+        "save_names_mlp": cp.save_only_these_names(
+            *OFFLOAD_ACTIVATION_NAMES, "mlp_h"),
     }
     if name == "offload_dots":
         return cp.save_and_offload_only_these_names(
@@ -857,12 +863,13 @@ class Engine:
                                               self.compute_specs)
         return jax.lax.with_sharding_constraint(cp, self.compute_specs)
 
-    def _gas_scan(self, compute_params, batch, scale, vary_axes=()):
+    def _gas_scan(self, compute_params, batch, scale):
         """Gradient-accumulation scan: (params, (gas, B, ...) batch) →
         (summed grads, mean loss). Runs either directly under jit (GSPMD
         inserts the cross-data grad reduction) or inside the manual-data
-        shard_map of the compressed path (no data reduction inserted;
-        ``vary_axes`` marks the carry as device-varying over those axes)."""
+        shard_map of the compressed path (no data reduction inserted; the
+        carry is seeded from the device-varying batch, so it needs no
+        explicit pcast-to-varying)."""
         cfg = self.config
         gas = int(cfg.gradient_accumulation_steps)
 
@@ -871,7 +878,9 @@ class Engine:
             return loss * scale / gas
 
         grad_fn = jax.value_and_grad(loss_fn, argnums=0)
-        acc_dtype = jnp.dtype(cfg.data_types.grad_accum_dtype or "float32")
+        acc_name = cfg.data_types.grad_accum_dtype or "float32"
+        acc_dtype = jnp.dtype({"fp32": "float32", "bf16": "bfloat16",
+                               "fp16": "float16"}.get(acc_name, acc_name))
 
         def gas_body(carry, mb):
             g_acc, loss_acc = carry
@@ -879,18 +888,20 @@ class Engine:
             g_acc = jax.tree.map(lambda a, gg: a + gg.astype(acc_dtype), g_acc, g)
             return (g_acc, loss_acc + scaled_loss / scale), None
 
-        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype),
-                                  compute_params)
-        carry = (zero_grads, jnp.float32(0.0))
-        if vary_axes:
-            # mark the carry device-varying over the manual axes (pvary is
-            # deprecated in favor of pcast; keep a fallback for older jax)
-            if hasattr(lax, "pcast"):
-                carry = jax.tree.map(
-                    lambda t: lax.pcast(t, vary_axes, to="varying"), carry)
-            else:  # pragma: no cover - older jax
-                carry = jax.tree.map(lambda t: lax.pvary(t, vary_axes), carry)
-        (grads, loss), _ = lax.scan(gas_body, carry, batch)
+        # Seed the accumulator from the FIRST micro-batch instead of zeros:
+        # XLA materializes a zeros-initialized carry as a live grad-sized
+        # buffer alongside each micro's grads (round-5 OOM dump: 1.17 GiB
+        # of broadcast(0) for the two MLP grad leaves alone at 1B params),
+        # while seeding aliases the first grads straight into the carry.
+        # gas == 1 skips the scan machinery entirely.
+        first = jax.tree.map(lambda t: t[0], batch)
+        scaled_loss0, g0 = grad_fn(compute_params, first)
+        grads0 = jax.tree.map(lambda g: g.astype(acc_dtype), g0)
+        carry = (grads0, scaled_loss0 / scale)
+        if gas == 1:
+            return carry
+        rest = jax.tree.map(lambda t: t[1:], batch)
+        (grads, loss), _ = lax.scan(gas_body, carry, rest)
         return grads, loss
 
     def _compressed_grads(self, compute_params, batch, scale, comm_err):
@@ -905,7 +916,7 @@ class Engine:
         mode = self.grad_comp
 
         def body(cp, b, ce):
-            grads, loss = self._gas_scan(cp, b, scale, vary_axes=("data",))
+            grads, loss = self._gas_scan(cp, b, scale)
             flat, unflatten = flatten_tree(grads)
             # Unscale BEFORE compressing so the error-feedback residuals are
             # stored in true gradient units — otherwise a dynamic loss-scale

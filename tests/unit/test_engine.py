@@ -79,6 +79,35 @@ def test_gas_matches_large_batch(devices):
     np.testing.assert_allclose(l1, l2, rtol=2e-2)
 
 
+def test_bf16_grad_accum_matches_fp32(devices):
+    """data_types.grad_accum_dtype=bfloat16 (reference config-json.md)
+    halves the grad buffer; trajectory must track the fp32 accumulator
+    within bf16 rounding, across a real GAS scan."""
+    l_fp32 = run_steps(ds.initialize(make_config(stage=1),
+                                     build_model(tiny_test())), n_steps=4)
+    l_bf16 = run_steps(ds.initialize(
+        make_config(stage=1, data_types={"grad_accum_dtype": "bfloat16"}),
+        build_model(tiny_test())), n_steps=4)
+    np.testing.assert_allclose(l_bf16, l_fp32, rtol=3e-2)
+    # alias spelling accepted
+    eng = ds.initialize(make_config(
+        stage=1, data_types={"grad_accum_dtype": "bf16"}),
+        build_model(tiny_test()))
+    assert np.isfinite(run_steps(eng, n_steps=1)[0])
+
+
+@pytest.mark.parametrize("policy", ["save_names", "save_names_mlp"])
+def test_save_names_remat_policies_match_dense(devices, policy):
+    """save_names / save_names_mlp change WHAT is stored, never the math:
+    trajectory must match the no-remat baseline tightly."""
+    base = run_steps(ds.initialize(make_config(stage=1),
+                                   build_model(tiny_test())), n_steps=3)
+    got = run_steps(ds.initialize(
+        make_config(stage=1, remat={"enabled": True, "policy": policy}),
+        build_model(tiny_test())), n_steps=3)
+    np.testing.assert_allclose(got, base, rtol=1e-4)
+
+
 def test_tensor_parallel_trains(devices):
     model = build_model(tiny_test())
     cfg = make_config(stage=1, train_micro_batch_size_per_gpu="auto")

@@ -177,11 +177,26 @@ def test_stray_finder_spares_cpu_pinned_process():
     finally:
         child.kill()
         child.wait()
-    # no JAX_PLATFORMS at all -> not provably cpu-pinned
+    # no JAX_PLATFORMS at all -> not provably cpu-pinned. Wait for the
+    # child's post-exec environ to become readable first — a mid-exec read
+    # can return the PARENT's image (which may itself carry
+    # JAX_PLATFORMS=cpu under this very test suite), the same race as above.
     env.pop("JAX_PLATFORMS")
     child = subprocess.Popen(
         [sys.executable, "-c", "import time; time.sleep(30)"], env=env)
     try:
+        deadline = time.monotonic() + 10
+
+        def _environ_ready():
+            try:
+                with open(f"/proc/{child.pid}/environ", "rb") as f:
+                    blob = f.read()
+            except OSError:
+                return False
+            return blob and b"JAX_PLATFORMS=" not in blob
+
+        while not _environ_ready() and time.monotonic() < deadline:
+            time.sleep(0.1)
         assert not bc._proc_is_cpu_pinned(child.pid)
     finally:
         child.kill()
