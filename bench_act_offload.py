@@ -45,7 +45,11 @@ def _run_workload():
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
     if on_tpu:
-        size, kw, micro, seq = "350m", {}, 8, 1024
+        # seq512: the dots_saveable BASELINE must itself fit (at seq1024 it
+        # saves ~6 GiB of (B,H,S,S) probs and compiles to 16.1 GiB — the
+        # round-5 OOM; the probe's value is the POLICY DELTA, which any
+        # fitting shape measures)
+        size, kw, micro, seq = "350m", {}, 8, 512
     else:   # CPU smoke: shrink the trunk, keep the graph shape
         size, kw, micro, seq = "125m", dict(n_layer=2, d_model=128, n_head=4,
                                             vocab_size=1024), 4, 64

@@ -49,15 +49,10 @@ _CACHE = os.path.join(_ROOT, "GPT_LARGE_BENCH_TPU_CACHE.json")
 # bf16 grads buy mbs8 back (12.1 + 1.6 GiB), gas2 halves the optimizer
 # tail, save_names_mlp skips the w_in recompute where it fits.
 _CANDIDATES = [
-    dict(tag="1b_lion_mbs8_gas2_xla_bf16g", kw=dict(size="1.5b", n_layer=30),
-         opt="lion", micro=8, seq=1024, policy="save_names", fused=False,
-         flash=False, gas=2, grad_dtype="bfloat16"),
-    dict(tag="1b_lion_mbs4_mlph_xla_bf16g", kw=dict(size="1.5b", n_layer=30),
-         opt="lion", micro=4, seq=1024, policy="save_names_mlp", fused=False,
-         flash=False, gas=2, grad_dtype="bfloat16"),
-    dict(tag="1b_lion_mbs4_gas4_xla", kw=dict(size="1.5b", n_layer=30),
-         opt="lion", micro=4, seq=1024, policy="save_names", fused=False,
-         flash=False, gas=4, grad_dtype=None),
+    # round-5 measured: the stable >=1B headline (0.322 MFU; its xla-attn
+    # twin 0.330). The bf16-grad / gas / mlp_h 1B variants all compile
+    # 0.5-2 GiB over the 15.75 GiB line (OOM dumps in PROGRESS notes) -
+    # buffer assignment, not arithmetic, owns that margin.
     dict(tag="1b_lion_mbs4_flash_savenames", kw=dict(size="1.5b", n_layer=30),
          opt="lion", micro=4, seq=1024, policy="save_names", fused=None,
          flash=True, gas=1, grad_dtype=None),
@@ -72,12 +67,20 @@ _CANDIDATES = [
          gas=1, grad_dtype=None),
 ]
 
+# Extra measured row (attached as "mlph_774m"): save_names_mlp keeps the
+# pre-GELU MLP intermediate so the backward never recomputes w_in — only
+# fits below 1B; bf16 grads buy back the saved-activation head-room.
+_MLPH_EXTRA = dict(tag="774m_lion_mbs8_mlph_bf16g", kw=dict(size="774m"),
+                   opt="lion", micro=8, seq=1024, policy="save_names_mlp",
+                   fused=None, flash=True, gas=1, grad_dtype="bfloat16")
+
 # A/B twins run AFTER the headline lands, each TOGGLING one lever on the
 # winner's exact config (VERDICT r5 priorities (a)/(b)): fused-vs-XLA
 # xent and flash-vs-XLA attention, whichever direction the winner isn't;
 # plus the remat dimension on the 350M shape where activations fit.
+# mbs4: the mbs8 no-remat step compiled to 16.36 GiB (round-5 OOM dump)
 _REMAT_OFF_TWIN = dict(tag="350m_lion_noremat", kw=dict(size="350m"),
-                       opt="lion", micro=8, seq=512, policy=None, fused=None,
+                       opt="lion", micro=4, seq=512, policy=None, fused=None,
                        flash=False, gas=1, grad_dtype=None)
 
 
@@ -261,11 +264,14 @@ def main():
             if extra is not None:
                 best = dict(best)
                 best[f"{key}_flip"] = extra
-        if time.monotonic() <= deadline:
-            extra = _launch(me, dict(_REMAT_OFF_TWIN), deadline)
+        for key, spec in (("mlph_774m", _MLPH_EXTRA),
+                          ("remat_off_350m", _REMAT_OFF_TWIN)):
+            if time.monotonic() > deadline:
+                break
+            extra = _launch(me, dict(spec), deadline)
             if extra is not None:
                 best = dict(best)
-                best["remat_off_350m"] = extra
+                best[key] = extra
         if "platform=tpu" in best.get("unit", ""):
             bc.save_tpu_cache(_CACHE, best)
     if best is None:

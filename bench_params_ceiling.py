@@ -56,7 +56,10 @@ def _tier_config(tier: str, nvme_dir: str) -> dict:
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "gradient_clipping": 1.0,
         "steps_per_print": 10 ** 9,
-        "remat": {"enabled": True, "policy": "dots_saveable"},
+        # save_names: the round-5-proven minimal-save policy (the ceiling
+        # question wants the framework's best practice, and dots_saveable
+        # puts ~6x more saved activation bytes in the device temp count)
+        "remat": {"enabled": True, "policy": "save_names"},
         "zero_optimization": {"stage": 1},
     }
     if tier == "host":
@@ -66,7 +69,7 @@ def _tier_config(tier: str, nvme_dir: str) -> dict:
         cfg["zero_optimization"] = {
             "stage": 2,
             "offload_optimizer": {"device": "nvme", "nvme_path": nvme_dir},
-            "offload_param": {"enabled": True},
+            "offload_param": {"device": "nvme", "nvme_path": nvme_dir},
         }
     return cfg
 
@@ -84,8 +87,12 @@ def _probe(tier: str, n_layer: int, budget: int, nvme_dir: str):
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model, gpt2
 
+    # fused_xent=False: at d=2560 the fused-xent BACKWARD kernel's scoped
+    # vmem crosses the 16 MiB limit (measured: 16.81 MiB) — and the loss
+    # kernel is irrelevant to the params-per-chip question (the round-5
+    # xent A/B measured the XLA path equal-or-faster anyway)
     model_cfg = gpt2("1.5b", n_layer=n_layer, d_model=_D_MODEL,
-                     n_head=_N_HEAD, max_seq=_SEQ, fused_xent=None)
+                     n_head=_N_HEAD, max_seq=_SEQ, fused_xent=False)
     eng = ds.initialize(_tier_config(tier, nvme_dir),
                         build_model(model_cfg), abstract_state=True)
     batch = {"input_ids": np.zeros((_MICRO, _SEQ), np.int32),

@@ -378,7 +378,8 @@ class T5Model:
         if dec_ids is None:
             dec_ids = self._shift_right(labels)
         safe = jnp.maximum(labels, 0)
-        if self._fused_xent_active(batch_size=labels.shape[0]):
+        if self._fused_xent_active(batch_size=labels.shape[0],
+                                   compute_dtype=params["shared"].dtype):
             x = self._features(params, batch["input_ids"], dec_ids,
                                batch.get("attention_mask"), remat_policy)
             nll = fused_nll_sharded(x, safe,
@@ -393,7 +394,7 @@ class T5Model:
              else (labels != -100).astype(jnp.float32))
         return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
-    def _fused_xent_active(self, batch_size=None) -> bool:
+    def _fused_xent_active(self, batch_size=None, compute_dtype=None) -> bool:
         """T5 fused-loss gate: tied shared embedding only (the kernel takes
         the (V, d) table), and conservatively NO model/seq/pipe sharding —
         the shared table's TP layout differs from the decoder trunk's, so
@@ -401,6 +402,18 @@ class T5Model:
         batch boundaries across the dp world (see the decoder gate)."""
         cfg = self.cfg
         if cfg.fused_xent is False or not cfg.tie_embeddings:
+            return False
+        # Mosaic has no f16 (see the decoder gate): f16 via cfg.dtype or
+        # via fp16-engine compute params keeps the XLA path on TPU
+        if jax.default_backend() == "tpu" and (
+                jnp.dtype(cfg.dtype) == jnp.float16
+                or (compute_dtype is not None
+                    and jnp.dtype(compute_dtype) == jnp.float16)):
+            return False
+        # even minimum tiles blow scoped VMEM past d~6144 (ops/xent.py)
+        from ..ops.xent import fused_xent_eligible_d
+
+        if not fused_xent_eligible_d(cfg.d_model):
             return False
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:

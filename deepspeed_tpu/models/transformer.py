@@ -768,7 +768,8 @@ class TransformerLM:
         ids = batch["input_ids"]
         mlm = self.cfg.objective == "mlm"
         B, S = ids.shape
-        if self._fused_xent_active(batch_size=B):
+        if self._fused_xent_active(
+                batch_size=B, compute_dtype=params["tok_embed"].dtype):
             x, aux = self._trunk(params, ids, batch.get("attention_mask"),
                                  remat_policy)
             feats = self._pre_head(params, x)
@@ -795,7 +796,8 @@ class TransformerLM:
             ce = ce + self.cfg.moe_aux_loss_weight * aux
         return ce
 
-    def _fused_xent_active(self, batch_size: Optional[int] = None) -> bool:
+    def _fused_xent_active(self, batch_size: Optional[int] = None,
+                           compute_dtype=None) -> bool:
         """Route the loss through the fused Pallas softmax-xent kernel?
         Auto (fused_xent=None): on for TPU when the head is expressible —
         tied embeddings (W stays in (V, d) table layout, no transpose) and
@@ -811,6 +813,22 @@ class TransformerLM:
         cfg = self.cfg
         if cfg.fused_xent is False or not cfg.tie_embeddings \
                 or cfg.objective not in ("clm", "mlm"):
+            return False
+        # Mosaic has no f16: if float16 can reach the kernel via EITHER
+        # path — cfg.dtype (the trunk's activation dtype; feats follow it
+        # through the embed cast) or the engine's compute params (fp16
+        # engines cast params to f16 even when cfg.dtype stays bf16) —
+        # take the XLA loss path on TPU ("Unsupported type in mosaic
+        # dialect: 'f16'", round-5 smoke). Interpret mode handles f16.
+        if jax.default_backend() == "tpu" and (
+                jnp.dtype(cfg.dtype) == jnp.float16
+                or (compute_dtype is not None
+                    and jnp.dtype(compute_dtype) == jnp.float16)):
+            return False
+        # even minimum tiles blow scoped VMEM past d~6144 (ops/xent.py)
+        from ..ops.xent import fused_xent_eligible_d
+
+        if not fused_xent_eligible_d(cfg.d_model):
             return False
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:

@@ -34,6 +34,7 @@ from jax.experimental import pallas as pl
 
 BIG_NEG = -2.0 ** 30
 SUBLANES = 8  # fp32 sublane tile: lse/delta rows replicated to (8, S)
+_warned_f16_fallback = False  # one warning per process (HBM-cliff notice)
 
 
 # ---------------------------------------------------------------- forward
@@ -173,7 +174,7 @@ def _bias_col_spec(bias_shape, B, H, block):
 def _fwd_call(q, k, v, mask, bias, *, block: int, causal: bool,
               interpret: bool, alibi=None):
     B, H, S, hd = q.shape
-    if bias is None and _use_streamed(S, hd, q.dtype.itemsize, False):
+    if bias is None and _use_streamed(S, hd, q.dtype.itemsize):
         return _fwd_call_streamed(q, k, v, mask, block=block, causal=causal,
                                   interpret=interpret, alibi=alibi)
     scale = 1.0 / math.sqrt(hd)
@@ -323,7 +324,7 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
 def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
               interpret: bool, grad_bias: bool = False, alibi=None):
     B, H, S, hd = q.shape
-    if bias is None and _use_streamed(S, hd, q.dtype.itemsize, False):
+    if bias is None and _use_streamed(S, hd, q.dtype.itemsize):
         return _bwd_call_streamed(q, k, v, o, lse, do, mask, block=block,
                                   causal=causal, interpret=interpret,
                                   alibi=alibi)
@@ -399,9 +400,10 @@ def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
 _STREAM_VMEM_BYTES = 8 * 1024 * 1024
 
 
-def _use_streamed(S, hd, itemsize, biased: bool) -> bool:
-    # 2 operands (k+v or q+do) x double buffering
-    return not biased and 2 * S * hd * itemsize * 2 > _STREAM_VMEM_BYTES
+def _use_streamed(S, hd, itemsize) -> bool:
+    # 2 operands (k+v or q+do) x double buffering; callers pre-exclude
+    # biased inputs (bias stays on the baseline path)
+    return 2 * S * hd * itemsize * 2 > _STREAM_VMEM_BYTES
 
 
 def _vmem_scratch(block, hd):
@@ -817,6 +819,28 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     assert bias is None or alibi_slopes is None, \
         "pass either bias or alibi_slopes, not both"
     blk = min(block, S)
+    # Mosaic has no f16: fp16-compute models take the XLA fallback (same
+    # reason the fused-xent gate excludes fp16) — bf16/f32 stay fused.
+    # Warn loudly ONCE: the dense path materializes (B, H, S, S) scores,
+    # an HBM cliff at long sequence that would otherwise surface as an
+    # opaque OOM instead of this explanation.
+    if jnp.dtype(q.dtype) == jnp.float16 \
+            and jax.default_backend() == "tpu":
+        global _warned_f16_fallback
+        if not _warned_f16_fallback:
+            _warned_f16_fallback = True
+            from ..utils.logging import logger
+
+            logger.warning(
+                "flash_attention: float16 inputs fall back to the dense "
+                "XLA path on TPU (Mosaic has no f16). The dense path "
+                "materializes (B, H, S, S) scores — prefer bf16 compute "
+                "for long sequences.")
+        from ..models.transformer import alibi_bias, causal_attention
+
+        if alibi_slopes is not None:
+            bias = alibi_bias(alibi_slopes, S)
+        return causal_attention(q, k, v, mask=mask, causal=causal, bias=bias)
     if S % blk != 0:
         from ..models.transformer import alibi_bias, causal_attention
 

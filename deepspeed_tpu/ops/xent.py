@@ -179,8 +179,33 @@ def _resolve_interpret(interpret):
     return jax.default_backend() != "tpu" if interpret is None else interpret
 
 
-def _blocks(T, V, block_t, block_v):
-    return min(block_t, _pow2_ceil(T)), min(block_v, _pow2_ceil(V))
+# Element budget for the kernels' VMEM stack ((bt + bv) x d tiles,
+# double-buffered): the default (256, 512) tiles measure ~13 MiB of scoped
+# VMEM at d=2048 (and 16.8 MiB at d=2560 — the round-5 remote-compile OOM),
+# so (256+512)*2048 elements is the proven-safe ceiling.
+_TILE_ELEM_BUDGET = (256 + 512) * 2048
+_MIN_TILE = 128
+
+
+def fused_xent_eligible_d(d: int) -> bool:
+    """Can the kernels' tiles be shrunk to fit scoped VMEM at this feature
+    width? Past d=6144 even the minimum (128, 128) tiles blow the budget —
+    gates must route the XLA loss path instead."""
+    return (2 * _MIN_TILE) * d <= _TILE_ELEM_BUDGET
+
+
+def _blocks(T, V, block_t, block_v, d=0):
+    bt = min(block_t, _pow2_ceil(T))
+    bv = min(block_v, _pow2_ceil(V))
+    # shrink tiles (largest first) until the byte budget holds at this d —
+    # a ratio-with-floor underestimates past d~4096 (round-5 review)
+    while d and (bt + bv) * d > _TILE_ELEM_BUDGET \
+            and (bt > _MIN_TILE or bv > _MIN_TILE):
+        if bv >= bt and bv > _MIN_TILE:
+            bv //= 2
+        else:
+            bt //= 2
+    return bt, bv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -210,7 +235,7 @@ def _fwd(x, w, bias, targets, block_t, block_v, interpret, partials=False):
     T, d = x.shape
     V = w.shape[0]
     interpret = _resolve_interpret(interpret)
-    bt, bv = _blocks(T, V, block_t, block_v)
+    bt, bv = _blocks(T, V, block_t, block_v, d)
     xp, wp, bp, tp = _operands(x, w, bias, targets, bt, bv)
     Tp, Vp = xp.shape[0], wp.shape[0]
     n_ti, n_vj = Tp // bt, Vp // bv
@@ -248,7 +273,7 @@ def _bwd_kernels(x, w, bias, targets, lse, g, block_t, block_v, interpret):
     T, d = x.shape
     V = w.shape[0]
     interpret = _resolve_interpret(interpret)
-    bt, bv = _blocks(T, V, block_t, block_v)
+    bt, bv = _blocks(T, V, block_t, block_v, d)
     # padded tokens enter with g = 0: no contribution to dx / dW / dbias
     # (their padded lse of 0 is therefore harmless)
     xp, wp, bp, tp, gp, lp = _operands(

@@ -317,3 +317,38 @@ def test_t5_loss_fused_matches_naive():
     gb, _ = ravel_pytree(jax.grad(lambda p: naive_m.loss(p, batch))(params))
     np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_fused_gate_declines_fp16_on_tpu(monkeypatch):
+    """Mosaic has no f16: under an fp16 engine the compute params are
+    float16 (cfg.dtype stays bf16), and on TPU the gate must route to the
+    XLA loss path (round-5 smoke: 'Unsupported type in mosaic dialect')."""
+    model = build_model(tiny_test(n_layer=2, fused_xent=None))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert model._fused_xent_active(compute_dtype=jnp.bfloat16)
+    assert not model._fused_xent_active(compute_dtype=jnp.float16)
+
+
+def test_xent_blocks_shrink_past_d2048():
+    """Tile sizes halve past d=2048 so the bwd kernels' scoped VMEM stays
+    under the 16 MiB budget (measured 16.8 MiB at d=2560 with the default
+    tiles); small-d shapes keep the full tiles."""
+    from deepspeed_tpu.ops.xent import _blocks
+
+    assert _blocks(4096, 50257, 256, 512, d=1600) == (256, 512)
+    bt, bv = _blocks(4096, 50257, 256, 512, d=2560)
+    assert (bt + bv) * 2560 <= (256 + 512) * 2048 and min(bt, bv) >= 128
+    # past d~6144 even minimum tiles blow the budget: gates must decline
+    from deepspeed_tpu.ops.xent import fused_xent_eligible_d
+    assert fused_xent_eligible_d(6144) and not fused_xent_eligible_d(8192)
+    # kernel still numerically exact at a shrunk-tile width
+    rng = np.random.default_rng(0)
+    T, d, V = 64, 2304, 512
+    x = jnp.asarray(rng.standard_normal((T, d)), jnp.float32) * 0.1
+    w = jnp.asarray(rng.standard_normal((V, d)), jnp.float32) * 0.1
+    t = jnp.asarray(rng.integers(0, V, (T,)), jnp.int32)
+    got = fused_token_nll(x, w, None, t, interpret=True)
+    logits = x @ w.T
+    want = jax.nn.logsumexp(logits, axis=-1) - logits[jnp.arange(T), t]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
