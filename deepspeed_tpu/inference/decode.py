@@ -3,7 +3,7 @@
 Reference analog: the fused inference kernels and KV-cache workspace of
 ``csrc/transformer/inference/`` (``softmax_context`` = attention over the
 cache, ``inference_context.h`` = the cache allocator). TPU-native: the cache
-is a pair of ``(L, B, max_len, KV, hd)`` arrays updated with
+is a pair of ``(L, B, KV, max_len, hd)`` arrays updated with
 ``dynamic_update_slice`` inside the compiled step; attention over the cache
 masks positions beyond the current length, so every decode step has an
 identical static shape (one compiled program for the whole generation).
@@ -27,22 +27,27 @@ BIG_NEG = -2.0 ** 30
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray           # (L, B, max_len, KV, hd)
-    v: jnp.ndarray           # (L, B, max_len, KV, hd)
+    # (L, B, KV, max_len, hd): heads-major so the Pallas decode kernel's
+    # cache operand blocks as (None, None, max_len, hd) — TPU lowering
+    # requires the last two block dims be (sublane, lane)-shaped, which a
+    # seq-major (max_len, KV, hd) layout cannot satisfy (round-5 hardware
+    # contact: "block shape ... (Squeezed(), Blocked(256), Squeezed(), 64)")
+    k: jnp.ndarray           # (L, B, KV, max_len, hd)
+    v: jnp.ndarray           # (L, B, KV, max_len, hd)
     length: jnp.ndarray      # i32 scalar: tokens currently cached
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=None) -> KVCache:
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    shape = (cfg.n_layer, batch, cfg.kv_heads, max_len, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((), jnp.int32))
 
 
 def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
                   alibi=None):
-    """q: (B, T, H, hd) vs cache (B, max_len, KV, hd); positions >= length
+    """q: (B, T, H, hd) vs cache (B, KV, max_len, hd); positions >= length
     masked. For prefill T = prompt len (with causal offset); decode T = 1.
 
     ``bias`` is an additive (H, T, max_len) score bias; ``alibi`` is the
@@ -57,30 +62,30 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
     # declines externally-built odd caches (which take the dense path
     # rather than risking an unaligned Pallas tile on hardware).
     if (flash_decode and bias is None and T == 1
-            and ck.shape[1] % 128 == 0):
+            and ck.shape[2] % 128 == 0):
         from ..ops.decode_attention import decode_attention
 
         return decode_attention(q, ck, cv, length, alibi_slopes=alibi)
     # query t sits at global position length - T + t; key at slot s —
     # ONE set of position math drives both the alibi bias and the mask
     t_pos = length - T + jnp.arange(T)[:, None]          # (T, 1)
-    s_pos = jnp.arange(ck.shape[1])[None, :]             # (1, max_len)
+    s_pos = jnp.arange(ck.shape[2])[None, :]             # (1, max_len)
     if alibi is not None:
         rel = (s_pos - t_pos).astype(jnp.float32)        # (T, max_len)
         ab = alibi[:, None, None] * rel[None]            # (H, T, max_len)
         bias = ab if bias is None else bias + ab
-    KV = ck.shape[2]
+    KV = ck.shape[1]
     if KV != H:
-        ck = jnp.repeat(ck, H // KV, axis=2)
-        cv = jnp.repeat(cv, H // KV, axis=2)
-    scores = jnp.einsum("bthd,bshd->bhts", q, ck).astype(jnp.float32)
+        ck = jnp.repeat(ck, H // KV, axis=1)
+        cv = jnp.repeat(cv, H // KV, axis=1)
+    scores = jnp.einsum("bthd,bhsd->bhts", q, ck).astype(jnp.float32)
     scores = scores / math.sqrt(hd)
     if bias is not None:
         scores = scores + bias[None]
     keep = s_pos <= t_pos                                # (T, max_len)
     scores = jnp.where(keep[None, None], scores, BIG_NEG)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, cv)
+    return jnp.einsum("bhts,bhsd->bthd", probs, cv)
 
 
 def _layer_step(model, x, p, cache_k, cache_v, length, positions,
@@ -103,10 +108,10 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
         q, k = _rope(q, k, positions, cfg.rope_theta, cfg.rotary_dim)
 
     start = length - T  # cache slots [start, start+T) receive the new k/v
-    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
-                                       (0, start, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
-                                       (0, start, 0, 0))
+    cache_k = lax.dynamic_update_slice(
+        cache_k, k.swapaxes(1, 2).astype(cache_k.dtype), (0, 0, start, 0))
+    cache_v = lax.dynamic_update_slice(
+        cache_v, v.swapaxes(1, 2).astype(cache_v.dtype), (0, 0, start, 0))
     alibi = None
     if cfg.pos_embedding == "alibi":
         # ALiBi positional signal (mirrors _attention_block's training

@@ -403,17 +403,10 @@ class T5Model:
         cfg = self.cfg
         if cfg.fused_xent is False or not cfg.tie_embeddings:
             return False
-        # Mosaic has no f16 (see the decoder gate): f16 via cfg.dtype or
-        # via fp16-engine compute params keeps the XLA path on TPU
-        if jax.default_backend() == "tpu" and (
-                jnp.dtype(cfg.dtype) == jnp.float16
-                or (compute_dtype is not None
-                    and jnp.dtype(compute_dtype) == jnp.float16)):
-            return False
-        # even minimum tiles blow scoped VMEM past d~6144 (ops/xent.py)
-        from ..ops.xent import fused_xent_eligible_d
+        # hardware eligibility (f16-on-TPU, VMEM at wide d): ops/xent.py
+        from ..ops.xent import fused_xent_eligible
 
-        if not fused_xent_eligible_d(cfg.d_model):
+        if not fused_xent_eligible(cfg.dtype, compute_dtype, cfg.d_model):
             return False
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:

@@ -814,21 +814,10 @@ class TransformerLM:
         if cfg.fused_xent is False or not cfg.tie_embeddings \
                 or cfg.objective not in ("clm", "mlm"):
             return False
-        # Mosaic has no f16: if float16 can reach the kernel via EITHER
-        # path — cfg.dtype (the trunk's activation dtype; feats follow it
-        # through the embed cast) or the engine's compute params (fp16
-        # engines cast params to f16 even when cfg.dtype stays bf16) —
-        # take the XLA loss path on TPU ("Unsupported type in mosaic
-        # dialect: 'f16'", round-5 smoke). Interpret mode handles f16.
-        if jax.default_backend() == "tpu" and (
-                jnp.dtype(cfg.dtype) == jnp.float16
-                or (compute_dtype is not None
-                    and jnp.dtype(compute_dtype) == jnp.float16)):
-            return False
-        # even minimum tiles blow scoped VMEM past d~6144 (ops/xent.py)
-        from ..ops.xent import fused_xent_eligible_d
+        # hardware eligibility (f16-on-TPU, VMEM at wide d): ops/xent.py
+        from ..ops.xent import fused_xent_eligible
 
-        if not fused_xent_eligible_d(cfg.d_model):
+        if not fused_xent_eligible(cfg.dtype, compute_dtype, cfg.d_model):
             return False
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:

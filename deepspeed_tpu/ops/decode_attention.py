@@ -11,7 +11,10 @@ scores is the win.
 
 Layout notes:
 - grid (B, H); each program handles one (batch, head) pair.
-- the cache keeps its storage layout (B, max_len, KV, hd) — the GQA head
+- the cache keeps its storage layout (B, KV, max_len, hd) — heads-major so
+  the per-head block is (None, None, max_len, hd), whose last two dims are
+  (sublane, lane)-shaped as the TPU lowering requires (a seq-major cache
+  would squeeze the second-to-last dim: rejected on hardware). The GQA head
   group mapping happens in the BlockSpec index_map (h // group), so there is
   no repeated-KV materialization at all (the training kernel pays a
   ``jnp.repeat``; decode can't afford it).
@@ -79,7 +82,7 @@ def _decode_kernel(*refs, block: int, scale: float, alibi: bool):
 
 def decode_attention(q, ck, cv, length, *, alibi_slopes=None,
                      block: int = 128, interpret: Optional[bool] = None):
-    """q: (B, 1, H, hd) current-token queries; ck/cv: (B, max_len, KV, hd)
+    """q: (B, 1, H, hd) current-token queries; ck/cv: (B, KV, max_len, hd)
     cache; ``length`` scalar or (B,) live lengths (slots < length attended).
     ``alibi_slopes``: optional (H,) per-head slopes — the ALiBi distance
     bias is reconstructed in-kernel from the live length (Bloom decode
@@ -90,7 +93,7 @@ def decode_attention(q, ck, cv, length, *, alibi_slopes=None,
 
     B, T, H, hd = q.shape
     assert T == 1, "decode kernel is single-token; use flash_attention for prefill"
-    S, KV = ck.shape[1], ck.shape[2]
+    KV, S = ck.shape[1], ck.shape[2]
     blk = min(block, S)
     if S % blk != 0:
         raise ValueError(f"cache length {S} not divisible by block {blk}")
@@ -113,10 +116,10 @@ def decode_attention(q, ck, cv, length, *, alibi_slopes=None,
         in_specs=[
             pl.BlockSpec((None, None, SUBLANES, hd),
                          lambda b, h, *pre: (b, h, 0, 0)),
-            pl.BlockSpec((None, S, None, hd),
-                         lambda b, h, *pre: (b, 0, h // group, 0)),
-            pl.BlockSpec((None, S, None, hd),
-                         lambda b, h, *pre: (b, 0, h // group, 0)),
+            pl.BlockSpec((None, None, S, hd),
+                         lambda b, h, *pre: (b, h // group, 0, 0)),
+            pl.BlockSpec((None, None, S, hd),
+                         lambda b, h, *pre: (b, h // group, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, None, SUBLANES, hd),
                                lambda b, h, *pre: (b, h, 0, 0)),

@@ -819,13 +819,14 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     assert bias is None or alibi_slopes is None, \
         "pass either bias or alibi_slopes, not both"
     blk = min(block, S)
-    # Mosaic has no f16: fp16-compute models take the XLA fallback (same
-    # reason the fused-xent gate excludes fp16) — bf16/f32 stay fused.
-    # Warn loudly ONCE: the dense path materializes (B, H, S, S) scores,
-    # an HBM cliff at long sequence that would otherwise surface as an
-    # opaque OOM instead of this explanation.
-    if jnp.dtype(q.dtype) == jnp.float16 \
-            and jax.default_backend() == "tpu":
+    # Mosaic has no f16: fp16-compute inputs (any of q/k/v — an fp16 KV
+    # cache under a bf16 trunk counts) take the same XLA fallback as
+    # non-divisible shapes; bf16/f32 stay fused. Warn ONCE for the f16
+    # case: the dense path materializes (B, H, S, S) scores, an HBM cliff
+    # at long sequence that would otherwise surface as an opaque OOM.
+    f16_in = any(jnp.dtype(x.dtype) == jnp.float16 for x in (q, k, v)) \
+        and jax.default_backend() == "tpu"
+    if f16_in:
         global _warned_f16_fallback
         if not _warned_f16_fallback:
             _warned_f16_fallback = True
@@ -836,12 +837,7 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                 "XLA path on TPU (Mosaic has no f16). The dense path "
                 "materializes (B, H, S, S) scores — prefer bf16 compute "
                 "for long sequences.")
-        from ..models.transformer import alibi_bias, causal_attention
-
-        if alibi_slopes is not None:
-            bias = alibi_bias(alibi_slopes, S)
-        return causal_attention(q, k, v, mask=mask, causal=causal, bias=bias)
-    if S % blk != 0:
+    if f16_in or S % blk != 0:
         from ..models.transformer import alibi_bias, causal_attention
 
         if alibi_slopes is not None:

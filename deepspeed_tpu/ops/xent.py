@@ -194,11 +194,30 @@ def fused_xent_eligible_d(d: int) -> bool:
     return (2 * _MIN_TILE) * d <= _TILE_ELEM_BUDGET
 
 
+def fused_xent_eligible(cfg_dtype, compute_dtype, d_model: int) -> bool:
+    """Shared hardware-eligibility gate for the decoder and T5 loss paths
+    (model-structure checks stay with each model). False when:
+
+    - float16 could reach the kernel on TPU, via EITHER the trunk's
+      activation dtype (cfg) or the engine's compute params (fp16 engines
+      cast params to f16 even when cfg.dtype stays bf16) — Mosaic has no
+      f16 ("Unsupported type in mosaic dialect", round-5 smoke); interpret
+      mode on other backends handles f16 fine;
+    - the feature width is past what tile-shrinking can fit in scoped VMEM
+      (fused_xent_eligible_d)."""
+    if jax.default_backend() == "tpu" and (
+            jnp.dtype(cfg_dtype) == jnp.float16
+            or (compute_dtype is not None
+                and jnp.dtype(compute_dtype) == jnp.float16)):
+        return False
+    return fused_xent_eligible_d(d_model)
+
+
 def _blocks(T, V, block_t, block_v, d=0):
     bt = min(block_t, _pow2_ceil(T))
     bv = min(block_v, _pow2_ceil(V))
-    # shrink tiles (largest first) until the byte budget holds at this d —
-    # a ratio-with-floor underestimates past d~4096 (round-5 review)
+    # shrink tiles (largest first) until the ELEMENT budget holds at this
+    # d — a ratio-with-floor underestimates past d~4096 (round-5 review)
     while d and (bt + bv) * d > _TILE_ELEM_BUDGET \
             and (bt > _MIN_TILE or bv > _MIN_TILE):
         if bv >= bt and bv > _MIN_TILE:

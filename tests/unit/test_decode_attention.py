@@ -15,8 +15,8 @@ from deepspeed_tpu.ops.decode_attention import decode_attention
 def _setup(B=2, S=128, H=4, KV=2, hd=32, length=77, seed=0):
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
-    ck = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
-    cv = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((B, KV, S, hd)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((B, KV, S, hd)), jnp.float32)
     return q, ck, cv, jnp.int32(length)
 
 
@@ -86,8 +86,8 @@ def test_alibi_slopes_in_kernel_match_dense():
     q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
     slopes = alibi_slopes(H)
     for KV in (H, 2):
-        ck = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
-        cv = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((B, KV, S, hd)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((B, KV, S, hd)), jnp.float32)
         for length in (jnp.int32(17), jnp.int32(64),
                        jnp.asarray([13, 49], jnp.int32)):
             got = decode_attention(q, ck, cv, length, alibi_slopes=slopes,
