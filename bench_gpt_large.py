@@ -38,21 +38,22 @@ _CACHE = os.path.join(_ROOT, "GPT_LARGE_BENCH_TPU_CACHE.json")
 
 # Candidate spec (JSON-serializable dict). policy None = remat off;
 # flash routes attention through the Pallas kernel; gas = gradient
-# accumulation steps (amortizes the measured 46 ms optimizer tail over
-# gas micro-steps); grad_dtype "bfloat16" halves the grad buffer
-# (data_types.grad_accum_dtype). Memory arithmetic on the 15.75 GiB v5e,
-# all round-5 MEASURED: 1B lion mbs8 seq1024 flash dots_saveable = 18.31
-# GiB (params+state 14.1 = fp32 master+moment, bf16 compute, fp32 grads
-# at 1.004 B params; ~4.2 GiB saved dots); save_names mbs4 = fits
-# (0.3151 MFU, twins: xla attn 0.3299 > flash, xla xent 0.3248 > fused);
-# save_names mbs8 fp32-grads = OOM by a hair. Hence this order:
-# bf16 grads buy mbs8 back (12.1 + 1.6 GiB), gas2 halves the optimizer
-# tail, save_names_mlp skips the w_in recompute where it fits.
+# accumulation steps; grad_dtype "bfloat16" halves the grad buffer
+# (data_types.grad_accum_dtype). Memory arithmetic on the 15.75 GiB v5e:
+# 1B lion = 14.1 GiB params+state (fp32 master+moment, bf16 compute,
+# fp32 grads at 1.004 B params), so only save_names-class remat fits it
+# (dots_saveable compiles to 18.31 GiB at mbs8 — measured OOM dump).
 _CANDIDATES = [
-    # round-5 measured: the stable >=1B headline (0.322 MFU; its xla-attn
-    # twin 0.330). The bf16-grad / gas / mlp_h 1B variants all compile
-    # 0.5-2 GiB over the 15.75 GiB line (OOM dumps in PROGRESS notes) -
-    # buffer assignment, not arithmetic, owns that margin.
+    # Round-5 measured at this 1B shape (latest run wins): all-XLA
+    # headline 0.3344 MFU; flips from it measured fused-xent 0.328 and
+    # flash-attn 0.3262 — XLA's fused attention+loss beat the Pallas
+    # kernels at seq1024/mbs4, so the all-XLA combo leads. The bf16-grad /
+    # gas / mlp_h 1B variants all compile 0.5-2 GiB over the line (OOM
+    # dumps in PROGRESS notes) - buffer assignment, not arithmetic, owns
+    # that margin.
+    dict(tag="1b_lion_mbs4_xla_savenames", kw=dict(size="1.5b", n_layer=30),
+         opt="lion", micro=4, seq=1024, policy="save_names", fused=False,
+         flash=False, gas=1, grad_dtype=None),
     dict(tag="1b_lion_mbs4_flash_savenames", kw=dict(size="1.5b", n_layer=30),
          opt="lion", micro=4, seq=1024, policy="save_names", fused=None,
          flash=True, gas=1, grad_dtype=None),
