@@ -63,6 +63,29 @@ def _alarm(signum, frame):
     raise TimeoutError("per-candidate alarm: remote compile/run hung")
 
 
+def _seq_of(result) -> int:
+    import re
+
+    m = re.search(r"seq(\d+)", (result or {}).get("metric", ""))
+    return int(m.group(1)) if m else 0
+
+
+def _maybe_cache(result, seq=None) -> None:
+    """Last-known-good cache keeps the LONGEST-seq headline (best-first
+    means longest = headline): a shorter-seq result (secondary rows,
+    demotion after a transient flake, operator one-offs) must not
+    downgrade it, and a rows-bearing cache must not be replaced by a
+    rows-less result at the same length (bit twice in round 5)."""
+    seq = _seq_of(result) if seq is None else seq
+    cached = bc.load_tpu_cache(_CACHE)       # envelope: {"result": {...}}
+    prev = (cached or {}).get("result", {})
+    if seq < _seq_of(prev):
+        return
+    if seq == _seq_of(prev) and prev.get("rows") and not result.get("rows"):
+        return
+    bc.save_tpu_cache(_CACHE, result)
+
+
 def _measure(seq, blk, devices, on_tpu):
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model, gpt2
@@ -116,7 +139,7 @@ def _measure(seq, blk, devices, on_tpu):
         "vs_baseline": round(mfu / 0.54, 4),   # Ulysses 54%-of-peak anchor
     }
     if on_tpu:
-        bc.save_tpu_cache(_CACHE, result)
+        _maybe_cache(result, seq)
     print(json.dumps(result), flush=True)
 
 
@@ -166,6 +189,25 @@ def main():
             # flagship sequence length
             bc.log(f"candidate {cand} never got the TPU; retrying it",
                    "longseq-bench")
+    # Secondary rows: the headline is the LONGEST sequence that measured;
+    # shorter lengths attach as "rows" so the artifact shows the
+    # MFU-vs-sequence curve, not one point (each its own child; a failure
+    # costs only that row).
+    if result is not None and "platform=tpu" in result.get("unit", ""):
+        extra_rows = {}
+        for cand in candidates[idx + 1:idx + 3]:
+            if time.monotonic() > deadline - 60:
+                break
+            env["DSTPU_LONGSEQ_TRY"] = cand
+            extra = bc.run_with_tpu_window(
+                me, env, window_s=max(120.0, deadline - time.monotonic()),
+                child_timeout=900, tag="longseq-bench",
+                max_claimed_attempts=1)
+            if extra is not None:
+                extra_rows[f"seq{cand.split(':')[0]}"] = extra
+        if extra_rows:
+            result = dict(result, rows=extra_rows)
+            _maybe_cache(result)
     if result is None:
         result = bc.cached_result(_CACHE, tag="longseq-bench")
     if result is None:

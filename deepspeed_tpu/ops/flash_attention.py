@@ -397,12 +397,15 @@ def _bwd_call(q, k, v, o, lse, do, mask, bias, *, block: int, causal: bool,
 # the never-visible triangle, no compute). Bias operands stay on the
 # baseline path: learned-bias callers (evoformer pair stacks) are
 # short-sequence by construction.
-_STREAM_VMEM_BYTES = 8 * 1024 * 1024
+_STREAM_VMEM_BYTES = 6 * 1024 * 1024
 
 
 def _use_streamed(S, hd, itemsize) -> bool:
     # 2 operands (k+v or q+do) x double buffering; callers pre-exclude
-    # biased inputs (bias stays on the baseline path)
+    # biased inputs (bias stays on the baseline path). 6 MiB: S=16384 at
+    # hd=64 bf16 computes to exactly 8 MiB and the baseline form measured
+    # a 16.8 MiB scoped-vmem OOM there (round-5 16k row) — the boundary
+    # must stream; S<=8192 (4.2 MiB) measured fine on the baseline form.
     return 2 * S * hd * itemsize * 2 > _STREAM_VMEM_BYTES
 
 
