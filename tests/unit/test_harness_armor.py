@@ -201,3 +201,31 @@ def test_stray_finder_spares_cpu_pinned_process():
     finally:
         child.kill()
         child.wait()
+
+
+def test_longseq_cache_guard_keeps_longest_headline(tmp_path, monkeypatch):
+    """bench_longseq._maybe_cache: a shorter-seq result must not downgrade
+    the cached longest-seq headline, and a rows-bearing cache must not be
+    replaced by a rows-less result at the same length (round-5 incidents:
+    manual children overwrote the 32k headline twice)."""
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import bench_common as bc
+    import bench_longseq as bl
+
+    cache = tmp_path / "LONGSEQ_CACHE.json"
+    monkeypatch.setattr(bl, "_CACHE", str(cache))
+    head = {"metric": "gpt2_flash_seq32768_mfu", "value": 0.24,
+            "rows": {"seq4096": {"value": 0.38}}}
+    bl._maybe_cache(dict(head))
+    assert bc.load_tpu_cache(str(cache))["result"]["value"] == 0.24
+    # shorter seq: ignored
+    bl._maybe_cache({"metric": "gpt2_flash_seq16384_mfu", "value": 0.9})
+    assert bc.load_tpu_cache(str(cache))["result"]["value"] == 0.24
+    # same seq without rows: ignored (would strip the curve)
+    bl._maybe_cache({"metric": "gpt2_flash_seq32768_mfu", "value": 0.9})
+    assert bc.load_tpu_cache(str(cache))["result"]["value"] == 0.24
+    # same seq WITH rows: updates
+    bl._maybe_cache({"metric": "gpt2_flash_seq32768_mfu", "value": 0.25,
+                     "rows": {"seq4096": {"value": 0.39}}})
+    assert bc.load_tpu_cache(str(cache))["result"]["value"] == 0.25
