@@ -89,7 +89,7 @@ def onebit_train_step(engine, state, batch, scale, warmup: bool):
     compute_params = engine._cast_compute(state.master_params)
 
     def body(cp, b, ce, mu_tree):
-        grads, loss = engine._gas_scan(cp, b, scale, vary_axes=("data",))
+        grads, loss = engine._gas_scan(cp, b, scale)
         g_flat, unflatten = flatten_tree(grads)
         g_flat = g_flat / scale
         mu_flat, _ = flatten_tree(mu_tree)
