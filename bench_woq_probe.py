@@ -81,6 +81,13 @@ def main():
                       if min(res["dequant_in_loop_ms"], res["mixed_dot_ms"])
                       < 0.75 * res["bf16_ms"]
                       else "hoisted/not-fused: no decode bandwidth win")
+    res["platform"] = "tpu"
+    import os
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "WOQ_PROBE.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
     print(json.dumps(res))
 
 
