@@ -14,8 +14,10 @@ Design (standard flash attention 2, MXU-shaped):
   the saved logsumexp; ``delta = rowsum(dO * O)`` precomputed outside.
 - GQA: kv heads are repeated to H with ``jnp.repeat`` *outside* the
   custom_vjp, so the head-group sum in dk/dv falls out of autodiff.
-- dtype: matmuls run on the MXU with fp32 accumulation
-  (``preferred_element_type``); softmax math in fp32.
+- dtype: matmul OPERANDS stay in their storage dtype (bf16 runs the MXU
+  at full rate; pre-casting to f32 forces multi-pass emulation — round-5
+  profile finding) with fp32 accumulation (``preferred_element_type``);
+  softmax math in fp32; the 1/√hd scale applies to the f32 product.
 
 On non-TPU backends the kernels run in Pallas interpret mode (tests), and
 inputs that the kernel doesn't cover (padding masks, non-divisible shapes)
@@ -53,13 +55,13 @@ def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool,
     o_ref, lse_ref = refs[i:]
     iq = pl.program_id(2)
     h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
-    q = q_ref[...].astype(jnp.float32) * scale          # (blk, hd)
+    q = q_ref[...]                                      # (blk, hd) bf16
     nkb = k_ref.shape[0] // block
 
     def body(jk, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-        v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+        k = k_ref[pl.ds(jk * block, block), :]
+        v = v_ref[pl.ds(jk * block, block), :]
         # additive score bias tile (blk, blk), streamed from the (blk, S)
         # row slice this q-block owns — never a full (S, S)
         # materialization; key-padding mask row for this k block
@@ -68,7 +70,7 @@ def _fwd_kernel(*refs, block: int, scale: float, causal: bool, masked: bool,
         mk = (mask_ref[0, pl.ds(jk * block, block)] > 0.5
               if mask_ref is not None else None)
         s, keep = _masked_scores(q, k, iq, jk, block, causal, mk, h_slope,
-                                 bias_tile)
+                                 scale=scale, bias_tile=bias_tile)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         if keep is not None:
@@ -109,14 +111,21 @@ def _alibi_rel(iq, jk, block):
     return (k_pos - q_pos).astype(jnp.float32)
 
 
-def _masked_scores(q, k, iq, jk, block, causal, mk, h_slope, bias_tile=None):
+def _masked_scores(q, k, iq, jk, block, causal, mk, h_slope, *, scale,
+                   bias_tile=None):
     """Shared (blk, blk) score tile for ALL six kernels (baseline and
-    streamed, fwd and bwd): s = q·kᵀ (+bias tile) (+ALiBi ramp), with
-    causal / key-padding positions forced to BIG_NEG BEFORE any exp (for
-    all-masked rows lse ~ BIG_NEG and a raw exp(s − lse) would overflow
-    to inf — the round-4 fix, now in exactly one place). Returns
+    streamed, fwd and bwd): s = scale·q·kᵀ (+bias tile) (+ALiBi ramp),
+    with causal / key-padding positions forced to BIG_NEG BEFORE any exp
+    (for all-masked rows lse ~ BIG_NEG and a raw exp(s − lse) would
+    overflow to inf — the round-4 fix, now in exactly one place).
+
+    q/k arrive in their STORAGE dtype (bf16 in practice): the MXU runs
+    bf16×bf16→f32 at full rate but emulates f32×f32 matmuls in multiple
+    passes — pre-casting operands to f32 (the round-5 profile's finding)
+    halves attention-matmul throughput. The 1/√hd scale therefore applies
+    to the f32 product, not the operands (also exact for any hd). Returns
     (s, keep) where keep is None when nothing is masked."""
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     if bias_tile is not None:
         s = s + bias_tile.astype(jnp.float32)
     if h_slope is not None:
@@ -239,21 +248,22 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
             # untouched upper triangle doesn't carry garbage
             dbias_ref[...] = jnp.zeros(dbias_ref.shape, dbias_ref.dtype)
         iq = pl.program_id(2)
-        q = q_ref[...].astype(jnp.float32) * scale
-        do = do_ref[...].astype(jnp.float32)
+        q = q_ref[...]                                   # storage dtype
+        do = do_ref[...]
         lse = lse_ref[0]
         delta = delta_ref[0]
         nkb = k_ref.shape[0] // block
 
         def body(jk, dq):
-            k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-            v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
+            k = k_ref[pl.ds(jk * block, block), :]
+            v = v_ref[pl.ds(jk * block, block), :]
             bias_tile = (bias_ref[:, pl.ds(jk * block, block)]
                          if bias_ref is not None else None)
             mk = (mask_ref[0, pl.ds(jk * block, block)] > 0.5
                   if mask_ref is not None else None)
             s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
-                                     h_slope, bias_tile)
+                                     h_slope, scale=scale,
+                                     bias_tile=bias_tile)
             p = _probs_from_lse(s, keep, lse)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
@@ -262,7 +272,8 @@ def _make_bwd_dq_kernel(block: int, scale: float, causal: bool, masked: bool,
                 # exactly one grid step, so this is a plain write
                 dbias_ref[:, pl.ds(jk * block, block)] = ds.astype(
                     dbias_ref.dtype)
-            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+            return dq + jnp.dot(ds.astype(k.dtype), k,
+                                preferred_element_type=jnp.float32)
 
         ub = iq + 1 if causal else nkb
         dq = jax.lax.fori_loop(0, ub, body, jnp.zeros(q.shape, jnp.float32))
@@ -287,8 +298,8 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
         dk_ref, dv_ref = refs[i:]
         h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
         jk = pl.program_id(2)
-        k = k_ref[...].astype(jnp.float32)               # (blk, hd)
-        v = v_ref[...].astype(jnp.float32)
+        k = k_ref[...]                                   # (blk, hd) storage
+        v = v_ref[...]
         nqb = q_ref.shape[0] // block
         mk = None
         if mask_ref is not None:
@@ -296,26 +307,31 @@ def _make_bwd_dkv_kernel(block: int, scale: float, causal: bool, masked: bool,
 
         def body(iq, carry):
             dk, dv = carry
-            q = q_ref[pl.ds(iq * block, block), :].astype(jnp.float32) * scale
-            do = do_ref[pl.ds(iq * block, block), :].astype(jnp.float32)
+            q = q_ref[pl.ds(iq * block, block), :]
+            do = do_ref[pl.ds(iq * block, block), :]
             lse = lse_ref[0, pl.ds(iq * block, block)]
             delta = delta_ref[0, pl.ds(iq * block, block)]
             # (S, blk) column slice of the bias: rows iq-block
             bias_tile = (bias_ref[pl.ds(iq * block, block), :]
                          if bias_ref is not None else None)
             s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
-                                     h_slope, bias_tile)
+                                     h_slope, scale=scale,
+                                     bias_tile=bias_tile)
             p = _probs_from_lse(s, keep, lse)
-            dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+            dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                              preferred_element_type=jnp.float32)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
-            dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+            dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
+                              preferred_element_type=jnp.float32)
             return dk, dv
 
         lb = jk if causal else 0
         z = jnp.zeros(k.shape, jnp.float32)
         dk, dv = jax.lax.fori_loop(lb, nqb, body, (z, z))
-        dk_ref[...] = dk.astype(dk_ref.dtype)
+        # dk accumulated against UNSCALED q: apply the 1/√hd chain-rule
+        # factor once at the end (q used to arrive pre-scaled)
+        dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
         dv_ref[...] = dv.astype(dv_ref.dtype)
 
     return kernel
@@ -438,12 +454,13 @@ def _fwd_kernel_streamed(*refs, block: int, scale: float, causal: bool,
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
     def _step():
-        q = q_ref[...].astype(jnp.float32) * scale
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
         mk = mask_ref[0, :] > 0.5 if mask_ref is not None else None
         h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
-        s, keep = _masked_scores(q, k, iq, jk, block, causal, mk, h_slope)
+        s, keep = _masked_scores(q, k, iq, jk, block, causal, mk, h_slope,
+                                 scale=scale)
         m = m_scr[:, :1]
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -533,21 +550,21 @@ def _make_bwd_dq_kernel_streamed(block: int, scale: float, causal: bool,
             dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
         def _step():
-            q = q_ref[...].astype(jnp.float32) * scale
-            do = do_ref[...].astype(jnp.float32)
+            q = q_ref[...]
+            do = do_ref[...]
             lse = lse_ref[0]
             delta = delta_ref[0]
-            k = k_ref[...].astype(jnp.float32)
-            v = v_ref[...].astype(jnp.float32)
+            k = k_ref[...]
+            v = v_ref[...]
             mk = mask_ref[0, :] > 0.5 if mask_ref is not None else None
             h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
             s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
-                                     h_slope)
+                                     h_slope, scale=scale)
             p = _probs_from_lse(s, keep, lse)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
             dq_scr[...] = dq_scr[...] + jnp.dot(
-                ds, k, preferred_element_type=jnp.float32)
+                ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
         if causal:
             pl.when(jk <= iq)(_step)
@@ -582,23 +599,23 @@ def _make_bwd_dkv_kernel_streamed(block: int, scale: float, causal: bool,
             dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
         def _step():
-            k = k_ref[...].astype(jnp.float32)
-            v = v_ref[...].astype(jnp.float32)
-            q = q_ref[...].astype(jnp.float32) * scale
-            do = do_ref[...].astype(jnp.float32)
+            k = k_ref[...]
+            v = v_ref[...]
+            q = q_ref[...]
+            do = do_ref[...]
             lse = lse_ref[0]
             delta = delta_ref[0]
             mk = mask_ref[0, :] > 0.5 if mask_ref is not None else None
             h_slope = slopes_ref[0, 0] if slopes_ref is not None else None
             s, keep = _masked_scores(q, k, iq, jk, block, causal, mk,
-                                     h_slope)
+                                     h_slope, scale=scale)
             p = _probs_from_lse(s, keep, lse)
             dv_scr[...] = dv_scr[...] + jnp.dot(
-                p.T, do, preferred_element_type=jnp.float32)
+                p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
             dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta[:, None])
             dk_scr[...] = dk_scr[...] + jnp.dot(
-                ds.T, q, preferred_element_type=jnp.float32)
+                ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32)
 
         if causal:
             pl.when(iq >= jk)(_step)
@@ -607,7 +624,8 @@ def _make_bwd_dkv_kernel_streamed(block: int, scale: float, causal: bool,
 
         @pl.when(iq == nq - 1)
         def _finalize():
-            dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+            # dk accumulated against UNSCALED q (see baseline dkv kernel)
+            dk_ref[...] = (dk_scr[...] * scale).astype(dk_ref.dtype)
             dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
     return kernel

@@ -184,15 +184,17 @@ def _layout_lists(layout: np.ndarray, causal: bool):
 def _fwd_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 block: int, scale: float, causal: bool):
     iq = pl.program_id(2)
-    q = q_ref[...].astype(jnp.float32) * scale
+    # storage-dtype operands: bf16 runs the MXU at full rate, f32 operands
+    # force multi-pass emulation (flash_attention._masked_scores, round-5)
+    q = q_ref[...]
     q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
 
     def body(jj, carry):
         m, l, acc = carry
         jk = kidx_ref[iq, jj]
-        k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-        v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[pl.ds(jk * block, block), :]
+        v = v_ref[pl.ds(jk * block, block), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             kpos = jk * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
@@ -204,7 +206,8 @@ def _fwd_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             p = jnp.where(keep, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * corr + jnp.dot(p.astype(v.dtype), v,
+                                   preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     m0 = jnp.full((block, 1), BIG_NEG, jnp.float32)
@@ -220,17 +223,17 @@ def _fwd_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 def _dq_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                delta_ref, dq_ref, *, block: int, scale: float, causal: bool):
     iq = pl.program_id(2)
-    q = q_ref[...].astype(jnp.float32) * scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[0]
     delta = delta_ref[0]
     q_pos = iq * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
 
     def body(jj, dq):
         jk = kidx_ref[iq, jj]
-        k = k_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-        v = v_ref[pl.ds(jk * block, block), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[pl.ds(jk * block, block), :]
+        v = v_ref[pl.ds(jk * block, block), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             kpos = jk * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
@@ -238,7 +241,8 @@ def _dq_kernel(kidx_ref, kn_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, kn_ref[iq], body,
                            jnp.zeros(q.shape, jnp.float32))
@@ -249,32 +253,35 @@ def _dkv_kernel(qidx_ref, qn_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 delta_ref, dk_ref, dv_ref, *, block: int, scale: float,
                 causal: bool):
     jk = pl.program_id(2)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
     k_pos = jk * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
 
     def body(ii, carry):
         dk, dv = carry
         iq = qidx_ref[jk, ii]
-        q = q_ref[pl.ds(iq * block, block), :].astype(jnp.float32) * scale
-        do = do_ref[pl.ds(iq * block, block), :].astype(jnp.float32)
+        q = q_ref[pl.ds(iq * block, block), :]
+        do = do_ref[pl.ds(iq * block, block), :]
         lse = lse_ref[0, pl.ds(iq * block, block)]
         delta = delta_ref[0, pl.ds(iq * block, block)]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = iq * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv = dv + jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
+                          preferred_element_type=jnp.float32)
         return dk, dv
 
     z = jnp.zeros(k.shape, jnp.float32)
     dk, dv = jax.lax.fori_loop(0, qn_ref[jk], body, (z, z))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
+    # dk accumulated against UNSCALED q: chain-rule factor applied once
+    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
