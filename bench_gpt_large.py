@@ -44,19 +44,21 @@ _CACHE = os.path.join(_ROOT, "GPT_LARGE_BENCH_TPU_CACHE.json")
 # fp32 grads at 1.004 B params), so only save_names-class remat fits it
 # (dots_saveable compiles to 18.31 GiB at mbs8 — measured OOM dump).
 _CANDIDATES = [
-    # Round-5 measured at this 1B shape (latest run wins): all-XLA
-    # headline 0.3344 MFU; flips from it measured fused-xent 0.328 and
-    # flash-attn 0.3262 — XLA's fused attention+loss beat the Pallas
-    # kernels at seq1024/mbs4, so the all-XLA combo leads. The bf16-grad /
-    # gas / mlp_h 1B variants all compile 0.5-2 GiB over the line (OOM
-    # dumps in PROGRESS notes) - buffer assignment, not arithmetic, owns
-    # that margin.
+    # Round-5 measured at this 1B shape (latest run wins): with the
+    # block-512 flash default (bf16 operands, wide MXU tiles) the flash
+    # step measures 305.5 ms vs 410.5 for the best all-XLA combo — flash
+    # leads. (History: at block 128 flash LOST to XLA 421.5 vs 410.5;
+    # the tile width was the whole story.) The bf16-grad / gas / mlp_h
+    # 1B variants all compile 0.5-2 GiB over the line (OOM dumps in
+    # PROGRESS notes) - buffer assignment, not arithmetic, owns that
+    # margin.
+    dict(tag="1b_lion_mbs4_flash512_savenames",
+         kw=dict(size="1.5b", n_layer=30), opt="lion", micro=4, seq=1024,
+         policy="save_names", fused=None, flash=True, gas=1,
+         grad_dtype=None),
     dict(tag="1b_lion_mbs4_xla_savenames", kw=dict(size="1.5b", n_layer=30),
          opt="lion", micro=4, seq=1024, policy="save_names", fused=False,
          flash=False, gas=1, grad_dtype=None),
-    dict(tag="1b_lion_mbs4_flash_savenames", kw=dict(size="1.5b", n_layer=30),
-         opt="lion", micro=4, seq=1024, policy="save_names", fused=None,
-         flash=True, gas=1, grad_dtype=None),
     dict(tag="774m_lion_mbs16_flash_savenames", kw=dict(size="774m"),
          opt="lion", micro=16, seq=1024, policy="save_names", fused=None,
          flash=True, gas=1, grad_dtype=None),

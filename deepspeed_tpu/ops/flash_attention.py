@@ -803,7 +803,7 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                     bias: Optional[jnp.ndarray] = None,
                     bias_is_constant: bool = False,
                     alibi_slopes: Optional[jnp.ndarray] = None,
-                    causal: bool = True, block: int = 128,
+                    causal: bool = True, block: int = 512,
                     interpret: Optional[bool] = None):
     """Fused causal attention. q: (B, S, H, hd); k/v: (B, S, KV, hd).
 
@@ -834,7 +834,14 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     seq would be 100+ GB; slopes cost H floats). Mutually exclusive with
     ``bias``.
 
-    The only remaining fallback is S not divisible by the block tile.
+    ``block`` default 512 (round-5 A/B on a v5e, 1B decoder seq 1024:
+    block 128 → 421.5 ms/step, 256 → 334.9, 512 → 305.5 — wider tiles
+    feed the MXU 512-wide dots and cut the kv-loop trips 4×; a (512,
+    512) f32 score tile is ~1 MiB of VMEM, comfortably under budget).
+    Shapes not divisible by the block clamp it to S (single tile).
+
+    The only remaining fallback is S not divisible by the (clamped)
+    block tile.
     """
     B, S, H, hd = q.shape
     assert bias is None or alibi_slopes is None, \
@@ -898,7 +905,7 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     return o.swapaxes(1, 2)
 
 
-def make_flash_attention(block: int = 128, interpret: Optional[bool] = None,
+def make_flash_attention(block: int = 512, interpret: Optional[bool] = None,
                          bias_is_constant: bool = True):
     """attention_fn factory for :class:`TransformerLM`.
 
