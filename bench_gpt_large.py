@@ -103,8 +103,20 @@ def _twin_spec(spec, key: str):
 
 
 def _run_candidate(spec_json: str):
+    import signal
+
     import jax
     import numpy as np
+
+    # Self-armed watchdog (bench_longseq's pattern): if the PARENT dies,
+    # nothing else bounds this child — round-5 incident: an orphaned
+    # child held the single-claimant tunnel for 28 min in a hung remote
+    # compile. The alarm raises cleanly between bytecodes so jax tears
+    # down and releases the claim.
+    signal.signal(signal.SIGALRM,
+                  lambda *a: (_ for _ in ()).throw(
+                      TimeoutError("gptl child watchdog: compile/run hung")))
+    signal.alarm(1200)
 
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model, gpt2
