@@ -229,7 +229,14 @@ def _run_candidate(spec_json: str):
         },
         "candidate": tag,
     }
-    if on_tpu and n_params >= 1e9 and remat:
+    twin_suffixes = ("_xlaxent", "_fusedxent", "_xlaattn", "_flashattn")
+    if on_tpu and n_params >= 1e9 and remat \
+            and not tag.endswith(twin_suffixes):
+        # headline children only: a twin child saving here would overwrite
+        # the headline in the single-slot cache (round-5 incident: the
+        # attn-flip twin's 0.33 replaced the flash-512 headline, and the
+        # next run's cache-upfront emission wrote it into the artifact).
+        # The parent saves the enriched headline+twins result at the end.
         bc.save_tpu_cache(_CACHE, result)
     print(json.dumps(result), flush=True)
 
