@@ -421,3 +421,24 @@ def test_streamed_masked_grads_match(monkeypatch):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=1e-5, atol=1e-5,
                                    err_msg=f"d{name} mismatch (streamed+mask)")
+
+
+def test_default_block_clamps_to_short_sequences():
+    """block=512 default (round-5): shorter sequences clamp the block to
+    S (single tile) and must stay ON the kernel path, not fall back."""
+    import deepspeed_tpu.models.transformer as tr
+
+    q, k, v = _qkv(S=96, hd=32)
+    want = causal_attention(q, k, v)
+    orig = tr.causal_attention
+
+    def _boom(*a, **kw):
+        raise AssertionError("fell back to dense at S=96")
+
+    tr.causal_attention = _boom
+    try:
+        got = flash_attention(q, k, v, interpret=True)   # default block
+    finally:
+        tr.causal_attention = orig
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
