@@ -14,7 +14,8 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 @pytest.mark.parametrize("example", ["pretrain_gpt2", "finetune_hf_import",
                                      "moe_pipeline_elastic", "rlhf_hybrid",
                                      "serve_inference", "longseq_sp",
-                                     "evoformer_science"])
+                                     "evoformer_science",
+                                     "billion_param_single_chip"])
 def test_example_runs(example, tmp_path):
     if example == "finetune_hf_import":
         pytest.importorskip("torch")
