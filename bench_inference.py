@@ -7,10 +7,14 @@ of MFU. The reference publishes no machine-readable inference numbers
 (SURVEY §6), so ``vs_baseline`` here is the fraction of the chip's own
 HBM roofline (1.0 = saturating memory bandwidth, the physical ceiling).
 
-Measures bf16 serving and int8 weight-only-quantized serving (reference
-``init_inference`` + quantization story) on GPT-2-350M. Steady-state
-decode is isolated by timing generate() at two output lengths and using
-the delta (subtracts prefill + dispatch).
+Measures bf16, int8-WOQ, and int4-WOQ serving (reference
+``init_inference`` + quantization story) on GPT-2-350M. Quantized decode
+streams int8/int4 weights through the fused Pallas GEMM
+(``ops/woq_matmul.py``), so each row carries its OWN per-step HBM-bytes
+model (``weight_bytes_per_step``, achieved GB/s, byte-ratio vs bf16) —
+the attribution that separates a bandwidth win from a compute win.
+Steady-state decode is isolated by timing generate() at two output
+lengths and using the delta (subtracts prefill + dispatch).
 
 Writes ``INFERENCE_BENCH.json``. Tunnel armor via bench_common.
 """
@@ -47,6 +51,22 @@ def _measure(engine, prompt, short, long_, bytes_per_token, peak_bw):
     return tokens_per_sec, mbu
 
 
+def _row(engine, prompt, short, long_, peak_bw):
+    """Measure one serving config and attach its HBM-bytes model: the
+    per-step weight read (quantized leaves count their int8/int4 bytes +
+    scales — decode now streams those, never a dequantized copy), the
+    achieved GB/s that implies, and the byte-model MBU against the chip
+    roofline. KV-cache traffic at these lengths is <4% of the weight read
+    and is left uncounted (under-reporting MBU slightly — conservative)."""
+    from deepspeed_tpu.inference.quantization import decode_weight_bytes
+
+    bpt = decode_weight_bytes(engine.params)
+    tps, mbu = _measure(engine, prompt, short, long_, bpt, peak_bw)
+    return {"tokens_per_sec": round(tps), "mbu": round(mbu, 4),
+            "weight_bytes_per_step": int(bpt),
+            "achieved_gbps": round(tps / prompt.shape[0] * bpt / 1e9, 1)}
+
+
 def _run_workload():
     import jax
     import numpy as np
@@ -68,32 +88,29 @@ def _run_workload():
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, (B, prompt_len)).astype(np.int32)
     peak_bw = peak_hbm_bw_for(devices[0])
-    # decode re-reads every weight once per token; KV-cache traffic at
-    # these lengths is <4% of the weight read and is left uncounted
-    # (under-reporting MBU slightly — conservative).
-    n_params = cfg.param_count()
 
     rows = {}
     for tag, icfg in (("bf16", {"dtype": "bfloat16"}),
+                      # decode keeps weights int8/int4 END-TO-END: the
+                      # fused Pallas GEMM streams quantized tiles and
+                      # dequantizes in VMEM, so these rows' bytes model
+                      # counts quantized bytes — the tok/s delta vs bf16
+                      # against the byte ratio (~1.94x / ~3.76x) is the
+                      # bandwidth-win attribution.
                       ("int8", {"dtype": "bfloat16", "quantize": True,
                                 "quant_bits": 8}),
-                      # int8 weights re-materialized INSIDE the decode scan:
-                      # tokens/s meaningfully above the int8 row means XLA
-                      # fused the convert (true in-HBM-int8 decode)
-                      ("int8_step", {"dtype": "bfloat16", "quantize": True,
-                                     "quant_bits": 8,
-                                     "dequant_per_step": True})):
+                      ("int4", {"dtype": "bfloat16", "quantize": True,
+                                "quant_bits": 4})):
         engine = ds.init_inference(model, params, dict(icfg))
-        # WOQ dequantizes ONCE per generate() inside the compiled program
-        # (before the decode scan), so steady-state decode re-reads bf16
-        # weights either way: count 2 bytes/param for BOTH rows. int8's
-        # win today is weight *storage* (2x params/chip), not decode
-        # bandwidth — claiming halved traffic would overstate MBU 2x.
-        bpt = n_params * 2
-        tps, mbu = _measure(engine, prompt, short, long_, bpt, peak_bw)
-        rows[tag] = {"tokens_per_sec": round(tps), "mbu": round(mbu, 4)}
+        rows[tag] = _row(engine, prompt, short, long_, peak_bw)
         del engine
         jax.clear_caches()
+    rows["int8"]["weight_read_reduction_vs_bf16"] = round(
+        rows["bf16"]["weight_bytes_per_step"]
+        / rows["int8"]["weight_bytes_per_step"], 3)
+    rows["int4"]["weight_read_reduction_vs_bf16"] = round(
+        rows["bf16"]["weight_bytes_per_step"]
+        / rows["int4"]["weight_bytes_per_step"], 3)
 
     # MoE decode (reference DeepSpeedMoEInference): single-group expert
     # dispatch inside the KV-cache scan (models/moe.py _mlp_block_infer).
@@ -113,21 +130,19 @@ def _run_workload():
     moe_prompt = rng.integers(0, moe_cfg.vocab_size,
                               (B, prompt_len)).astype(np.int32)
     engine = ds.init_inference(moe_model, moe_params, {"dtype": "bfloat16"})
-    tps, mbu = _measure(engine, moe_prompt, short, long_,
-                        moe_cfg.param_count() * 2, peak_bw)
-    rows["moe"] = {"tokens_per_sec": round(tps), "mbu": round(mbu, 4),
-                   "experts": moe_cfg.num_experts,
-                   "top_k": moe_cfg.moe_top_k}
+    rows["moe"] = _row(engine, moe_prompt, short, long_, peak_bw)
+    rows["moe"].update(experts=moe_cfg.num_experts, top_k=moe_cfg.moe_top_k)
     del engine
     jax.clear_caches()
 
     result = {
         "metric": f"gpt2_{size}_decode_mbu_int8",
         "value": rows["int8"]["mbu"],
-        "unit": (f"MBU (int8 WOQ {rows['int8']['tokens_per_sec']} tok/s, "
-                 f"bf16 {rows['bf16']['tokens_per_sec']} tok/s "
-                 f"mbu={rows['bf16']['mbu']}, per-step-dequant "
-                 f"{rows['int8_step']['tokens_per_sec']} tok/s, "
+        "unit": (f"MBU (int8 WOQ {rows['int8']['tokens_per_sec']} tok/s "
+                 f"@ {rows['int8']['weight_read_reduction_vs_bf16']}x fewer "
+                 f"weight bytes, bf16 {rows['bf16']['tokens_per_sec']} tok/s"
+                 f" mbu={rows['bf16']['mbu']}, int4 "
+                 f"{rows['int4']['tokens_per_sec']} tok/s, "
                  f"moe {rows['moe']['tokens_per_sec']} tok/s "
                  f"mbu={rows['moe']['mbu']}, batch={B}, "
                  f"platform={devices[0].platform}"

@@ -8,6 +8,7 @@ Pallas kernels for the hot ops, sharded universal checkpoints, inference/
 decode engine, and the observability stack.
 """
 
+from . import compat  # noqa: F401  (must run before any jax-0.9 API use)
 from .config import Config
 from .inference import InferenceConfig, InferenceEngine, init_inference
 from .platform import (get_accelerator, init_distributed, build_mesh, MeshSpec)

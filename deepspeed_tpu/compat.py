@@ -1,0 +1,63 @@
+"""JAX version compatibility shims.
+
+The codebase targets the jax==0.9 API surface (``jax.shard_map``,
+``jax.set_mesh``, ``jax.sharding.get_abstract_mesh``); some deployment
+images still carry a 0.4.x JAX where those names live under
+``jax.experimental`` or do not exist. Importing this module (the first
+import in ``deepspeed_tpu/__init__.py``) installs forward-compatible
+aliases on the ``jax`` module so the rest of the package — and user code
+written against the pinned API — runs unchanged on both.
+
+Kept dependency-free (imports only jax) so ``import deepspeed_tpu.compat``
+can never cycle back into the package.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        # 0.9 renamed check_rep -> check_vma; translate and delegate.
+        # Default OFF when unspecified: 0.4's replication checker lacks
+        # rules for primitives the 0.9 checker handles (checkpoint_name's
+        # `name`, sharding_constraint), and bodies written against 0.9
+        # trip it spuriously.
+        kw.setdefault("check_rep",
+                      check_vma if check_vma is not None else False)
+        # 0.9's axis_names (the manual subset) is 0.4's complement of
+        # `auto` (the non-manual subset).
+        axis_names = kw.pop("axis_names", None)
+        if axis_names is not None:
+            kw.setdefault("auto",
+                          frozenset(mesh.axis_names) - frozenset(axis_names))
+        return _shard_map_04(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+    jax.shard_map = _shard_map
+
+if not hasattr(jax.lax, "pcast"):
+    # 0.9's replication-cast for manual regions. 0.4 shard_map bodies
+    # with check_rep=False track no replication types — identity is the
+    # faithful translation.
+    jax.lax.pcast = lambda x, axis_name=None, **kw: x
+
+if not hasattr(jax.lax, "axis_size"):
+    # 0.9's lax.axis_size; psum of a literal 1 constant-folds to the
+    # bound axis size on 0.4.
+    jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+if not hasattr(jax, "set_mesh"):
+    # 0.9's ``with jax.set_mesh(mesh):`` — on 0.4 a Mesh is already a
+    # context manager that installs itself as the thread-resources env
+    # (which is exactly what ``current_mesh()``'s legacy branch reads).
+    @contextlib.contextmanager
+    def _set_mesh(mesh):
+        with mesh:
+            yield mesh
+
+    jax.set_mesh = _set_mesh

@@ -34,16 +34,27 @@ class InferenceConfig:
     # (ops/decode_attention.py). None = auto: on for TPU, off elsewhere
     # (interpret-mode Pallas inside the decode scan is test-only slow).
     flash_decode: Optional[bool] = None
-    # WOQ only: dequantize inside each decode step instead of once per
-    # generate(). If XLA fuses the int8→bf16 convert into the matmul
-    # operand loads, decode weight traffic halves (true in-kernel WOQ, the
-    # reference's dequantize-in-kernel design); if it hoists, identical to
-    # the default. bench_woq_probe.py measures which; off by default.
+    # WOQ only: route eligible quantized projections through the fused
+    # Pallas dequant-in-VMEM GEMM (ops/woq_matmul.py) so decode reads
+    # int8/int4 bytes from HBM by construction. None = auto: on for TPU,
+    # off elsewhere (the XLA per-use dequant is the portable fallback).
+    woq_kernel: Optional[bool] = None
+    # Subsumed knob, accepted for config compat: decode now keeps weights
+    # quantized end-to-end and dispatches the dequant at each consumption
+    # site, so there is no hoisted whole-tree dequant to toggle anymore
+    # (round-5 WOQ_PROBE showed XLA hoisting it either way).
     dequant_per_step: bool = False
 
     def flash_decode_resolved(self) -> bool:
         if self.flash_decode is not None:
             return self.flash_decode
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    def woq_kernel_resolved(self) -> bool:
+        if self.woq_kernel is not None:
+            return self.woq_kernel
         import jax
 
         return jax.default_backend() == "tpu"

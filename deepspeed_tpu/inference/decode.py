@@ -17,8 +17,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import TransformerConfig, _norm, _rope
+from ..platform.mesh import BATCH_AXES, constrain
+from .quantization import (QuantizedTensor, dequant_rows, matmul_any,
+                           woq_dot, woq_dot_t)
 
 # Host constant, NOT jnp.float32(...): a device constant here would run a
 # computation at import time and initialize the XLA backend — which breaks
@@ -57,11 +61,25 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
     hot path to the Pallas streaming kernel (ops/decode_attention.py)
     instead of materializing the full (B, H, 1, max_len) score tensor."""
     B, T, H, hd = q.shape
+    # Mosaic has no f16: an fp16 engine (or an externally-built fp16 KV
+    # cache under a bf16 trunk) must take the dense path on TPU instead of
+    # failing Mosaic compilation inside the decode scan — same gate and
+    # one-shot warning as flash_attention's.
+    f16_in = any(jnp.dtype(x.dtype) == jnp.float16 for x in (q, ck, cv)) \
+        and jax.default_backend() == "tpu"
+    if f16_in and flash_decode:
+        from ..utils.logging import warning_once
+
+        warning_once(
+            "decode: float16 q/KV-cache falls back to the dense XLA "
+            "cache attention on TPU (Mosaic has no f16). The dense "
+            "path materializes (B, H, 1, max_len) scores per step — "
+            "prefer bf16 compute for long generations.")
     # TPU lane tiling wants full 128-wide blocks: generate_tokens pads the
     # cache to a 128 multiple when flash_decode is on, so this gate only
     # declines externally-built odd caches (which take the dense path
     # rather than risking an unaligned Pallas tile on hardware).
-    if (flash_decode and bias is None and T == 1
+    if (flash_decode and not f16_in and bias is None and T == 1
             and ck.shape[2] % 128 == 0):
         from ..ops.decode_attention import decode_attention
 
@@ -88,22 +106,48 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
     return jnp.einsum("bhts,bhsd->bthd", probs, cv)
 
 
+def _qkv_proj(model, y, p):
+    """The attention projections as ONE GEMM when the engine pre-fused
+    them (``wqkv`` = [wq | wk | wv] along the output dim, ``bqkv``
+    likewise): a T=1 decode step's three skinny (B, d) x (d, n) dots
+    become a single (B, d) x (d, 2d-ish) call — one weight stream, one
+    MXU dispatch, one bias add — instead of three kernel launches over
+    the same activations. Falls back to the per-projection weights for
+    unfused trees (training params via HybridEngine, external callers)."""
+    cfg = model.cfg
+    B, T, _ = y.shape
+    h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    use_kernel = getattr(model, "woq_kernel", False)
+    if "wqkv" in p:
+        qkv = matmul_any(y, p["wqkv"], use_kernel=use_kernel)
+        if cfg.use_bias and "bqkv" in p:
+            qkv = qkv + p["bqkv"].astype(qkv.dtype)
+        q, k, v = jnp.split(qkv, [h * hd, (h + kv) * hd], axis=-1)
+    else:
+        q = model._maybe_bias(matmul_any(y, p["wq"], use_kernel), p, "bq")
+        k = model._maybe_bias(matmul_any(y, p["wk"], use_kernel), p, "bk")
+        v = model._maybe_bias(matmul_any(y, p["wv"], use_kernel), p, "bv")
+    return (q.reshape(B, T, h, hd), k.reshape(B, T, kv, hd),
+            v.reshape(B, T, kv, hd))
+
+
 def _layer_step(model, x, p, cache_k, cache_v, length, positions,
                 flash_decode: bool = False):
     """One transformer layer over x: (B, T, d), reading/writing the cache.
 
     Returns (x_out, new_cache_k, new_cache_v). Mirrors
     ``TransformerLM._attention_block`` / ``_mlp_block`` with cache attention
-    substituted for the full causal attention.
+    substituted for the full causal attention. Weights may arrive dense OR
+    quantized (int8/int4 ``QuantizedTensor`` leaves): every projection goes
+    through the point-of-use dispatch, so quantized decode re-reads int8
+    bytes from HBM each step — never a hoisted bf16 copy.
     """
     cfg = model.cfg
     B, T, d = x.shape
     h, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
     y = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.norm, cfg.norm_eps)
-    q = model._maybe_bias(y @ p["wq"].astype(y.dtype), p, "bq").reshape(B, T, h, hd)
-    k = model._maybe_bias(y @ p["wk"].astype(y.dtype), p, "bk").reshape(B, T, kv, hd)
-    v = model._maybe_bias(y @ p["wv"].astype(y.dtype), p, "bv").reshape(B, T, kv, hd)
+    q, k, v = _qkv_proj(model, y, p)
     if cfg.pos_embedding == "rope":
         q, k = _rope(q, k, positions, cfg.rope_theta, cfg.rotary_dim)
 
@@ -122,8 +166,9 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
         alibi = alibi_slopes(h)
     o = _cache_attend(q, cache_k, cache_v, length, flash_decode=flash_decode,
                       alibi=alibi)
-    o = model._maybe_bias(o.reshape(B, T, h * hd) @ p["wo"].astype(x.dtype),
-                          p, "bo")
+    o = model._maybe_bias(
+        matmul_any(o.reshape(B, T, h * hd), p["wo"],
+                   use_kernel=getattr(model, "woq_kernel", False)), p, "bo")
     # MoE trunks expose a single-group no-drop dispatch (_mlp_block_infer,
     # models/moe.py) for the T=1 decode step; prefill (T>1) and dense
     # trunks use the training MLP unchanged (per-row grouping keeps
@@ -141,12 +186,68 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
     return x + out, cache_k, cache_v
 
 
+def _embed_rows(table, ids, dtype):
+    """Row gather from a dense or int8/int4-stored embedding table — a
+    quantized table reads int8 bytes for exactly the batch's tokens."""
+    if isinstance(table, QuantizedTensor):
+        return dequant_rows(table, ids, dtype)
+    return table.astype(dtype)[ids]
+
+
+def _decode_head(model, params, x):
+    """Final norm + unembedding for the decode path, in fp32.
+
+    Differences from the training head that matter per token:
+    - logits come out of the MXU in fp32 (``preferred_element_type``)
+      and STAY fp32 into the sampler — the old path rounded the dot to
+      bf16 and the sampler cast straight back, a pure bf16↔fp32
+      round-trip over (B, V) every step;
+    - a quantized tied table is consumed in (V, d) layout by the fused
+      transposed WOQ GEMM (``woq_dot_t``) — the unembedding, the single
+      largest weight read of a decode step, streams int8;
+    - no (V, d) transpose is ever materialized for the dense tied case
+      either (``dot_general`` contracts the table's last dim directly).
+    """
+    cfg = model.cfg
+    x = model._pre_head(params, x)
+    use_kernel = getattr(model, "woq_kernel", False)
+    w = params["tok_embed"] if cfg.tie_embeddings else params["lm_head"]
+    if isinstance(w, QuantizedTensor):
+        dot = woq_dot_t if cfg.tie_embeddings else woq_dot
+        logits = dot(x, w, use_kernel=use_kernel, out_dtype=jnp.float32)
+    elif cfg.tiled_head > 1 and w.shape[0 if cfg.tie_embeddings else 1] \
+            % cfg.tiled_head == 0 and x.shape[1] > 1:
+        # big-vocab prefill through the public API: keep the tiled head
+        # (bounds the (B, T, V) logits working set; the generation loop
+        # never lands here — its prefill slices to the last position)
+        from ..ops.tiled import tiled_matmul
+
+        w2 = (w.T if cfg.tie_embeddings else w).astype(x.dtype)
+        logits = tiled_matmul(x, w2, cfg.tiled_head)
+    elif cfg.tie_embeddings:
+        logits = lax.dot_general(
+            x, w.astype(x.dtype), (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        logits = lax.dot_general(
+            x, w.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head_bias"].astype(logits.dtype)
+    return constrain(logits, P(BATCH_AXES, None, "model"))
+
+
 def forward_with_cache(model, params, input_ids, cache: KVCache,
-                       positions=None, flash_decode: bool = False):
+                       positions=None, flash_decode: bool = False,
+                       last_token_head: bool = False):
     """Run T tokens through all layers, appending to the cache.
 
     input_ids: (B, T). Works for both prefill (T = prompt length, cache
-    empty) and decode (T = 1). Returns (logits (B, T, V), new cache).
+    empty) and decode (T = 1). Returns (fp32 logits (B, T, V), new cache).
+    ``last_token_head=True`` computes the unembedding only for the final
+    position (the generation loop's prefill: the other T-1 logit rows are
+    discarded anyway, and at GPT-2 vocab sizes they're the biggest tensor
+    of the whole prefill).
     """
     cfg = model.cfg
     B, T = input_ids.shape
@@ -154,9 +255,10 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     if positions is None:
         positions = cache.length + jnp.broadcast_to(
             jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    x = params["tok_embed"].astype(cfg.dtype)[input_ids]
+    x = _embed_rows(params["tok_embed"], input_ids, cfg.dtype)
     if cfg.pos_embedding == "learned":
-        x = x + params["pos_embed"].astype(cfg.dtype)[positions[0]][None]
+        x = x + _embed_rows(params["pos_embed"], positions[0],
+                            cfg.dtype)[None]
     if cfg.embed_norm:
         x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
                   cfg.norm, cfg.norm_eps)
@@ -169,7 +271,7 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
         return x, (ck, cv)
 
     x, (ck, cv) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
-    logits = model._head(params, x)
+    logits = _decode_head(model, params, x[:, -1:] if last_token_head else x)
     return logits, KVCache(k=ck, v=cv, length=new_len)
 
 
@@ -183,14 +285,22 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
     schedule/eos logic cannot drift between them. ``sampler(logits, rng)``
     -> (B,) int32.
 
-    ``materialize``: optional ``quantized params -> dense params`` fn.
-    When given, the prefill materializes once (compute-bound, dense is
-    right), but each decode step re-materializes INSIDE the scan body —
-    inviting XLA to fuse the int8→bf16 convert into the matmul operand
-    loads so the weights re-read from HBM each token stay int8 (half the
-    decode traffic). Whether the compiler fuses or hoists is toolchain-
-    dependent: ``bench_woq_probe.py`` measures it; the knob is
-    ``InferenceConfig.dequant_per_step``.
+    ``materialize``: optional ``quantized params -> dense params`` fn,
+    applied ONLY to the prefill (compute-bound; dense is right there).
+    The decode scan consumes ``params`` as given: a quantized tree stays
+    int8/int4 end-to-end — every projection dispatches through
+    ``matmul_any``/``woq_dot_t`` at its point of use, so the weight bytes
+    re-read from HBM each token are the quantized ones. The old
+    alternative (re-materializing the whole tree in the scan body and
+    hoping XLA fuses the convert) measurably did not fuse — XLA hoisted
+    the loop-invariant dequant and decode re-read a bf16 copy
+    (``WOQ_PROBE.json`` round 5) — which is why the consumption sites
+    dispatch explicitly now.
+
+    The prefill + decode scan share one jitted program; the KV cache
+    threads through the scan carry, so XLA reuses (donates) the cache
+    buffers in place — cache update and attend live in the same scan body
+    with no copy between steps.
     """
     objective = getattr(model.cfg, "objective", "clm")
     if objective != "clm":
@@ -209,14 +319,15 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
     eos = eos_token_id
     mat = materialize if materialize is not None else (lambda p: p)
 
-    logits, cache = forward_with_cache(model, mat(params), input_ids, cache)
+    logits, cache = forward_with_cache(model, mat(params), input_ids, cache,
+                                       last_token_head=True)
     rng, sub = jax.random.split(rng)
     tok = sampler(logits[:, -1], sub)
     done = (tok == eos) if eos is not None else jnp.zeros((B,), bool)
 
     def step(carry, _):
         tok, cache, rng, done = carry
-        lg, cache = forward_with_cache(model, mat(params), tok[:, None], cache,
+        lg, cache = forward_with_cache(model, params, tok[:, None], cache,
                                        flash_decode=flash_decode)
         rng, sub = jax.random.split(rng)
         nxt = sampler(lg[:, 0], sub)

@@ -5,8 +5,12 @@ TP group, converts dtype, injects kernels, captures CUDA graphs, and serves
 ``generate``. Here: params are device_put against the model's sharding specs
 over a ``model``-axis mesh (TP == AutoTP without the module-graph walking,
 since the sharding rules ARE the policy), the decode loop is one jitted
-``lax.scan`` over a static KV cache (graph capture subsumed by XLA), and
-int8 WOQ stores weights quantized in HBM with dequant fused into the step.
+``lax.scan`` over a static KV cache (graph capture subsumed by XLA), the
+serving tree fuses the attention projections into one column-sharded
+[wq|wk|wv] GEMM, and int8/int4 WOQ keeps weights quantized END-TO-END —
+the decode step consumes them through the fused dequant-in-VMEM Pallas
+GEMM (ops/woq_matmul.py), so each token re-reads int8 bytes from HBM, not
+a hoisted bf16 copy (docs/WOQ_DECODE.md).
 """
 
 from __future__ import annotations
@@ -51,6 +55,14 @@ class InferenceEngine:
                  mesh: Optional[Mesh] = None):
         self.config = InferenceConfig.from_any(config)
         cfg = self.config
+        if cfg.dequant_per_step:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "inference config: dequant_per_step is obsolete — decode "
+                "now keeps weights quantized end-to-end and dequantizes "
+                "at each consumption site (the fused WOQ GEMM); the knob "
+                "is accepted for config compat but changes nothing.")
         self.compute_dtype = cfg.compute_dtype
         self.model = model_with_dtype(model, self.compute_dtype)
         if getattr(self.model.cfg, "num_experts", 1) > 1:
@@ -88,21 +100,40 @@ class InferenceEngine:
 
         cast = jax.tree_util.tree_map_with_path(_cast, params)
         specs = self.model.param_specs()
+        # Fuse the attention projections into one [wq | wk | wv] weight
+        # for the serving tree: the decode step runs ONE batched GEMM over
+        # the shared post-norm activations instead of three skinny ones
+        # (reference qkv_gemm fusion, csrc/transformer/inference). The
+        # column-concat keeps Megatron column sharding: spec stays
+        # (None, None, "model").
+        self._fused = self._can_fuse_qkv(cast)
+        if self._fused:
+            cast = self._fuse_qkv_params(cast)
+            specs = self._fuse_qkv_specs(specs)
         if cfg.quantize:
             # WOQ x TP: quantize straight into the sharded layout — the
             # shardings for the quantized tree come from the same
             # param_specs the dense path uses (scales follow their weights;
-            # quantized_shardings docs). eval_shape first so nothing is
-            # ever materialized unsharded.
+            # quantized_shardings docs), and each leaf's spec travels in
+            # its aux data so the decode-side kernel dispatch can
+            # shard_map accordingly. eval_shape first so nothing is ever
+            # materialized unsharded.
             quant = partial(quantize_params, group_size=cfg.quant_group_size,
-                            bits=cfg.quant_bits)
+                            bits=cfg.quant_bits, specs=specs)
             q_shapes = jax.eval_shape(quant, cast)
             shardings = quantized_shardings(specs, q_shapes, self.mesh)
             with self.mesh:
                 self.params = jax.jit(quant, out_shardings=shardings)(cast)
+            # the decode consumption sites read this flag off the model
+            # (shared code paths can't thread an engine handle through);
+            # clone first so a shared training model isn't flagged
+            if self.model is model:
+                self.model = copy.copy(model)
+            self.model.woq_kernel = cfg.woq_kernel_resolved()
             log_dist(f"inference: int{cfg.quant_bits} WOQ, "
                      f"{quantized_bytes(self.params)/2**20:.0f}"
-                     f" MiB weights, tp={cfg.tensor_parallel}", ranks=[0])
+                     f" MiB weights, tp={cfg.tensor_parallel}, "
+                     f"kernel={self.model.woq_kernel}", ranks=[0])
         else:
             shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s if s is not None else P()),
@@ -112,6 +143,56 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._fwd = jax.jit(self._forward_impl)
 
+    # ------------------------------------------------------------ qkv fuse
+    def _can_fuse_qkv(self, params) -> bool:
+        """Only decoder trunks that generate get the fused serving layout
+        (the training ``apply`` path reads per-projection names; encoder /
+        feature towers only ever run ``forward``, which would pay the
+        unfuse slicing for nothing)."""
+        layers = params.get("layers") if isinstance(params, dict) else None
+        return (getattr(self.model.cfg, "objective", None) == "clm"
+                and isinstance(layers, dict)
+                and all(k in layers for k in ("wq", "wk", "wv")))
+
+    def _fuse_qkv_params(self, params):
+        layers = dict(params["layers"])
+        layers["wqkv"] = jnp.concatenate(
+            [layers.pop("wq"), layers.pop("wk"), layers.pop("wv")], axis=-1)
+        if all(k in layers for k in ("bq", "bk", "bv")):
+            layers["bqkv"] = jnp.concatenate(
+                [layers.pop("bq"), layers.pop("bk"), layers.pop("bv")],
+                axis=-1)
+        return {**params, "layers": layers}
+
+    def _fuse_qkv_specs(self, specs):
+        layers = dict(specs["layers"])
+        for k in ("wq", "wk", "wv"):
+            layers.pop(k, None)
+        layers["wqkv"] = P(None, None, "model")
+        if "bq" in layers:
+            for k in ("bq", "bk", "bv"):
+                layers.pop(k, None)
+            layers["bqkv"] = P(None, "model")
+        return {**specs, "layers": layers}
+
+    def _unfused(self, params):
+        """Split the serving tree's fused qkv back into per-projection
+        leaves (XLA slices; only the cold ``forward`` path pays this)."""
+        if not self._fused:
+            return params
+        cfg = self.model.cfg
+        qd = cfg.n_head * cfg.head_dim
+        kvd = cfg.kv_heads * cfg.head_dim
+        layers = dict(params["layers"])
+        w = layers.pop("wqkv")
+        layers["wq"], layers["wk"], layers["wv"] = (
+            w[..., :qd], w[..., qd:qd + kvd], w[..., qd + kvd:])
+        if "bqkv" in layers:
+            b = layers.pop("bqkv")
+            layers["bq"], layers["bk"], layers["bv"] = (
+                b[..., :qd], b[..., qd:qd + kvd], b[..., qd + kvd:])
+        return {**params, "layers": layers}
+
     # -------------------------------------------------------------- forward
     def _materialized(self, params):
         if self.config.quantize:
@@ -119,7 +200,8 @@ class InferenceEngine:
         return params
 
     def _forward_impl(self, params, input_ids):
-        return self.model.apply(self._materialized(params), input_ids)
+        return self.model.apply(self._unfused(self._materialized(params)),
+                                input_ids)
 
     def forward(self, input_ids) -> jnp.ndarray:
         """Full forward (no cache): (B, S) → (B, S, V) logits."""
@@ -134,16 +216,18 @@ class InferenceEngine:
                        greedy: bool):
         sampler = partial(sample_logits, temperature=temperature, top_k=top_k,
                           top_p=top_p, greedy=greedy)
-        # per_step: weights stay quantized and each decode step
-        # re-materializes in the scan body (generate_tokens docs)
-        per_step = self.config.quantize and self.config.dequant_per_step
+        # Quantized trees stay int8/int4 through the whole decode scan —
+        # the step's consumption sites dispatch per-use (generate_tokens
+        # docs). Only the prefill materializes (compute-bound; dense is
+        # right there). ``dequant_per_step`` is subsumed: decode never
+        # re-reads a dequantized copy anymore.
         return generate_tokens(
-            self.model, params if per_step else self._materialized(params),
+            self.model, params,
             input_ids, rng, max_new=max_new, sampler=sampler,
             eos_token_id=self.config.eos_token_id,
             cache_dtype=self.compute_dtype,
             flash_decode=self.config.flash_decode_resolved(),
-            materialize=self._materialized if per_step else None)
+            materialize=self._materialized if self.config.quantize else None)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)

@@ -163,6 +163,20 @@ class MoETransformerLM(TransformerLM):
             return u + p[name][:, None, :].astype(u.dtype)  # (E,f) -> (E,1,f)
         return u
 
+    @staticmethod
+    def _bank(p, name, dtype):
+        """Dense view of a (possibly int8/int4) expert bank at its point
+        of consumption. The decode engine keeps expert banks quantized in
+        HBM; the 3-D batched-expert einsum has no Pallas WOQ kernel (yet),
+        so the dequant happens per-use inside the decode step — in-scan,
+        never hoisted to a whole-bank bf16 copy across steps."""
+        w = p[name]
+        from ..inference.quantization import QuantizedTensor, dequantize
+
+        if isinstance(w, QuantizedTensor):
+            return dequantize(w, dtype)
+        return w.astype(dtype)
+
     # -------------------------------------------------------- inference MoE
     def _mlp_block_infer(self, y, p):
         """Single-group MoE dispatch for the T=1 KV-cache decode step
@@ -198,15 +212,15 @@ class MoETransformerLM(TransformerLM):
         # all-to-all the training path's constraint emits.
         xs = jnp.einsum("tec,td->ecd", dispatch.astype(y.dtype), yt)
         xs = constrain(xs, P("expert", None, None))
-        u = jnp.einsum("ecd,edf->ecf", xs, p["w_in"].astype(y.dtype))
+        u = jnp.einsum("ecd,edf->ecf", xs, self._bank(p, "w_in", y.dtype))
         u = self._expert_bias(u, p, "b_in")
         if cfg.is_glu:
-            g = jnp.einsum("ecd,edf->ecf", xs, p["w_gate"].astype(y.dtype))
+            g = jnp.einsum("ecd,edf->ecf", xs, self._bank(p, "w_gate", y.dtype))
             u = jax.nn.silu(g) * u
         else:
             u = _activation(u, cfg.activation)
         u = constrain(u, P("expert", None, "model"))
-        out = jnp.einsum("ecf,efd->ecd", u, p["w_out"].astype(y.dtype))
+        out = jnp.einsum("ecf,efd->ecd", u, self._bank(p, "w_out", y.dtype))
         out = self._expert_bias(out, p, "b_out")
         out = constrain(out, P("expert", None, None))
         res = jnp.einsum("tec,ecd->td", combine.astype(y.dtype), out)

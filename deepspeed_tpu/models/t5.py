@@ -410,7 +410,8 @@ class T5Model:
             return False
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:
-            if getattr(mesh, "manual_axes", frozenset()):
+            from ..platform.mesh import manual_axes_of
+            if manual_axes_of(mesh):
                 return False
             for ax in ("model", "seq", "pipe"):
                 if ax in mesh.axis_names and mesh.shape[ax] != 1:

@@ -222,8 +222,8 @@ def vocab_parallel_lookup(table, ids):
     activation-sized traffic instead of table-sized.
     """
     ctx = current_mesh()
-    manual = getattr(ctx, "manual_axes", frozenset()) if ctx is not None \
-        else frozenset()
+    from ..platform.mesh import manual_axes_of
+    manual = manual_axes_of(ctx) if ctx is not None else frozenset()
     if (ctx is None or "model" not in getattr(ctx, "axis_names", ())
             or ctx.shape["model"] == 1 or manual):
         return table[ids]
@@ -565,14 +565,29 @@ class TransformerLM:
         o = self._maybe_bias(o.reshape(B, S, h * hd) @ p["wo"].astype(x.dtype), p, "bo")
         return o
 
+    def _proj(self, y, p, name):
+        """``y @ p[name]`` whether the weight is dense or int8/int4
+        (inference WOQ: the engine keeps weights quantized end-to-end and
+        the decode step consumes them at the point of use — via the fused
+        Pallas GEMM when ``self.woq_kernel`` is set, else a per-use XLA
+        dequant). Training trees never carry quantized leaves, so this is
+        a plain matmul there."""
+        w = p[name]
+        from ..inference.quantization import QuantizedTensor, woq_dot
+
+        if isinstance(w, QuantizedTensor):
+            return woq_dot(y, w, use_kernel=getattr(self, "woq_kernel",
+                                                    False))
+        return y @ w.astype(y.dtype)
+
     def _mlp_block(self, y, p):
         """FFN half. Returns (out, aux_loss); MoE trunks override this."""
         cfg = self.cfg
-        u = self._maybe_bias(y @ p["w_in"].astype(y.dtype), p, "b_in")
+        u = self._maybe_bias(self._proj(y, p, "w_in"), p, "b_in")
         if cfg.is_glu:
             # GLU: tag the gated product — bwd still recomputes the gate
             # matmul for the silu grad, but w_out's input is saved
-            u = jax.nn.silu(y @ p["w_gate"].astype(y.dtype)) * u
+            u = jax.nn.silu(self._proj(y, p, "w_gate")) * u
             u = checkpoint_name(u, "mlp_h")
         else:
             # Tag the PRE-activation: under save_names_mlp the bwd then
@@ -582,7 +597,7 @@ class TransformerLM:
             u = checkpoint_name(u, "mlp_h")
             u = _activation(u, cfg.activation)
         u = constrain(u, P(B_AXES, "seq", "model"))
-        out = self._maybe_bias(u @ p["w_out"].astype(y.dtype), p, "b_out")
+        out = self._maybe_bias(self._proj(u, p, "w_out"), p, "b_out")
         return out, jnp.float32(0.0)
 
     def _layer(self, x, layer_params, positions, attn_mask):
@@ -821,7 +836,8 @@ class TransformerLM:
             return False
         mesh = current_mesh()
         if mesh is not None and not mesh.empty:
-            if getattr(mesh, "manual_axes", frozenset()):
+            from ..platform.mesh import manual_axes_of
+            if manual_axes_of(mesh):
                 return False
             for ax in ("seq", "pipe"):
                 if ax in mesh.axis_names and mesh.shape[ax] != 1:

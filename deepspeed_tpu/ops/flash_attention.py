@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 
 BIG_NEG = -2.0 ** 30
 SUBLANES = 8  # fp32 sublane tile: lse/delta rows replicated to (8, S)
-_warned_f16_fallback = False  # one warning per process (HBM-cliff notice)
+# fallback notices warn once per process via utils.logging.warning_once
 
 
 # ---------------------------------------------------------------- forward
@@ -838,15 +838,37 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     block 128 → 421.5 ms/step, 256 → 334.9, 512 → 305.5 — wider tiles
     feed the MXU 512-wide dots and cut the kv-loop trips 4×; a (512,
     512) f32 score tile is ~1 MiB of VMEM, comfortably under budget).
-    Shapes not divisible by the block clamp it to S (single tile).
+    Shapes not divisible by the block clamp it to S (single tile), then
+    shrink toward the largest power-of-two divisor of S ≥ 128 (512 → 256
+    → 128, one-shot warning) so S = 768/1152/1920 stay fused.
 
-    The only remaining fallback is S not divisible by the (clamped)
-    block tile.
+    The only remaining fallback is S with no fused-eligible divisor
+    (warned once — the dense path is an HBM cliff at long sequence).
     """
     B, S, H, hd = q.shape
     assert bias is None or alibi_slopes is None, \
         "pass either bias or alibi_slopes, not both"
     blk = min(block, S)
+    if S % blk != 0:
+        # Shrink to the largest halving of the block ≥ 128 that divides S
+        # before giving up: S = 768/1152/1920 are divisible by 256 or 128
+        # and must stay fused — the dense fallback materializes
+        # (B, H, S, S) scores. Candidates derive from blk (a 1024 caller
+        # block still tries 512 first), wider-first because wider tiles
+        # feed the MXU better (the 512-vs-256 A/B in the docstring).
+        cand = blk // 2
+        while cand >= 128:
+            if S % cand == 0:
+                from ..utils.logging import warning_once
+
+                warning_once(
+                    f"flash_attention: seq {S} not divisible by block "
+                    f"{blk}; shrinking to {cand} to stay on the fused "
+                    "path (wider tiles feed the MXU better — pad S to "
+                    f"a multiple of {blk} to avoid the shrink)")
+                blk = cand
+                break
+            cand //= 2
     # Mosaic has no f16: fp16-compute inputs (any of q/k/v — an fp16 KV
     # cache under a bf16 trunk counts) take the same XLA fallback as
     # non-divisible shapes; bf16/f32 stay fused. Warn ONCE for the f16
@@ -855,17 +877,22 @@ def flash_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     f16_in = any(jnp.dtype(x.dtype) == jnp.float16 for x in (q, k, v)) \
         and jax.default_backend() == "tpu"
     if f16_in:
-        global _warned_f16_fallback
-        if not _warned_f16_fallback:
-            _warned_f16_fallback = True
-            from ..utils.logging import logger
+        from ..utils.logging import warning_once
 
-            logger.warning(
-                "flash_attention: float16 inputs fall back to the dense "
-                "XLA path on TPU (Mosaic has no f16). The dense path "
-                "materializes (B, H, S, S) scores — prefer bf16 compute "
-                "for long sequences.")
+        warning_once(
+            "flash_attention: float16 inputs fall back to the dense "
+            "XLA path on TPU (Mosaic has no f16). The dense path "
+            "materializes (B, H, S, S) scores — prefer bf16 compute "
+            "for long sequences.")
     if f16_in or S % blk != 0:
+        if S % blk != 0:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                f"flash_attention: seq {S} has no fused-eligible block "
+                f"divisor (tried {blk}, 256, 128); demoting to the "
+                "dense XLA path, which materializes (B, H, S, S) "
+                "scores in HBM")
         from ..models.transformer import alibi_bias, causal_attention
 
         if alibi_slopes is not None:

@@ -373,11 +373,31 @@ _sparse.defvjp(_sparse_fwd, _sparse_bwd)
 # ------------------------------------------------------------- public API
 def sparse_attention(q, k, v, config: SparsityConfig, *, causal: bool = True,
                      interpret: Optional[bool] = None):
-    """Block-sparse attention. q: (B, S, H, hd); k/v: (B, S, KV, hd)."""
+    """Block-sparse attention. q: (B, S, H, hd); k/v: (B, S, KV, hd).
+
+    float16 inputs on TPU take a dense masked fallback (the layout expanded
+    to an elementwise score bias) instead of the Pallas kernels — Mosaic
+    has no f16. Warned once, mirroring flash_attention's gate."""
     B, S, H, hd = q.shape
     block = config.block
     if S % block != 0:
         raise ValueError(f"seq {S} not divisible by sparsity block {block}")
+    if any(jnp.dtype(x.dtype) == jnp.float16 for x in (q, k, v)) \
+            and jax.default_backend() == "tpu":
+        from ..utils.logging import warning_once
+
+        warning_once(
+            "sparse_attention: float16 inputs fall back to dense masked "
+            "attention on TPU (Mosaic has no f16) — the layout becomes "
+            "an (S, S) additive bias and full scores materialize; "
+            "prefer bf16 compute for long sequences.")
+        from ..models.transformer import causal_attention
+
+        layout = config.make_layout(S // block)
+        allowed = np.kron(layout, np.ones((block, block), bool))
+        bias = jnp.where(jnp.asarray(allowed), 0.0, BIG_NEG
+                         ).astype(jnp.float32)
+        return causal_attention(q, k, v, causal=causal, bias=bias)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     KV = k.shape[2]

@@ -213,17 +213,28 @@ def fused_xent_eligible(cfg_dtype, compute_dtype, d_model: int) -> bool:
     return fused_xent_eligible_d(d_model)
 
 
+def _pow2_floor_tile(b):
+    """Normalize a user block to a lane-aligned power of two: a 192 block
+    would otherwise reach Mosaic as a misaligned 192-lane tile whenever
+    the VMEM budget doesn't force shrinking (the shrink-loop clamp alone
+    only covers the shrinking case)."""
+    p = 1 << (int(b).bit_length() - 1)       # power-of-two floor
+    return max(_MIN_TILE, p)
+
+
 def _blocks(T, V, block_t, block_v, d=0):
-    bt = min(block_t, _pow2_ceil(T))
-    bv = min(block_v, _pow2_ceil(V))
+    bt = min(_pow2_floor_tile(block_t), _pow2_ceil(T))
+    bv = min(_pow2_floor_tile(block_v), _pow2_ceil(V))
     # shrink tiles (largest first) until the ELEMENT budget holds at this
-    # d — a ratio-with-floor underestimates past d~4096 (round-5 review)
+    # d — a ratio-with-floor underestimates past d~4096 (round-5 review).
+    # Each halving clamps at _MIN_TILE: a non-power-of-two user block
+    # (e.g. 192) must land on the 128 lane floor, not sail past it to 96.
     while d and (bt + bv) * d > _TILE_ELEM_BUDGET \
             and (bt > _MIN_TILE or bv > _MIN_TILE):
         if bv >= bt and bv > _MIN_TILE:
-            bv //= 2
+            bv = max(_MIN_TILE, bv // 2)
         else:
-            bt //= 2
+            bt = max(_MIN_TILE, bt // 2)
     return bt, bv
 
 

@@ -136,11 +136,14 @@ def current_mesh():
     """The mesh active in this trace/context, or None. Checks the abstract
     mesh first (``jax.set_mesh`` / inside-jit), then the legacy
     ``with mesh:`` thread resources."""
-    from jax.sharding import get_abstract_mesh
-
-    ctx = get_abstract_mesh()
-    if ctx is not None and not ctx.empty:
-        return ctx
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:          # jax 0.4.x: no abstract-mesh API
+        get_abstract_mesh = None
+    if get_abstract_mesh is not None:
+        ctx = get_abstract_mesh()
+        if ctx is not None and not ctx.empty:
+            return ctx
     try:
         from jax._src.mesh import thread_resources
 
@@ -150,6 +153,26 @@ def current_mesh():
     return None if (ctx is None or ctx.empty) else ctx
 
 
+def manual_axes_of(mesh) -> frozenset:
+    """Axis names that are *manual* in the current trace context — i.e.
+    the caller already holds a per-device block of them (inside a
+    shard_map body). jax 0.9 exposes this as ``AbstractMesh.manual_axes``;
+    on 0.4.x the physical mesh carries no such attribute, but the bound
+    axis-env names ARE the manual axes."""
+    manual = getattr(mesh, "manual_axes", None)
+    if manual is not None:
+        # present-but-empty is an ANSWER (nothing manual) — falling
+        # through to the axis-env probe would misreport vmap/pmap
+        # axis_name frames as manual mesh axes
+        return frozenset(manual)
+    try:
+        from jax.core import unsafe_get_axis_names_DO_NOT_USE as _names
+
+        return frozenset(_names())
+    except (ImportError, AttributeError):
+        return frozenset()
+
+
 def constrain(x, *spec_or_pspec):
     """``with_sharding_constraint`` that no-ops when no mesh is in context
     (single-chip / un-meshed execution) and ignores axes the context mesh
@@ -157,11 +180,18 @@ def constrain(x, *spec_or_pspec):
     (the caller already holds a per-device block of those). Models use this
     so the same code runs on a bare chip, on any parallel mesh, and inside
     partially-manual shard_maps (e.g. the compressed-gradient data axis)."""
-    if current_mesh() is None:
+    ctx = current_mesh()
+    if ctx is None:
         return x
     spec = spec_or_pspec[0] if len(spec_or_pspec) == 1 and isinstance(
         spec_or_pspec[0], PartitionSpec) else PartitionSpec(*spec_or_pspec)
-    return jax.lax.with_sharding_constraint(x, filter_spec(spec))
+    filtered = filter_spec(spec)
+    # Inside a manual region a fully-filtered (all-None) constraint is a
+    # no-op intent-wise; older JAX additionally has no replication rule
+    # for the primitive there (check_rep) — skip it outright.
+    if manual_axes_of(ctx) and all(e is None for e in filtered):
+        return x
+    return jax.lax.with_sharding_constraint(x, filtered)
 
 
 def filter_spec(spec: PartitionSpec) -> PartitionSpec:
@@ -169,7 +199,7 @@ def filter_spec(spec: PartitionSpec) -> PartitionSpec:
     ctx = current_mesh()
     if ctx is None:
         return spec
-    manual = getattr(ctx, "manual_axes", frozenset())
+    manual = manual_axes_of(ctx)
 
     def filter_entry(e):
         if e is None:
@@ -194,7 +224,14 @@ def to_device_memory(tree, spec_tree=None):
     def put(x, spec):
         spec = filter_spec(spec if isinstance(spec, PartitionSpec)
                            else PartitionSpec())
-        return jax.device_put(x, NamedSharding(ctx, spec, memory_kind="device"))
+        try:
+            return jax.device_put(
+                x, NamedSharding(ctx, spec, memory_kind="device"))
+        except ValueError:
+            # backends without an addressable "device" memory kind (older
+            # JAX CPU exposes only unpinned_host): the page-in is a no-op
+            # placement-wise but keeps the sharding
+            return jax.device_put(x, NamedSharding(ctx, spec))
 
     if spec_tree is None:
         return jax.tree.map(lambda x: put(x, None), tree)

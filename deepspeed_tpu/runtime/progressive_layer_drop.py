@@ -51,11 +51,11 @@ class PLDMixin:
         if self.pld_step is None:
             return super()._scan_layers(x, layers, positions, attn_mask,
                                         remat_policy)
-        from ..platform.mesh import current_mesh
+        from ..platform.mesh import current_mesh, manual_axes_of
         mesh = current_mesh()
         if (mesh is not None and not mesh.empty
                 and int(mesh.shape.get("pipe", 1)) != 1
-                and "pipe" not in getattr(mesh, "manual_axes", frozenset())):
+                and "pipe" not in manual_axes_of(mesh)):
             # A pipe-sharded mesh whose pipe axis is NOT manual means this
             # trunk is running outside the pipeline engine's shard_map:
             # axis_index("pipe") is unbound, the stage offset silently

@@ -171,7 +171,7 @@ def test_moe_woq_generation_router_stays_full_precision():
     # keeps the router fp32 (training engine parity)
     bf = ds.init_inference(model, params, {"dtype": "bfloat16"})
     assert bf.params["layers"]["router"].dtype == jnp.float32
-    assert bf.params["layers"]["wq"].dtype == jnp.bfloat16
+    assert bf.params["layers"]["wqkv"].dtype == jnp.bfloat16
 
 
 def test_moe_expert_parallel_serving(devices):
@@ -280,10 +280,14 @@ def test_woq_tp_matches_tp1(devices):
                                              "quantize": True,
                                              "quant_group_size": 16,
                                              "tensor_parallel": 2})
-    qt = woq2.params["layers"]["wq"]
+    # the serving tree fuses the attention projections: one column-sharded
+    # [wq | wk | wv] weight whose scales shard alongside it
+    qt = woq2.params["layers"]["wqkv"]
     assert isinstance(qt, QuantizedTensor)
     assert "model" in jax.tree.leaves(tuple(qt.q.sharding.spec)), \
         qt.q.sharding.spec
+    assert "model" in jax.tree.leaves(tuple(qt.scale.sharding.spec)), \
+        qt.scale.sharding.spec
     got = np.asarray(woq2.generate(ids, 5, greedy=True))
     np.testing.assert_array_equal(got, want)
 
@@ -331,7 +335,7 @@ def test_int4_woq_quantization():
     w = jnp.asarray(rng.standard_normal((64, 256)), jnp.float32)
     q8 = quantize(w, group_size=64, bits=8)
     q4 = quantize(w, group_size=64, bits=4)
-    assert q4.q.shape == (64, 128)           # two nibbles per byte
+    assert q4.q.shape == (32, 256)           # adjacent-row nibble pairs
     assert q4.shape == w.shape
     err8 = float(jnp.max(jnp.abs(dequantize(q8, jnp.float32) - w)))
     err4 = float(jnp.max(jnp.abs(dequantize(q4, jnp.float32) - w)))
@@ -356,15 +360,18 @@ def test_int4_woq_quantization():
 
 
 def test_int4_odd_dim_degrades_to_int8():
-    """A weight whose last dim can't nibble-pack must degrade per-leaf to
-    int8, not abort engine init (GPT-2's odd vocab head)."""
+    """A weight whose grouped (second-to-last) dim can't row-pack must
+    degrade per-leaf to int8, not abort engine init — GPT-2's odd
+    50257-row vocab table is the real-world hit: 50257 % 128 != 0
+    degrades it to ONE whole group, which is odd, so int4 can't pair
+    rows."""
     import jax.numpy as jnp
     import numpy as np
     from deepspeed_tpu.inference.quantization import dequantize, quantize
 
-    w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 50257)),
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((50257, 16)),
                     jnp.float32)
-    q = quantize(w, group_size=128, bits=4)   # 50257 % 128 != 0, odd last
+    q = quantize(w, group_size=128, bits=4)
     assert q.bits == 8 and q.q.shape == w.shape
     err = float(jnp.max(jnp.abs(dequantize(q, jnp.float32) - w)))
     assert err < float(jnp.max(jnp.abs(w))) / 64
