@@ -17,6 +17,7 @@ from .runtime.hybrid_engine import HybridEngine
 from .version import __version__
 
 from . import comm  # noqa: F401  (deepspeed.comm analog)
+from . import observability  # noqa: F401  (metrics/tracing/sinks layer)
 
 __all__ = ["initialize", "Engine", "HybridEngine", "Config",
            "init_inference", "InferenceEngine", "InferenceConfig",

@@ -70,10 +70,25 @@ class CommsLogger:
             d["mbytes"] += ev.bytes / 1e6
         return out
 
-    def log_summary(self) -> None:
-        for key, d in self.summary().items():
+    def log_summary(self) -> dict[str, dict[str, float]]:
+        """Log the aggregate per-op/axis volumes AND return them (the
+        reference's version was log-line-only; returning the dict makes the
+        ledger testable and lets callers export it as monitor events)."""
+        out = self.summary()
+        for key, d in out.items():
             log_dist(f"comm summary | {key}: n={int(d['count'])} vol={d['mbytes']:.1f} MB",
                      ranks=[0])
+        return out
+
+    def as_monitor_events(self, step: int = 0) -> list[tuple]:
+        """Ledger → ``(name, value, step)`` tuples under the ``Comm/*``
+        namespace, ready for ``MonitorMaster.write_events`` or a
+        ``MetricsRegistry``."""
+        events: list[tuple] = []
+        for key, d in sorted(self.summary().items()):
+            events.append((f"Comm/{key}/count", float(d["count"]), step))
+            events.append((f"Comm/{key}/mbytes", float(d["mbytes"]), step))
+        return events
 
     def reset(self) -> None:
         self.events.clear()

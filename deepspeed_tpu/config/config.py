@@ -147,6 +147,11 @@ class MonitorConfig(ConfigModel):
     tensorboard: dict[str, Any] = Field(default_factory=dict)
     csv_monitor: dict[str, Any] = Field(default_factory=dict)
     wandb: dict[str, Any] = Field(default_factory=dict)
+    # Machine-readable sinks (observability/sinks.py): JSONL event log and
+    # Prometheus textfile exporter. Same shape as the other backends:
+    # {"enabled": true, "output_path": ..., "job_name": ...}.
+    jsonl: dict[str, Any] = Field(default_factory=dict)
+    prometheus: dict[str, Any] = Field(default_factory=dict)
 
     def any_enabled(self) -> bool:
         """A backend-level ``"enabled": true`` must not be silently ignored
@@ -154,7 +159,25 @@ class MonitorConfig(ConfigModel):
         per-backend blocks directly, with no outer gate)."""
         return bool(self.enabled or self.tensorboard.get("enabled")
                     or self.csv_monitor.get("enabled")
-                    or self.wandb.get("enabled"))
+                    or self.wandb.get("enabled")
+                    or self.jsonl.get("enabled")
+                    or self.prometheus.get("enabled"))
+
+
+class ObservabilityConfig(ConfigModel):
+    """Training-side observability (``observability/``): metrics registry
+    emission cadence, HBM-watermark sampling, and windowed XLA trace
+    capture. The registry itself always records (host-side floats, no
+    device sync); these knobs control the extra host work.
+    """
+
+    # Sample platform memory_stats() into Memory/* gauges at report
+    # boundaries (one cheap host call per steps_per_print, never per step).
+    hbm_watermark: bool = True
+    # (start, stop) global-step window to capture an XLA profiler trace
+    # around, e.g. [100, 104]; None = no capture.
+    trace_steps: Optional[list[int]] = None
+    trace_dir: str = "./xla_trace"
 
 
 class CommsLoggerConfig(ConfigModel):
@@ -358,6 +381,8 @@ class Config(ConfigModel):
     mesh: MeshConfig = Field(default_factory=MeshConfig)
     remat: RematConfig = Field(default_factory=RematConfig)
     monitor: MonitorConfig = Field(default_factory=MonitorConfig)
+    observability: ObservabilityConfig = Field(
+        default_factory=ObservabilityConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)

@@ -44,6 +44,15 @@ class InferenceConfig:
     # site, so there is no hoisted whole-tree dequant to toggle anymore
     # (round-5 WOQ_PROBE showed XLA hoisting it either way).
     dequant_per_step: bool = False
+    # Request tracing (observability/tracing.py): every generate() records
+    # TTFT, per-token decode latency, tokens/s, and roofline MBU into a
+    # ring buffer surfaced by InferenceEngine.metrics_snapshot(). When on,
+    # generation compiles as two programs (prefill / decode scan) and pays
+    # ONE extra host sync per request — never one per token. When off
+    # (default), generate() keeps the single fused program and adds no
+    # host synchronization at all.
+    observability: bool = False
+    trace_ring_size: int = 256
 
     def flash_decode_resolved(self) -> bool:
         if self.flash_decode is not None:

@@ -131,6 +131,7 @@ def _qkv_proj(model, y, p):
             v.reshape(B, T, kv, hd))
 
 
+@jax.named_scope("decode_layer")
 def _layer_step(model, x, p, cache_k, cache_v, length, positions,
                 flash_decode: bool = False):
     """One transformer layer over x: (B, T, d), reading/writing the cache.
@@ -275,18 +276,22 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     return logits, KVCache(k=ck, v=cv, length=new_len)
 
 
-def generate_tokens(model, params, input_ids, rng, *, max_new: int,
-                    sampler, eos_token_id=None, cache_dtype=None,
-                    flash_decode: bool = False, materialize=None):
-    """Shared prefill + decode-scan generation loop.
+class GenCarry(NamedTuple):
+    """Generation state between the prefill and the decode scan."""
 
-    Used by both :class:`~deepspeed_tpu.inference.InferenceEngine` and the
-    RLHF :class:`~deepspeed_tpu.runtime.hybrid_engine.HybridEngine` so the
-    schedule/eos logic cannot drift between them. ``sampler(logits, rng)``
-    -> (B,) int32.
+    tok: jnp.ndarray         # (B,) i32 — latest sampled token
+    cache: KVCache
+    rng: jnp.ndarray
+    done: jnp.ndarray        # (B,) bool — eos reached
+
+
+def prefill_tokens(model, params, input_ids, rng, *, max_new: int,
+                   sampler, eos_token_id=None, cache_dtype=None,
+                   flash_decode: bool = False, materialize=None) -> GenCarry:
+    """Prompt → first sampled token + primed KV cache (the TTFT phase).
 
     ``materialize``: optional ``quantized params -> dense params`` fn,
-    applied ONLY to the prefill (compute-bound; dense is right there).
+    applied ONLY here (prefill is compute-bound; dense is right there).
     The decode scan consumes ``params`` as given: a quantized tree stays
     int8/int4 end-to-end — every projection dispatches through
     ``matmul_any``/``woq_dot_t`` at its point of use, so the weight bytes
@@ -296,11 +301,6 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
     the loop-invariant dequant and decode re-read a bf16 copy
     (``WOQ_PROBE.json`` round 5) — which is why the consumption sites
     dispatch explicitly now.
-
-    The prefill + decode scan share one jitted program; the KV cache
-    threads through the scan carry, so XLA reuses (donates) the cache
-    buffers in place — cache update and attend live in the same scan body
-    with no copy between steps.
     """
     objective = getattr(model.cfg, "objective", "clm")
     if objective != "clm":
@@ -316,27 +316,67 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
         # on the streaming kernel regardless of prompt/output lengths
         cache_len = -(-cache_len // 128) * 128
     cache = init_cache(model.cfg, B, cache_len, cache_dtype or model.cfg.dtype)
-    eos = eos_token_id
     mat = materialize if materialize is not None else (lambda p: p)
 
-    logits, cache = forward_with_cache(model, mat(params), input_ids, cache,
-                                       last_token_head=True)
+    with jax.named_scope("prefill"):
+        logits, cache = forward_with_cache(model, mat(params), input_ids,
+                                           cache, last_token_head=True)
     rng, sub = jax.random.split(rng)
     tok = sampler(logits[:, -1], sub)
-    done = (tok == eos) if eos is not None else jnp.zeros((B,), bool)
+    done = (tok == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((B,), bool)
+    return GenCarry(tok=tok, cache=cache, rng=rng, done=done)
+
+
+def decode_tokens(model, params, carry: GenCarry, *, steps: int, sampler,
+                  eos_token_id=None, flash_decode: bool = False):
+    """Decode scan: ``steps`` more tokens after the carry's.
+
+    Returns (B, steps + 1) — the carry token plus everything it generated.
+    The KV cache threads through the scan carry, so XLA reuses (donates)
+    the cache buffers in place — cache update and attend live in the same
+    scan body with no copy between steps.
+    """
+    eos = eos_token_id
 
     def step(carry, _):
         tok, cache, rng, done = carry
-        lg, cache = forward_with_cache(model, params, tok[:, None], cache,
-                                       flash_decode=flash_decode)
+        with jax.named_scope("decode_step"):
+            lg, cache = forward_with_cache(model, params, tok[:, None], cache,
+                                           flash_decode=flash_decode)
         rng, sub = jax.random.split(rng)
         nxt = sampler(lg[:, 0], sub)
         if eos is not None:
             nxt = jnp.where(done, eos, nxt)
             done = done | (nxt == eos)
-        return (nxt, cache, rng, done), tok
+        return GenCarry(nxt, cache, rng, done), tok
 
-    (tok, _, _, _), toks = lax.scan(step, (tok, cache, rng, done), None,
-                                    length=max_new - 1)
-    # emitted tokens 0..max_new-2 plus the final carry token
-    return jnp.concatenate([toks, tok[None]], axis=0).T  # (B, max_new)
+    out, toks = lax.scan(step, carry, None, length=steps)
+    # emitted tokens 0..steps-1 plus the final carry token
+    return jnp.concatenate([toks, out.tok[None]], axis=0).T  # (B, steps + 1)
+
+
+def generate_tokens(model, params, input_ids, rng, *, max_new: int,
+                    sampler, eos_token_id=None, cache_dtype=None,
+                    flash_decode: bool = False, materialize=None):
+    """Shared prefill + decode-scan generation loop, as ONE traceable fn.
+
+    Used by both :class:`~deepspeed_tpu.inference.InferenceEngine` and the
+    RLHF :class:`~deepspeed_tpu.runtime.hybrid_engine.HybridEngine` so the
+    schedule/eos logic cannot drift between them. ``sampler(logits, rng)``
+    -> (B,) int32.
+
+    Composes :func:`prefill_tokens` + :func:`decode_tokens` inside one
+    trace — jitted as a unit this is the zero-host-sync fast path (nothing
+    leaves the device between prompt in and tokens out). The engine's
+    request-tracing mode jits the two halves separately instead, buying an
+    honest TTFT / per-token-latency split for exactly one extra host sync
+    per request (see ``InferenceEngine.generate``).
+    """
+    carry = prefill_tokens(model, params, input_ids, rng, max_new=max_new,
+                           sampler=sampler, eos_token_id=eos_token_id,
+                           cache_dtype=cache_dtype, flash_decode=flash_decode,
+                           materialize=materialize)
+    return decode_tokens(model, params, carry, steps=max_new - 1,
+                         sampler=sampler, eos_token_id=eos_token_id,
+                         flash_decode=flash_decode)

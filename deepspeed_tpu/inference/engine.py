@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..platform.mesh import MeshSpec, build_mesh
 from ..utils.logging import log_dist
 from .config import InferenceConfig
-from .decode import generate_tokens
+from .decode import decode_tokens, generate_tokens, prefill_tokens
 from .quantization import (dequantize_params, quantize_params,
                            quantized_bytes, quantized_shardings)
 from .sampling import sample_logits
@@ -142,6 +142,30 @@ class InferenceEngine:
         self._gen_cache: OrderedDict = OrderedDict()
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._fwd = jax.jit(self._forward_impl)
+        # Request tracing (observability): ring buffer + Serve/* registry.
+        # Built lazily-enough that the disabled path allocates nothing and
+        # generate() stays on the single fused program with zero added
+        # host syncs.
+        self.tracer = None
+        if cfg.observability:
+            from ..observability.tracing import RequestTracer
+            from ..utils.timer import peak_hbm_bw_for
+            from .quantization import decode_weight_bytes
+
+            try:
+                peak_bw = peak_hbm_bw_for(jax.devices()[0])
+            except ValueError as e:
+                # Unknown hardware must not break serving — latencies still
+                # trace; only the MBU attribution goes dark.
+                log_dist(f"inference observability: MBU disabled ({e})",
+                         ranks=[0])
+                peak_bw = None
+            self.tracer = RequestTracer(
+                ring_size=cfg.trace_ring_size,
+                bytes_per_step=decode_weight_bytes(self.params),
+                peak_bw=peak_bw)
+            self._prefill_cache: OrderedDict = OrderedDict()
+            self._decode_cache: OrderedDict = OrderedDict()
 
     # ------------------------------------------------------------ qkv fuse
     def _can_fuse_qkv(self, params) -> bool:
@@ -214,8 +238,6 @@ class InferenceEngine:
     def _generate_impl(self, params, input_ids, rng, *, max_new: int,
                        temperature: float, top_k: int, top_p: float,
                        greedy: bool):
-        sampler = partial(sample_logits, temperature=temperature, top_k=top_k,
-                          top_p=top_p, greedy=greedy)
         # Quantized trees stay int8/int4 through the whole decode scan —
         # the step's consumption sites dispatch per-use (generate_tokens
         # docs). Only the prefill materializes (compute-bound; dense is
@@ -223,11 +245,36 @@ class InferenceEngine:
         # re-reads a dequantized copy anymore.
         return generate_tokens(
             self.model, params,
-            input_ids, rng, max_new=max_new, sampler=sampler,
+            input_ids, rng, max_new=max_new,
+            sampler=self._sampler(temperature, top_k, top_p, greedy),
             eos_token_id=self.config.eos_token_id,
             cache_dtype=self.compute_dtype,
             flash_decode=self.config.flash_decode_resolved(),
             materialize=self._materialized if self.config.quantize else None)
+
+    def _sampler(self, temperature: float, top_k: int, top_p: float,
+                 greedy: bool):
+        return partial(sample_logits, temperature=temperature, top_k=top_k,
+                       top_p=top_p, greedy=greedy)
+
+    def _prefill_impl(self, params, input_ids, rng, *, max_new: int,
+                      temperature: float, top_k: int, top_p: float,
+                      greedy: bool):
+        return prefill_tokens(
+            self.model, params, input_ids, rng, max_new=max_new,
+            sampler=self._sampler(temperature, top_k, top_p, greedy),
+            eos_token_id=self.config.eos_token_id,
+            cache_dtype=self.compute_dtype,
+            flash_decode=self.config.flash_decode_resolved(),
+            materialize=self._materialized if self.config.quantize else None)
+
+    def _decode_impl(self, params, carry, *, steps: int, temperature: float,
+                     top_k: int, top_p: float, greedy: bool):
+        return decode_tokens(
+            self.model, params, carry, steps=steps,
+            sampler=self._sampler(temperature, top_k, top_p, greedy),
+            eos_token_id=self.config.eos_token_id,
+            flash_decode=self.config.flash_decode_resolved())
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -254,19 +301,95 @@ class InferenceEngine:
         max_new = int(max_new_tokens or self.config.max_out_tokens)
         key = (input_ids.shape, max_new, float(temperature), int(top_k),
                float(top_p), bool(greedy))
-        fn = self._gen_cache.get(key)
-        if fn is None:
-            fn = jax.jit(partial(
-                self._generate_impl, max_new=max_new, temperature=temperature,
-                top_k=top_k, top_p=top_p, greedy=greedy))
-            self._gen_cache[key] = fn
-            if len(self._gen_cache) > _MAX_COMPILED_SHAPES:
-                self._gen_cache.popitem(last=False)
-        else:
-            self._gen_cache.move_to_end(key)
         rng = rng if rng is not None else self._next_rng()
+        knobs = dict(temperature=temperature, top_k=top_k, top_p=top_p,
+                     greedy=greedy)
+        if self.tracer is not None:
+            return self._traced_generate(input_ids, rng, key, max_new, knobs)
+        # Fast path: ONE fused prefill+decode program, nothing read back to
+        # the host until the caller consumes the tokens — tracing disabled
+        # means zero added synchronization.
+        fn = self._cached(self._gen_cache, key, lambda: jax.jit(
+            partial(self._generate_impl, max_new=max_new, **knobs)))
         with self.mesh:
             return fn(self.params, input_ids, rng)
+
+    @staticmethod
+    def _cached(cache: OrderedDict, key, build):
+        """Get-or-build with the engine's bounded-LRU policy (one policy,
+        three program caches: fused / prefill / decode)."""
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = build()
+            if len(cache) > _MAX_COMPILED_SHAPES:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+        return fn
+
+    def _traced_generate(self, input_ids, rng, key, max_new: int,
+                         knobs: dict):
+        """Request-traced generation: prefill and decode as two compiled
+        programs so their wall times are separable (TTFT vs per-token
+        decode). Costs one host sync between the phases; tokens match the
+        fused path bit-for-bit (same sampler chain, same rng splits)."""
+        B, S = input_ids.shape
+        cold = key not in self._prefill_cache
+        pf = self._cached(self._prefill_cache, key, lambda: jax.jit(
+            partial(self._prefill_impl, max_new=max_new, **knobs)))
+        # The carry (KV cache above all) is dead after the decode call:
+        # donate it so the scan reuses the prefill cache buffers in place —
+        # matching the fused path, where the cache lives in the scan carry
+        # and is never copied. Without donation each traced request would
+        # hold two full caches and pay a copy the tracer then mis-attributes
+        # to decode time.
+        dc = self._cached(self._decode_cache, key, lambda: jax.jit(
+            partial(self._decode_impl, steps=max_new - 1, **knobs),
+            donate_argnums=(1,)))
+        clock = self.tracer.clock
+        t0 = clock()
+        with self.mesh:
+            carry = pf(self.params, input_ids, rng)
+            jax.block_until_ready(carry)
+            t1 = clock()
+            out = dc(self.params, carry)
+            jax.block_until_ready(out)
+        t2 = clock()
+        self.tracer.observe(batch=B, prompt_len=S, new_tokens=max_new,
+                            prefill_s=t1 - t0, decode_s=t2 - t1, cold=cold)
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        """Serving metrics: request count, TTFT / per-token-latency
+        percentiles, tokens/s, achieved weight-GB/s and decode MBU, plus
+        the most recent request records. ``{"tracing": False}`` when the
+        engine was built without ``observability`` (the zero-sync path
+        records nothing)."""
+        if self.tracer is None:
+            return {"tracing": False, "requests": 0}
+        return {"tracing": True, **self.tracer.snapshot()}
+
+    def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
+        """Push the ``Serve/*`` registry through a monitor fan-out — a
+        :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster` or anything
+        with ``write_events([(name, value, step)])``.
+
+        Unlike the training engine (whose step loop flushes its sinks at
+        report boundaries), serving has no universal cadence — the
+        serving loop owns it: call this from a timer or every N requests.
+        ``step`` defaults to the request count. Returns the number of
+        events written (0 when tracing is off)."""
+        if self.tracer is None:
+            return 0
+        reg = self.tracer.registry
+        if step is None:
+            step = int(reg.snapshot()["counters"].get("Serve/requests", 0))
+        events = reg.to_events(step)
+        monitor.write_events(events)
+        fl = getattr(monitor, "flush", None)
+        if fl is not None:
+            fl()
+        return len(events)
 
 
 def init_inference(model, params=None, config: InferenceConfig | dict | None = None,

@@ -113,6 +113,7 @@ class MoETransformerLM(TransformerLM):
     Only the MLP half of the layer differs; attention is inherited."""
 
     # ------------------------------------------------------------- MoE MLP
+    @jax.named_scope("moe_mlp")
     def _mlp_block(self, y, p):
         """y: (B, S, d) post-norm activations. Groups = batch rows."""
         cfg = self.cfg
@@ -178,6 +179,7 @@ class MoETransformerLM(TransformerLM):
         return w.astype(dtype)
 
     # -------------------------------------------------------- inference MoE
+    @jax.named_scope("moe_mlp_infer")
     def _mlp_block_infer(self, y, p):
         """Single-group MoE dispatch for the T=1 KV-cache decode step
         (reference ``DeepSpeedMoEInference``,

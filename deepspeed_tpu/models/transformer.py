@@ -524,6 +524,7 @@ class TransformerLM:
     def _maybe_bias(self, y, p, name):
         return y + p[name].astype(y.dtype) if self.cfg.use_bias and name in p else y
 
+    @jax.named_scope("attn")
     def _attention_block(self, x, p, positions, attn_mask):
         """Shared attention half of a layer (dense and MoE trunks)."""
         cfg = self.cfg
@@ -580,6 +581,7 @@ class TransformerLM:
                                                     False))
         return y @ w.astype(y.dtype)
 
+    @jax.named_scope("mlp")
     def _mlp_block(self, y, p):
         """FFN half. Returns (out, aux_loss); MoE trunks override this."""
         cfg = self.cfg
@@ -648,6 +650,7 @@ class TransformerLM:
     def _positions(B: int, S: int):
         return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
 
+    @jax.named_scope("embed")
     def _embed(self, params, input_ids):
         """(B, S) int32 → ((B, S, D) embeddings, (B, S) positions)."""
         cfg = self.cfg
@@ -722,6 +725,7 @@ class TransformerLM:
                       cfg.norm, cfg.norm_eps)
         return x
 
+    @jax.named_scope("lm_head")
     def _head(self, params, x):
         """Final norm + unembedding: (B, S, D) → (B, S, V) logits."""
         cfg = self.cfg

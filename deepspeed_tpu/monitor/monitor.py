@@ -1,8 +1,15 @@
 """Experiment monitoring fan-out.
 
 Analog of ``deepspeed/monitor/monitor.py:29`` (``MonitorMaster``): rank-0
-event writer dispatching to TensorBoard / CSV / WandB backends, driven by the
-``monitor`` config block. Events are ``(name, value, step)`` tuples.
+event writer dispatching to TensorBoard / CSV / WandB backends — plus the
+machine-readable sinks from ``observability/sinks.py`` (JSONL event log,
+Prometheus textfile) — driven by the ``monitor`` config block. Events are
+``(name, value, step)`` tuples.
+
+Writers keep their file handles open for the life of the master (the old
+CSV writer re-opened its file per event — measurable syscall overhead at
+per-step cadence); the engines call ``flush()`` at report boundaries and
+``close()`` on teardown.
 """
 
 from __future__ import annotations
@@ -18,21 +25,43 @@ from ..utils.logging import logger
 
 
 class _CsvWriter:
+    """One append-mode CSV per metric name, handles kept open."""
+
     def __init__(self, cfg: dict):
         self.dir = Path(cfg.get("output_path", "./csv_monitor"))
         self.job = cfg.get("job_name", "DeepSpeedTpuJob")
         self.dir.mkdir(parents=True, exist_ok=True)
-        self._files: dict[str, object] = {}
+        self._files: dict[str, object] = {}      # name -> open file
+        self._writers: dict[str, csv.writer] = {}
+
+    def _writer(self, name: str):
+        w = self._writers.get(name)
+        if w is None:
+            fname = self.dir / (name.replace("/", "_") + ".csv")
+            new = not fname.exists() or fname.stat().st_size == 0
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name])
+            self._files[name] = f
+            self._writers[name] = w
+        return w
 
     def write_events(self, events: Sequence[tuple]):
         for name, value, step in events:
-            fname = self.dir / (name.replace("/", "_") + ".csv")
-            new = not fname.exists()
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", name])
-                w.writerow([step, float(value)])
+            self._writer(name).writerow([step, float(value)])
+
+    def flush(self):
+        for f in self._files.values():
+            if not f.closed:
+                f.flush()
+
+    def close(self):
+        for f in self._files.values():
+            if not f.closed:
+                f.close()
+        self._files.clear()
+        self._writers.clear()
 
 
 class _TensorboardWriter:
@@ -46,6 +75,12 @@ class _TensorboardWriter:
         for name, value, step in events:
             self.writer.add_scalar(name, float(value), int(step))
         self.writer.flush()
+
+    def flush(self):
+        self.writer.flush()
+
+    def close(self):
+        self.writer.close()
 
 
 class _WandbWriter:
@@ -78,6 +113,14 @@ class MonitorMaster:
                 self.writers.append(_WandbWriter(cfg.wandb))
             except Exception as e:
                 logger.warning(f"wandb monitor disabled: {e}")
+        if getattr(cfg, "jsonl", {}).get("enabled"):
+            from ..observability.sinks import JsonlSink
+
+            self.writers.append(JsonlSink(cfg.jsonl))
+        if getattr(cfg, "prometheus", {}).get("enabled"):
+            from ..observability.sinks import PrometheusTextfileSink
+
+            self.writers.append(PrometheusTextfileSink(cfg.prometheus))
 
     @property
     def enabled(self) -> bool:
@@ -86,3 +129,18 @@ class MonitorMaster:
     def write_events(self, events: Sequence[tuple]):
         for w in self.writers:
             w.write_events(events)
+
+    def flush(self):
+        """Push buffered events to disk (engines call this at report
+        boundaries; sinks without buffering just no-op)."""
+        for w in self.writers:
+            fl = getattr(w, "flush", None)
+            if fl is not None:
+                fl()
+
+    def close(self):
+        for w in self.writers:
+            cl = getattr(w, "close", None)
+            if cl is not None:
+                cl()
+        self.writers = []

@@ -1,0 +1,21 @@
+"""Serving & training observability: metrics core, request tracing,
+machine-readable sinks, and XLA profiler integration.
+
+See ``docs/OBSERVABILITY.md`` for the metric namespace and runbook.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
+                      get_registry)
+from .sinks import (JsonlSink, PrometheusTextfileSink,
+                    parse_prometheus_textfile, prometheus_name)
+from .tracing import RequestRecord, RequestTracer
+from .xla import TraceWindow, sample_memory
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Reservoir",
+    "get_registry",
+    "JsonlSink", "PrometheusTextfileSink", "parse_prometheus_textfile",
+    "prometheus_name",
+    "RequestRecord", "RequestTracer",
+    "TraceWindow", "sample_memory",
+]

@@ -1,0 +1,246 @@
+"""Metrics core: counters / gauges / histograms with rolling reservoirs.
+
+The serving/training analog of the reference's monitor + flops-profiler
+numbers, unified: every component records into a :class:`MetricsRegistry`
+(``Train/*`` from the training engine, ``Serve/*`` from the inference
+engine, ``Comm/*`` from the collective census, ``Memory/*`` from the HBM
+watermark), and ``snapshot()`` / ``to_events()`` expose one coherent
+namespace to callers and to the :class:`~..monitor.monitor.MonitorMaster`
+sinks (CSV / TensorBoard / WandB / JSONL / Prometheus).
+
+Everything here is host-side Python over already-materialized floats —
+recording never touches a device buffer, so instrumentation cannot add
+host↔device synchronization. In a multi-host job each process keeps its
+own registry; emission is process-0's business (``MonitorMaster`` already
+gates on ``jax.process_index() == 0``), which is the reference monitor's
+rank-0 aggregation contract.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, Optional
+
+# Percentiles every histogram reports (nearest-rank over the rolling window).
+DEFAULT_PERCENTILES = (50, 90, 99)
+
+
+class Reservoir:
+    """Rolling window of the most recent ``size`` observations.
+
+    A plain ring buffer, not Vitter sampling: serving percentiles should
+    reflect *recent* traffic (a latency regression must show up in p99 now,
+    not diluted by the whole process history), and the window is small
+    enough that keeping every recent sample exactly is cheaper than being
+    clever."""
+
+    def __init__(self, size: int = 1024):
+        if size <= 0:
+            raise ValueError(f"reservoir size must be positive, got {size}")
+        self.size = int(size)
+        self._buf: list[float] = []
+        self._idx = 0          # next write slot once the buffer is full
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        if len(self._buf) < self.size:
+            self._buf.append(v)
+        else:
+            self._buf[self._idx] = v
+            self._idx = (self._idx + 1) % self.size
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def values(self) -> list[float]:
+        return list(self._buf)
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the window (q in [0, 100])."""
+        if not self._buf:
+            return math.nan
+        s = sorted(self._buf)
+        rank = max(1, math.ceil(q / 100.0 * len(s)))
+        return s[min(rank, len(s)) - 1]
+
+    def percentiles(self, qs: Iterable[float] = DEFAULT_PERCENTILES) -> dict:
+        if not self._buf:
+            return {f"p{_fmt_q(q)}": math.nan for q in qs}
+        s = sorted(self._buf)
+        out = {}
+        for q in qs:
+            rank = max(1, math.ceil(q / 100.0 * len(s)))
+            out[f"p{_fmt_q(q)}"] = s[min(rank, len(s)) - 1]
+        return out
+
+
+def _fmt_q(q: float) -> str:
+    return str(int(q)) if float(q).is_integer() else str(q).replace(".", "_")
+
+
+class Counter:
+    """Monotonic accumulator (requests served, tokens generated, bytes)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, lock: Optional[threading.RLock] = None):
+        self.name = name
+        self.value = 0.0
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:   # += is a read-modify-write, not atomic
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar (loss, lr, MFU, bytes in use)."""
+
+    __slots__ = ("name", "value", "updated", "_lock")
+
+    def __init__(self, name: str, lock: Optional[threading.RLock] = None):
+        self.name = name
+        self.value = math.nan
+        self.updated = False
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+            self.updated = True
+
+
+class Histogram:
+    """Distribution summary: count/sum/last + rolling-window percentiles."""
+
+    def __init__(self, name: str, reservoir_size: int = 1024,
+                 percentiles: tuple = DEFAULT_PERCENTILES,
+                 lock: Optional[threading.RLock] = None):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.last = math.nan
+        self.percentiles = tuple(percentiles)
+        self.reservoir = Reservoir(reservoir_size)
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:   # count/sum/reservoir must move together
+            self.count += 1
+            self.sum += v
+            self.last = v
+            self.reservoir.add(v)
+
+    def summary(self) -> dict:
+        with self._lock:
+            out = {"count": self.count,
+                   "mean": (self.sum / self.count) if self.count else math.nan,
+                   "last": self.last}
+            out.update(self.reservoir.percentiles(self.percentiles))
+            return out
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms behind one shared lock.
+
+    The registry's RLock is handed to every instrument it creates, so
+    mutators (``inc``/``set``/``observe``) and readers
+    (``snapshot``/``to_events``) serialize against each other — two server
+    threads recording concurrently can't lose increments or tear a
+    histogram's count/reservoir pair (reentrant because ``snapshot`` holds
+    the lock while calling ``Histogram.summary``).
+
+    ``snapshot()`` is the machine-readable read API (nested dict);
+    ``to_events(step)`` flattens to the ``(name, value, step)`` tuples the
+    monitor fan-out consumes — histograms emit ``<name>/p50`` etc. so every
+    sink sees plain scalars."""
+
+    def __init__(self, default_reservoir: int = 1024):
+        self._lock = threading.RLock()
+        self._default_reservoir = int(default_reservoir)
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------ accessors
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, lock=self._lock)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, lock=self._lock)
+            return g
+
+    def histogram(self, name: str, reservoir_size: Optional[int] = None,
+                  percentiles: tuple = DEFAULT_PERCENTILES) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(
+                    name, reservoir_size or self._default_reservoir,
+                    percentiles, lock=self._lock)
+            return h
+
+    # ----------------------------------------------------------- shorthands
+    def set_gauges(self, values: dict[str, float]) -> None:
+        for k, v in values.items():
+            self.gauge(k).set(v)
+
+    # -------------------------------------------------------------- readout
+    def snapshot(self) -> dict:
+        """Nested machine-readable view of everything recorded so far."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()
+                           if g.updated},
+                "histograms": {n: h.summary()
+                               for n, h in self._histograms.items()},
+            }
+
+    def to_events(self, step: int) -> list[tuple]:
+        """Flat ``(name, value, step)`` list for MonitorMaster.write_events.
+
+        NaNs (empty gauges/histograms) are dropped rather than written: a
+        NaN row poisons CSV plots and Prometheus scrapes alike."""
+        events: list[tuple] = []
+        with self._lock:
+            for n, c in self._counters.items():
+                events.append((n, c.value, step))
+            for n, g in self._gauges.items():
+                if g.updated and not math.isnan(g.value):
+                    events.append((n, g.value, step))
+            for n, h in self._histograms.items():
+                for k, v in h.summary().items():
+                    if isinstance(v, float) and math.isnan(v):
+                        continue
+                    events.append((f"{n}/{k}", v, step))
+        return events
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-wide default registry (engines default to their own private
+    registries; this one is for ad-hoc instrumentation and scripts)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = MetricsRegistry()
+        return _DEFAULT

@@ -1,0 +1,130 @@
+"""Machine-readable monitor sinks: JSONL event log + Prometheus textfile.
+
+Both plug into :class:`~..monitor.monitor.MonitorMaster` next to the
+CSV/TensorBoard/WandB writers (same ``write_events([(name, value, step)])``
+contract) and exist because the reference trio's outputs are either
+binary (TB event files) or external services (WandB): perf attribution
+tooling wants something it can ``json.loads`` or scrape.
+
+- :class:`JsonlSink` appends one JSON object per event — the replayable
+  ground-truth log (``{"name", "value", "step", "time"}``).
+- :class:`PrometheusTextfileSink` maintains the *latest* value per metric
+  and atomically rewrites a textfile in Prometheus exposition format, the
+  standard node-exporter textfile-collector handoff: point
+  ``--collector.textfile.directory`` at its directory and the job's gauges
+  show up in every scrape without running an HTTP server inside the
+  training process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from pathlib import Path
+from typing import Sequence
+
+
+class JsonlSink:
+    """Append-only JSONL event log with a persistent file handle."""
+
+    def __init__(self, cfg: dict):
+        path = Path(cfg.get("output_path", "./monitor")) / (
+            cfg.get("job_name", "DeepSpeedTpuJob") + ".jsonl")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+        # 0 = rely on close(); N = fsync-less flush every N events
+        self._flush_every = int(cfg.get("flush_every", 64))
+        self._pending = 0
+
+    def write_events(self, events: Sequence[tuple]) -> None:
+        now = time.time()
+        for name, value, step in events:
+            self._f.write(json.dumps(
+                {"name": name, "value": float(value), "step": int(step),
+                 "time": now}, separators=(",", ":")) + "\n")
+            self._pending += 1
+        if self._flush_every and self._pending >= self._flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        self._pending = 0
+        if not self._f.closed:
+            self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+_PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str, prefix: str = "dstpu") -> str:
+    """Metric name → legal Prometheus identifier (``Serve/ttft_s/p99`` →
+    ``dstpu_serve_ttft_s_p99``)."""
+    n = _PROM_BAD_CHARS.sub("_", name.strip()).strip("_").lower()
+    full = f"{prefix}_{n}" if prefix else n
+    if not _PROM_NAME_OK.match(full):
+        full = "_" + full
+    return full
+
+
+class PrometheusTextfileSink:
+    """Latest-value gauge exporter in Prometheus exposition format.
+
+    The textfile is rewritten atomically (tmp + rename) on every flush so a
+    concurrent scrape never reads a torn file."""
+
+    def __init__(self, cfg: dict):
+        d = Path(cfg.get("output_path", "./monitor"))
+        d.mkdir(parents=True, exist_ok=True)
+        self.path = d / (cfg.get("job_name", "DeepSpeedTpuJob") + ".prom")
+        self.prefix = cfg.get("prefix", "dstpu")
+        self._values: dict[str, float] = {}
+        self._step = 0
+        self._dirty = False
+
+    def write_events(self, events: Sequence[tuple]) -> None:
+        # buffered: the textfile is rewritten at flush() (report boundaries
+        # / close), not per event batch
+        for name, value, step in events:
+            self._values[prometheus_name(name, self.prefix)] = float(value)
+            self._step = max(self._step, int(step))
+            self._dirty = True
+
+    def flush(self) -> None:
+        if not self._dirty:
+            return
+        # The step is its own gauge, NOT a label: a step label would mint a
+        # brand-new Prometheus series per metric per step (label sets key
+        # series), fragmenting graphs and blowing up TSDB head cardinality.
+        lines = [f"# TYPE {prometheus_name('step', self.prefix)} gauge",
+                 f"{prometheus_name('step', self.prefix)} {self._step}"]
+        for name in sorted(self._values):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {self._values[name]:.10g}")
+        tmp = self.path.with_suffix(".prom.tmp")
+        tmp.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        os.replace(tmp, self.path)
+        self._dirty = False
+
+    def close(self) -> None:
+        self.flush()
+
+
+def parse_prometheus_textfile(text: str) -> dict[str, float]:
+    """Tiny exposition-format reader (tests + doctors): name -> value."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+(\S+)", line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out

@@ -1,0 +1,144 @@
+"""Inference request tracing: per-``generate()`` structured records.
+
+Every traced request produces a :class:`RequestRecord` — prefill wall time
+(TTFT), steady per-token decode latency (TPOT), tokens/s, and the roofline
+attribution numbers (achieved weight-GB/s and MBU against the chip's peak
+HBM bandwidth, reusing the per-step HBM-bytes model the PR-1 WOQ work
+introduced in ``inference/quantization.py:decode_weight_bytes``). Records
+land in a bounded ring buffer and feed ``Serve/*`` histograms in a
+:class:`~.metrics.MetricsRegistry`, so ``InferenceEngine.metrics_snapshot()``
+can answer "what is my p99 TTFT right now" without any bench script.
+
+Timing honesty: the engine only gets split prefill/decode timings when
+tracing is ON (it compiles the generation in two programs and pays exactly
+one extra host sync per request, between prefill and decode — never one per
+token). Cold calls (first compile of a shape) are recorded and flagged but
+kept OUT of the latency reservoirs, so one retrace can't blow up p99.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .metrics import MetricsRegistry
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One generate() call, fully attributed."""
+
+    request_id: int
+    batch: int
+    prompt_len: int
+    new_tokens: int
+    prefill_s: float                    # TTFT: prompt in → first token out
+    decode_s: float                     # remaining new_tokens - 1 steps
+    cold: bool                          # this shape compiled during the call
+    tpot_s: Optional[float] = None      # per-token decode latency
+    tokens_per_sec: Optional[float] = None
+    achieved_gbps: Optional[float] = None
+    weight_bytes_per_step: Optional[int] = None
+    mbu: Optional[float] = None         # achieved / peak HBM bandwidth
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class RequestTracer:
+    """Ring buffer + rolling latency accounting for served requests.
+
+    ``bytes_per_step`` is the decode weight-read model (quantized leaves
+    count their int8/int4 bytes); ``peak_bw`` the per-chip HBM roofline.
+    Either may be None (unknown hardware): the trace still records
+    latencies, only the MBU attribution is omitted.
+
+    ``clock`` is injectable for tests (fake-clock TTFT/TPOT accounting).
+    """
+
+    def __init__(self, ring_size: int = 256,
+                 registry: Optional[MetricsRegistry] = None,
+                 bytes_per_step: Optional[int] = None,
+                 peak_bw: Optional[float] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.bytes_per_step = bytes_per_step
+        self.peak_bw = peak_bw
+        self.clock = clock
+        self._ring: deque[RequestRecord] = deque(maxlen=int(ring_size))
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    # ------------------------------------------------------------- recording
+    def observe(self, *, batch: int, prompt_len: int, new_tokens: int,
+                prefill_s: float, decode_s: float,
+                cold: bool = False) -> RequestRecord:
+        """Account one request from its measured phase times."""
+        decode_steps = max(0, new_tokens - 1)
+        tpot = (decode_s / decode_steps) if decode_steps else None
+        total = prefill_s + decode_s
+        tps = (batch * new_tokens / total) if total > 0 else None
+        gbps = mbu = None
+        if tpot and self.bytes_per_step:
+            # decode streams the weights once per step regardless of batch
+            gbps = self.bytes_per_step / tpot / 1e9
+            if self.peak_bw:
+                mbu = self.bytes_per_step / tpot / self.peak_bw
+        with self._lock:
+            rec = RequestRecord(
+                request_id=self._next_id, batch=batch, prompt_len=prompt_len,
+                new_tokens=new_tokens, prefill_s=prefill_s, decode_s=decode_s,
+                cold=cold, tpot_s=tpot, tokens_per_sec=tps,
+                achieved_gbps=gbps, weight_bytes_per_step=self.bytes_per_step,
+                mbu=mbu)
+            self._next_id += 1
+            self._ring.append(rec)
+        r = self.registry
+        r.counter("Serve/requests").inc()
+        r.counter("Serve/tokens_generated").inc(batch * new_tokens)
+        if cold:
+            # compile time must not pollute the latency percentiles, but a
+            # retrace storm is itself worth seeing
+            r.counter("Serve/cold_starts").inc()
+            return rec
+        r.histogram("Serve/ttft_s").observe(prefill_s)
+        if tpot is not None:
+            r.histogram("Serve/tpot_s").observe(tpot)
+        if tps is not None:
+            r.gauge("Serve/tokens_per_sec").set(tps)
+        if gbps is not None:
+            r.gauge("Serve/achieved_gbps").set(gbps)
+        if mbu is not None:
+            r.gauge("Serve/decode_mbu").set(mbu)
+        return rec
+
+    # --------------------------------------------------------------- readout
+    def records(self) -> list[RequestRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def snapshot(self) -> dict:
+        """Aggregate view: warm-request latency percentiles + roofline."""
+        snap = self.registry.snapshot()
+        hist = snap["histograms"]
+        gauges = snap["gauges"]
+        counters = snap["counters"]
+        recent = [r.as_dict() for r in self.records()[-8:]]
+        out = {
+            "requests": int(counters.get("Serve/requests", 0)),
+            "cold_starts": int(counters.get("Serve/cold_starts", 0)),
+            "tokens_generated": int(counters.get("Serve/tokens_generated", 0)),
+            "ttft_s": hist.get("Serve/ttft_s", {}),
+            "tpot_s": hist.get("Serve/tpot_s", {}),
+            "tokens_per_sec": gauges.get("Serve/tokens_per_sec", math.nan),
+            "achieved_gbps": gauges.get("Serve/achieved_gbps"),
+            "decode_mbu": gauges.get("Serve/decode_mbu"),
+            "weight_bytes_per_step": self.bytes_per_step,
+            "peak_hbm_bw": self.peak_bw,
+            "recent": recent,
+        }
+        return out

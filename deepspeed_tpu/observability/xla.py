@@ -1,0 +1,103 @@
+"""XLA profiler integration: windowed trace capture + HBM watermark.
+
+Two pieces the training loop wires in:
+
+- :class:`TraceWindow` — config-driven ``trace_steps=(start, stop)``: the
+  engine calls ``on_step(global_step)`` once per ``train_batch`` and the
+  window starts ``jax.profiler.start_trace`` entering step ``start`` and
+  stops it after step ``stop`` completes. Capturing a *bounded* window in
+  prod is the point: an unbounded trace on a busy serving host fills disk
+  in minutes, while a 5-step window around a suspect region is megabytes.
+  View with ``tensorboard --logdir <dir>`` or xprof/perfetto.
+
+- :func:`sample_memory` — the HBM watermark: reads the accelerator's
+  ``memory_stats()`` (bytes in use / peak / limit) into ``Memory/*``
+  gauges. Sampled at step boundaries only (one cheap host call; never
+  inside a compiled program).
+
+The ``jax.named_scope`` annotations on the model blocks (attn / mlp / moe
+/ decode_step — see ``models/transformer.py``) are what make the captured
+trace readable: XLA ops inherit the scope names, so the trace viewer's
+timeline groups by transformer block instead of a flat fusion soup.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..utils.logging import log_dist
+
+
+class TraceWindow:
+    """Windowed ``jax.profiler`` capture around a step interval.
+
+    ``trace_steps=(start, stop)``: the trace runs for global steps in
+    ``[start, stop]`` inclusive. ``sync_fn`` (optional) is called before
+    stopping so the trace includes the full device activity of the last
+    step (async dispatch would otherwise close the file mid-step).
+    """
+
+    def __init__(self, trace_steps: Sequence[int], logdir: str,
+                 sync_fn=None):
+        if len(tuple(trace_steps)) != 2:
+            raise ValueError(
+                f"trace_steps must be (start, stop), got {trace_steps!r}")
+        self.start_step, self.stop_step = (int(s) for s in trace_steps)
+        if self.stop_step < self.start_step:
+            raise ValueError(
+                f"trace_steps stop ({self.stop_step}) precedes start "
+                f"({self.start_step})")
+        self.logdir = logdir
+        self.sync_fn = sync_fn
+        self.active = False
+        self.done = False
+
+    def on_step(self, step: int) -> None:
+        """Call once per train step with the CURRENT global step (the step
+        about to run). Idempotent after the window closes."""
+        if self.done:
+            return
+        if not self.active and self.start_step <= step <= self.stop_step:
+            import jax
+
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+            log_dist(f"observability: XLA trace window open at step {step} "
+                     f"→ {self.logdir}", ranks=[0])
+        elif self.active and step > self.stop_step:
+            self._stop(step)
+
+    def close(self) -> None:
+        """Stop the trace if still open (end of training, error paths)."""
+        if self.active:
+            self._stop(None)
+
+    def _stop(self, step: Optional[int]) -> None:
+        import jax
+
+        if self.sync_fn is not None:
+            try:
+                self.sync_fn()
+            except Exception:   # sync is best-effort; the trace still closes
+                pass
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        at = f" at step {step}" if step is not None else ""
+        log_dist(f"observability: XLA trace window closed{at} "
+                 f"(view: tensorboard --logdir {self.logdir})", ranks=[0])
+
+
+def sample_memory(registry, accelerator=None, prefix: str = "Memory") -> dict:
+    """HBM watermark → ``Memory/*`` gauges; returns the sampled dict.
+
+    Uses ``platform/accelerator.py`` ``memory_stats()`` (zeros on backends
+    that don't report, e.g. CPU) — callers need no platform guard."""
+    if accelerator is None:
+        from ..platform.accelerator import get_accelerator
+
+        accelerator = get_accelerator()
+    stats = accelerator.memory_stats().as_dict()
+    registry.set_gauges({f"{prefix}/{k}": float(v)
+                         for k, v in stats.items()})
+    return stats

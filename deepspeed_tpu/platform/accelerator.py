@@ -36,6 +36,13 @@ class MemoryStats:
     def available_bytes(self) -> int:
         return max(0, self.bytes_limit - self.bytes_in_use)
 
+    def as_dict(self) -> dict:
+        """Gauge-ready view (observability HBM watermark sampling)."""
+        return {"bytes_in_use": self.bytes_in_use,
+                "peak_bytes_in_use": self.peak_bytes_in_use,
+                "bytes_limit": self.bytes_limit,
+                "available_bytes": self.available_bytes}
+
 
 class TpuAccelerator:
     """Device/platform facade over JAX.

@@ -496,7 +496,23 @@ class Engine:
                         expert=m.expert, zero=zsize)
 
     def _post_init(self):
+        from ..observability.metrics import MetricsRegistry
+
         self.timers = WallClockTimers()
+        # One registry per engine: Train/* from the step loop, Memory/*
+        # from the HBM watermark, Comm/* from the collective census —
+        # the training half of the unified metric namespace
+        # (docs/OBSERVABILITY.md). Recording is host-side floats only.
+        self.metrics = MetricsRegistry()
+        obs = self.config.observability
+        self._trace_window = None
+        if obs.trace_steps:
+            from ..observability.xla import TraceWindow
+
+            self._trace_window = TraceWindow(
+                obs.trace_steps, obs.trace_dir,
+                sync_fn=lambda: jax.block_until_ready(
+                    self.compute_params if self.offload else self.state))
         mb, gas = self.config.train_micro_batch_size_per_gpu, self.config.gradient_accumulation_steps
         try:
             peak = peak_flops_for(self.acc.current_device()) * len(jax.devices())
@@ -771,9 +787,14 @@ class Engine:
                "loss_scale": float(scale), "skipped": 0 if finite else 1,
                "bwd_s": t_bwd, "host_step_s": t_host}
         if self.global_steps % self.config.steps_per_print == 0:
-            self.throughput.stop(report=True)
+            stats = self.throughput.stop(report=True)
             log_dist(f"step={self.global_steps} loss={out['loss']:.4f} "
                      f"lr={lr:.3e} gnorm={gnorm:.3f}", ranks=[0])
+            # same registry namespace as the in-device path, plus the
+            # offload-specific phase split (backward vs host optimizer)
+            self._record_step_metrics(out, stats, extra_gauges={
+                "Train/bwd_s": t_bwd, "Train/host_step_s": t_host})
+            self._emit_monitor_events()
         else:
             self.throughput.stop(report=False)
         if self.flops_profiler and self.flops_profiler.should_fire():
@@ -1210,6 +1231,58 @@ class Engine:
                     pass
         return out
 
+    # -------------------------------------------------------- observability
+    def _record_step_metrics(self, metrics: dict, stats: Optional[dict],
+                             extra_gauges: Optional[dict] = None) -> None:
+        """Step metrics → the engine registry (Train/* + Memory/*)."""
+        gauges = {"Train/loss": metrics["loss"], "Train/lr": metrics["lr"],
+                  "Train/grad_norm": metrics["grad_norm"]}
+        if "loss_scale" in metrics:
+            gauges["Train/loss_scale"] = metrics["loss_scale"]
+        if extra_gauges:
+            gauges.update(extra_gauges)
+        if stats:
+            gauges["Train/samples_per_sec"] = stats["samples_per_sec"]
+            for key in ("tflops", "mfu"):
+                if key in stats:
+                    gauges[f"Train/{key}"] = stats[key]
+            self.metrics.histogram("Train/step_time_s").observe(
+                stats["step_time_s"])
+        self.metrics.set_gauges(gauges)
+        if metrics.get("skipped"):
+            self.metrics.counter("Train/skipped_steps").inc(
+                metrics["skipped"])
+        if self.config.observability.hbm_watermark:
+            from ..observability.xla import sample_memory
+
+            # HBM watermark at the step boundary (one host call per report
+            # window; zeros on backends that don't expose memory_stats)
+            sample_memory(self.metrics, self.acc)
+
+    def _emit_monitor_events(self, extra: Optional[list] = None) -> None:
+        """Flush the registry (+ any hand-built events) through the monitor
+        fan-out — CSV/TB/WandB and the JSONL/Prometheus sinks alike."""
+        if not self.monitor:
+            return
+        events = self.metrics.to_events(self.global_steps)
+        if extra:
+            events.extend(extra)
+        self.monitor.write_events(events)
+        self.monitor.flush()
+
+    def metrics_snapshot(self) -> dict:
+        """Machine-readable view of the training registry (the serving
+        analog lives on ``InferenceEngine.metrics_snapshot``)."""
+        return self.metrics.snapshot()
+
+    def close(self) -> None:
+        """Teardown: close any open XLA trace window and the monitor's
+        file handles. Safe to call more than once."""
+        if self._trace_window is not None:
+            self._trace_window.close()
+        if self.monitor:
+            self.monitor.close()
+
     def train_batch(self, batch: dict) -> dict:
         """One optimizer step over train_batch_size samples (micro-stepping,
         grad accumulation, and the update are all inside the compiled step;
@@ -1220,13 +1293,22 @@ class Engine:
                 "mode): no state is materialized — only compile_train_step "
                 "is available")
         self._check_flops_nominal(batch)
+        if self._trace_window is not None:
+            # windowed XLA capture: opens entering trace_steps[0], closes
+            # after trace_steps[1] completes (observability/xla.py)
+            self._trace_window.on_step(self.global_steps)
         if self.offload:
             return self._train_batch_offload(batch)
+        wcb = self.config.wall_clock_breakdown
         self.throughput.start()
+        if wcb:
+            self.timers.start("batch_prep")
         if self.curriculum is not None or self._ltd is not None:
             batch = self._apply_data_efficiency(batch)
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
+        if wcb:
+            self.timers.stop("batch_prep")
         if self._moq is not None and self._moq_probe_batch is None:
             # small fixed probe batch for the curvature power iteration:
             # captured AFTER globalization (pre-converted jax batches
@@ -1259,38 +1341,50 @@ class Engine:
             comp_active = self._moq.annotate(comp_active)
         warm = (in_warmup(self.onebit, self.global_steps)
                 if self.onebit is not None else False)
+        if wcb:
+            self.timers.start("step_dispatch")
         with self.mesh:
             self.state, metrics = self._train_step(
                 self.state, batch, max(0, self._ltd_tokens), comp_active, warm)
+        if wcb:
+            self.timers.stop("step_dispatch")
         self.global_steps += 1
-        if self.config.wall_clock_breakdown or \
-                self.global_steps % self.config.steps_per_print == 0:
-            metrics = {k: float(v) for k, v in metrics.items()}
+        boundary = self.global_steps % self.config.steps_per_print == 0
+        if wcb or boundary:
+            # sync FIRST, then floatify: float() on the metrics arrays is
+            # itself a device wait, and running it before the step_sync
+            # timer would bury the whole device-execution time in no timer
+            if wcb:
+                self.timers.start("step_sync")
             jax.block_until_ready(self.state.step)
+            if wcb:
+                self.timers.stop("step_sync")
+            metrics = {k: float(v) for k, v in metrics.items()}
             stats = self.throughput.stop(report=True)
-            if self.global_steps % self.config.steps_per_print == 0:
+            if wcb:
+                # wall-clock breakdown → registry gauges (log() also prints
+                # the reference-style "time (ms)" line and resets). Gauges
+                # record per step; sinks still flush only at boundaries.
+                for name, ms in self.timers.log(reset=True).items():
+                    self.metrics.gauge(f"Train/time_{name}_ms").set(ms)
+            if boundary:
                 log_dist(f"step={self.global_steps} loss={metrics['loss']:.4f} "
                          f"lr={metrics['lr']:.3e} gnorm={metrics['grad_norm']:.3f}",
                          ranks=[0])
-            if self.monitor:
-                events = [(f"Train/loss", metrics["loss"], self.global_steps),
-                          (f"Train/lr", metrics["lr"], self.global_steps)]
+                # recording + emission stay on the report cadence even
+                # under wall_clock_breakdown (the HBM watermark and sink
+                # flush are documented as per-boundary, never per-step)
+                self._record_step_metrics(metrics, stats)
+                extra = []
                 if self._moq is not None and any(
                         n.startswith("weight_quantization")
                         for n in comp_active):
                     # observability for the quantization schedule (the
                     # reference logs its quantizer's bit switches too);
                     # only while QAT is actually active per its offset
-                    events.append(("Train/moq_bits", self._moq.bits,
-                                   self.global_steps))
-                if stats:
-                    events.append(("Train/samples_per_sec",
-                                   stats["samples_per_sec"], self.global_steps))
-                    for key, tag in (("tflops", "Train/tflops"),
-                                     ("mfu", "Train/mfu")):
-                        if key in stats:
-                            events.append((tag, stats[key], self.global_steps))
-                self.monitor.write_events(events)
+                    extra.append(("Train/moq_bits", self._moq.bits,
+                                  self.global_steps))
+                self._emit_monitor_events(extra)
         else:
             self.throughput.stop(report=False)
         # Profiler fires OUTSIDE the throughput window (its extra timed step
@@ -1316,7 +1410,18 @@ class Engine:
                 for key, d in sorted(collective_summary(compiled).items()):
                     log_dist(f"comms | HLO {key}: n={int(d['count'])} "
                              f"vol={d['mbytes']:.1f} MB", ranks=[0])
+                    # collective census → Comm/* gauges: per-step wire
+                    # bytes by kind, exact from the compiled program
+                    self.metrics.set_gauges({
+                        f"Comm/hlo/{key}/count": d["count"],
+                        f"Comm/hlo/{key}/mbytes": d["mbytes"]})
+                for name, value, _ in _cl.as_monitor_events(
+                        self.global_steps):
+                    self.metrics.gauge(name).set(value)
                 _cl.log_summary()
+                # no emit here: the Comm/* gauges ride the next report
+                # boundary's flush (an emit now would duplicate this
+                # step's Train/* rows in every sink)
             except Exception as e:   # best-effort per backend
                 log_dist(f"comms_logger: HLO summary unavailable ({e})")
         return metrics

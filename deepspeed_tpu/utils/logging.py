@@ -42,13 +42,17 @@ def _process_index() -> int:
         return 0
 
 
-def log_dist(message: str, ranks: list[int] | None = None, level: int = logging.INFO) -> None:
+def log_dist(message: str, ranks: list[int] | None = None,
+             level: int | str = logging.INFO) -> None:
     """Log ``message`` only on the listed process ranks (``[-1]`` or None = all).
 
     Mirrors the behavior of the reference ``log_dist`` but keyed on
-    ``jax.process_index()``.
+    ``jax.process_index()``. ``level`` accepts a name ("WARNING") or an
+    int — ``logging.Logger.log`` raises on strings, and callers pass both.
     """
     my_rank = _process_index()
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
     if ranks is None or -1 in ranks or my_rank in ranks:
         logger.log(level, f"[Rank {my_rank}] {message}")
 

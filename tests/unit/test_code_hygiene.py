@@ -1,0 +1,54 @@
+"""Lint-style source checks over ``deepspeed_tpu/``.
+
+Bare ``print(`` is forbidden in library code: in a multi-host job it
+writes from every process with no rank gating, it bypasses the
+``DSTPU_LOG_LEVEL`` filter, and nothing downstream can parse it — output
+belongs in ``utils/logging`` (human logs) or the observability layer
+(machine-readable metrics).
+
+Exempt: modules whose *stdout is their interface* — CLI report/bench
+entry points and the autotuner's worker JSON protocol. Adding a module
+here needs that justification, not convenience.
+"""
+
+import re
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parents[2] / "deepspeed_tpu"
+
+# stdout-as-interface modules (relative to deepspeed_tpu/)
+PRINT_ALLOWED = {
+    "env_report.py",           # ds_report analog: a stdout report tool
+    "comm/bench.py",           # comms microbench CLI table
+    "ops/aio_bench.py",        # aio sweep CLI table
+    "autotuning/cli.py",       # autotuner CLI frontend
+    "autotuning/worker.py",    # prints JSON: the worker↔tuner IPC protocol
+    "elasticity/agent.py",     # launcher agent: pre-logging bootstrap output
+    "launcher/launch.py",      # process supervisor: child exit reporting
+    "launcher/runner.py",      # multinode launcher CLI
+    "runtime/checkpoint/to_fp32.py",   # zero_to_fp32-style CLI (stderr note)
+}
+
+_BARE_PRINT = re.compile(r"^\s*print\(")
+
+
+def test_no_bare_print_in_library_code():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        if rel in PRINT_ALLOWED:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _BARE_PRINT.match(line):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "bare print( in library code — route through utils/logging or the "
+        "observability metrics layer (or, for a stdout-protocol CLI, add "
+        "an explicit justified entry to PRINT_ALLOWED):\n"
+        + "\n".join(offenders))
+
+
+def test_print_allowlist_entries_exist():
+    """A deleted/renamed module must not leave a stale exemption behind."""
+    missing = [rel for rel in PRINT_ALLOWED if not (PKG / rel).exists()]
+    assert not missing, f"stale PRINT_ALLOWED entries: {missing}"
