@@ -10,7 +10,9 @@ decode engine, and the observability stack.
 
 from . import compat  # noqa: F401  (must run before any jax-0.9 API use)
 from .config import Config
-from .inference import InferenceConfig, InferenceEngine, init_inference
+from .inference import (InferenceConfig, InferenceEngine, ServingConfig,
+                        init_inference)
+from .serving import ServingEngine
 from .platform import (get_accelerator, init_distributed, build_mesh, MeshSpec)
 from .runtime.engine import Engine, initialize
 from .runtime.hybrid_engine import HybridEngine
@@ -21,5 +23,6 @@ from . import observability  # noqa: F401  (metrics/tracing/sinks layer)
 
 __all__ = ["initialize", "Engine", "HybridEngine", "Config",
            "init_inference", "InferenceEngine", "InferenceConfig",
+           "ServingConfig", "ServingEngine",
            "get_accelerator", "init_distributed", "build_mesh", "MeshSpec",
            "__version__"]

@@ -10,7 +10,8 @@ compilation), TP falls out of the same param sharding specs as training,
 and there is no module surgery — the model is already functional.
 """
 
-from .config import InferenceConfig
+from .config import InferenceConfig, ServingConfig
 from .engine import InferenceEngine, init_inference
 
-__all__ = ["InferenceConfig", "InferenceEngine", "init_inference"]
+__all__ = ["InferenceConfig", "ServingConfig", "InferenceEngine",
+           "init_inference"]

@@ -19,6 +19,55 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
 
 
 @dataclasses.dataclass
+class ServingConfig:
+    """Continuous-batching knobs (``deepspeed_tpu/serving/``).
+
+    The compiled-program budget is a direct function of these: steady-state
+    serving runs one slot decode program, one slot-insert program, and one
+    prefill-chunk program per chunk bucket (powers of two from 8 up to
+    ``prefill_chunk``) — see docs/SERVING.md for bucket-tuning guidance.
+    """
+
+    slots: int = 8                  # persistent KV slots (decode batch)
+    max_len: int = 256              # per-slot cache capacity (prompt + new);
+                                    # serving admits only P + max_new <= max_len
+    prefill_chunk: int = 32         # SplitFuse-style chunk size: long prompts
+                                    # prefill in chunks of this many tokens,
+                                    # one chunk per scheduler iteration,
+                                    # interleaved with the slot decode step
+    max_queue: int = 0              # submit() backpressure; 0 = unbounded
+    # engine-wide sampling policy (per-request RNG still makes every
+    # request's draws independent of batch composition)
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    greedy: bool = False
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"serving needs >= 1 slot, got {self.slots}")
+        c = self.prefill_chunk
+        if c < 8 or (c & (c - 1)) != 0:
+            raise ValueError(
+                f"prefill_chunk must be a power of two >= 8 (the chunk "
+                f"bucket set), got {c}")
+        if self.max_len < c:
+            raise ValueError(f"max_len={self.max_len} < prefill_chunk={c}")
+
+    @classmethod
+    def from_any(cls, cfg: "ServingConfig | dict | None") -> "ServingConfig":
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, cls):
+            return cfg
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f"unknown serving config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+@dataclasses.dataclass
 class InferenceConfig:
     dtype: str = "bfloat16"            # compute dtype for decode
     tensor_parallel: int = 1           # reference tensor_parallel.tp_size
@@ -53,6 +102,16 @@ class InferenceConfig:
     # host synchronization at all.
     observability: bool = False
     trace_ring_size: int = 256
+    # Decode in host-checked chunks of this many steps instead of one fused
+    # scan: between chunks the engine reads the (B,) done flags and stops
+    # as soon as every row hit eos, so a batch that finishes early stops
+    # paying for the dead tail of max_new_tokens. 0 (default) keeps the
+    # zero-sync fused path; the chunked path costs one host sync per chunk
+    # and is bit-identical (the tail is eos-filled either way).
+    decode_chunk: int = 0
+    # Continuous-batching knobs for serving.ServingEngine (ignored by the
+    # plain generate() path). Accepts a nested dict in from_any.
+    serving: "ServingConfig | None" = None
 
     def flash_decode_resolved(self) -> bool:
         if self.flash_decode is not None:
@@ -92,6 +151,9 @@ class InferenceConfig:
             if unknown_moe:
                 raise ValueError(f"unknown moe config keys: {sorted(unknown_moe)}")
             flat.setdefault("expert_parallel", int(moe.get("ep_size", 1)))
+        srv = flat.get("serving")
+        if srv is not None:
+            flat["serving"] = ServingConfig.from_any(srv)
         unknown = set(flat) - known
         if unknown:
             raise ValueError(f"unknown inference config keys: {sorted(unknown)}")

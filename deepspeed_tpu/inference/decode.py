@@ -38,13 +38,23 @@ class KVCache(NamedTuple):
     # contact: "block shape ... (Squeezed(), Blocked(256), Squeezed(), 64)")
     k: jnp.ndarray           # (L, B, KV, max_len, hd)
     v: jnp.ndarray           # (L, B, KV, max_len, hd)
-    length: jnp.ndarray      # i32 scalar: tokens currently cached
+    length: jnp.ndarray      # i32 tokens cached: scalar (all rows advance
+                             # together) or (B,) per-slot (serving/slots.py)
+
+
+def cache_layout(cfg: TransformerConfig, batch: int, max_len: int,
+                 dtype=None) -> tuple:
+    """(shape, dtype) of one K or V cache buffer — the single source of
+    truth shared by :func:`init_cache` and the serving slot allocator
+    (``serving/slots.py``), so a prefilled request's cache can be written
+    into its slot with one ``dynamic_update_slice`` and no relayout."""
+    return ((cfg.n_layer, batch, cfg.kv_heads, max_len, cfg.head_dim),
+            dtype or cfg.dtype)
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=None) -> KVCache:
-    dtype = dtype or cfg.dtype
-    shape = (cfg.n_layer, batch, cfg.kv_heads, max_len, cfg.head_dim)
+    shape, dtype = cache_layout(cfg, batch, max_len, dtype)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((), jnp.int32))
 
@@ -53,6 +63,13 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
                   alibi=None):
     """q: (B, T, H, hd) vs cache (B, KV, max_len, hd); positions >= length
     masked. For prefill T = prompt len (with causal offset); decode T = 1.
+
+    ``length`` is a scalar (all rows at the same position — the
+    single-request generate() path) or a (B,) vector of per-row lengths
+    (the serving slot batch, where every slot is at its own position).
+    The per-row math is the same expressions with a batch dim on the
+    position grid; masked scores underflow to exactly 0 after softmax, so
+    a row's output depends only on its own live positions.
 
     ``bias`` is an additive (H, T, max_len) score bias; ``alibi`` is the
     (H,) slope vector — preferred over a materialized bias because the
@@ -84,24 +101,41 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
         from ..ops.decode_attention import decode_attention
 
         return decode_attention(q, ck, cv, length, alibi_slopes=alibi)
-    # query t sits at global position length - T + t; key at slot s —
-    # ONE set of position math drives both the alibi bias and the mask
-    t_pos = length - T + jnp.arange(T)[:, None]          # (T, 1)
-    s_pos = jnp.arange(ck.shape[2])[None, :]             # (1, max_len)
-    if alibi is not None:
-        rel = (s_pos - t_pos).astype(jnp.float32)        # (T, max_len)
-        ab = alibi[:, None, None] * rel[None]            # (H, T, max_len)
-        bias = ab if bias is None else bias + ab
     KV = ck.shape[1]
     if KV != H:
         ck = jnp.repeat(ck, H // KV, axis=1)
         cv = jnp.repeat(cv, H // KV, axis=1)
     scores = jnp.einsum("bthd,bhsd->bhts", q, ck).astype(jnp.float32)
     scores = scores / math.sqrt(hd)
-    if bias is not None:
-        scores = scores + bias[None]
-    keep = s_pos <= t_pos                                # (T, max_len)
-    scores = jnp.where(keep[None, None], scores, BIG_NEG)
+    if getattr(length, "ndim", 0) == 1:
+        # per-slot lengths: the position grid gains a batch dim; an
+        # externally materialized bias has no per-row layout, so only the
+        # in-house alibi slopes are supported here
+        if bias is not None:
+            raise ValueError("per-slot lengths don't compose with a "
+                             "materialized (H, T, max_len) bias — pass "
+                             "alibi slopes instead")
+        t_pos = length[:, None, None] - T \
+            + jnp.arange(T)[None, :, None]               # (B, T, 1)
+        s_pos = jnp.arange(ck.shape[2])[None, None, :]   # (1, 1, max_len)
+        if alibi is not None:
+            rel = (s_pos - t_pos).astype(jnp.float32)    # (B, T, max_len)
+            scores = scores + alibi[None, :, None, None] * rel[:, None]
+        keep = s_pos <= t_pos                            # (B, T, max_len)
+        scores = jnp.where(keep[:, None], scores, BIG_NEG)
+    else:
+        # query t sits at global position length - T + t; key at slot s —
+        # ONE set of position math drives both the alibi bias and the mask
+        t_pos = length - T + jnp.arange(T)[:, None]      # (T, 1)
+        s_pos = jnp.arange(ck.shape[2])[None, :]         # (1, max_len)
+        if alibi is not None:
+            rel = (s_pos - t_pos).astype(jnp.float32)    # (T, max_len)
+            ab = alibi[:, None, None] * rel[None]        # (H, T, max_len)
+            bias = ab if bias is None else bias + ab
+        if bias is not None:
+            scores = scores + bias[None]
+        keep = s_pos <= t_pos                            # (T, max_len)
+        scores = jnp.where(keep[None, None], scores, BIG_NEG)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bhsd->bthd", probs, cv)
 
@@ -153,10 +187,19 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
         q, k = _rope(q, k, positions, cfg.rope_theta, cfg.rotary_dim)
 
     start = length - T  # cache slots [start, start+T) receive the new k/v
-    cache_k = lax.dynamic_update_slice(
-        cache_k, k.swapaxes(1, 2).astype(cache_k.dtype), (0, 0, start, 0))
-    cache_v = lax.dynamic_update_slice(
-        cache_v, v.swapaxes(1, 2).astype(cache_v.dtype), (0, 0, start, 0))
+    if getattr(length, "ndim", 0) == 1:
+        # per-slot write positions: one dynamic_update_slice per row via
+        # vmap (lowers to a scatter) — each serving slot appends at its
+        # own length while the batch stays one static-shape program
+        upd = jax.vmap(lambda c, u, s: lax.dynamic_update_slice(
+            c, u, (0, s, 0)))
+        cache_k = upd(cache_k, k.swapaxes(1, 2).astype(cache_k.dtype), start)
+        cache_v = upd(cache_v, v.swapaxes(1, 2).astype(cache_v.dtype), start)
+    else:
+        cache_k = lax.dynamic_update_slice(
+            cache_k, k.swapaxes(1, 2).astype(cache_k.dtype), (0, 0, start, 0))
+        cache_v = lax.dynamic_update_slice(
+            cache_v, v.swapaxes(1, 2).astype(cache_v.dtype), (0, 0, start, 0))
     alibi = None
     if cfg.pos_embedding == "alibi":
         # ALiBi positional signal (mirrors _attention_block's training
@@ -240,26 +283,35 @@ def _decode_head(model, params, x):
 
 def forward_with_cache(model, params, input_ids, cache: KVCache,
                        positions=None, flash_decode: bool = False,
-                       last_token_head: bool = False):
+                       last_token_head: bool = False, last_index=None):
     """Run T tokens through all layers, appending to the cache.
 
     input_ids: (B, T). Works for both prefill (T = prompt length, cache
     empty) and decode (T = 1). Returns (fp32 logits (B, T, V), new cache).
+    ``cache.length`` may be a scalar (every row at the same position) or a
+    (B,) per-slot vector (serving: each slot appends at its own length).
     ``last_token_head=True`` computes the unembedding only for the final
     position (the generation loop's prefill: the other T-1 logit rows are
     discarded anyway, and at GPT-2 vocab sizes they're the biggest tensor
-    of the whole prefill).
+    of the whole prefill); ``last_index`` (traced i32 scalar) overrides
+    which position that is — the serving engine's right-padded final
+    prefill chunk puts the last real token at ``true_len - 1``, not T-1.
     """
     cfg = model.cfg
     B, T = input_ids.shape
     new_len = cache.length + T
+    per_slot = getattr(cache.length, "ndim", 0) == 1
     if positions is None:
-        positions = cache.length + jnp.broadcast_to(
+        base = cache.length[:, None] if per_slot else cache.length
+        positions = base + jnp.broadcast_to(
             jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     x = _embed_rows(params["tok_embed"], input_ids, cfg.dtype)
     if cfg.pos_embedding == "learned":
-        x = x + _embed_rows(params["pos_embed"], positions[0],
-                            cfg.dtype)[None]
+        if per_slot:   # rows sit at different positions: per-row gather
+            x = x + _embed_rows(params["pos_embed"], positions, cfg.dtype)
+        else:
+            x = x + _embed_rows(params["pos_embed"], positions[0],
+                                cfg.dtype)[None]
     if cfg.embed_norm:
         x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
                   cfg.norm, cfg.norm_eps)
@@ -272,23 +324,37 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
         return x, (ck, cv)
 
     x, (ck, cv) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
-    logits = _decode_head(model, params, x[:, -1:] if last_token_head else x)
+    if last_token_head:
+        x = x[:, -1:] if last_index is None else \
+            lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    logits = _decode_head(model, params, x)
     return logits, KVCache(k=ck, v=cv, length=new_len)
 
 
 class GenCarry(NamedTuple):
-    """Generation state between the prefill and the decode scan."""
+    """Generation state between the prefill and the decode scan.
+
+    ``rng`` is one (2,) key (whole-batch sampling stream) or a (B, 2)
+    per-row key stack — each row then advances its own independent chain,
+    so a request folded from its own seed samples identically whether it
+    runs alone, in a static batch, or through the serving scheduler."""
 
     tok: jnp.ndarray         # (B,) i32 — latest sampled token
     cache: KVCache
-    rng: jnp.ndarray
+    rng: jnp.ndarray         # (2,) or (B, 2) uint32
     done: jnp.ndarray        # (B,) bool — eos reached
 
 
 def prefill_tokens(model, params, input_ids, rng, *, max_new: int,
                    sampler, eos_token_id=None, cache_dtype=None,
-                   flash_decode: bool = False, materialize=None) -> GenCarry:
+                   flash_decode: bool = False, materialize=None,
+                   cache_len=None) -> GenCarry:
     """Prompt → first sampled token + primed KV cache (the TTFT phase).
+
+    ``cache_len`` overrides the tight ``S + max_new`` cache allocation —
+    the serving layer buckets cache shapes so one compiled program serves
+    many (prompt, max_new) combinations; positions past the live length
+    are masked either way.
 
     ``materialize``: optional ``quantized params -> dense params`` fn,
     applied ONLY here (prefill is compute-bound; dense is right there).
@@ -302,6 +368,8 @@ def prefill_tokens(model, params, input_ids, rng, *, max_new: int,
     (``WOQ_PROBE.json`` round 5) — which is why the consumption sites
     dispatch explicitly now.
     """
+    from .sampling import split_keys
+
     objective = getattr(model.cfg, "objective", "clm")
     if objective != "clm":
         raise ValueError(
@@ -309,7 +377,11 @@ def prefill_tokens(model, params, input_ids, rng, *, max_new: int,
             f"{objective!r} — use forward() (MLM logits / feature hidden "
             "states) instead")
     B, S = input_ids.shape
-    cache_len = S + max_new
+    if cache_len is None:
+        cache_len = S + max_new
+    elif cache_len < S + max_new:
+        raise ValueError(f"cache_len={cache_len} < prompt + max_new "
+                         f"= {S + max_new}")
     if flash_decode:
         # round up to the Pallas decode kernel's 128-lane block: the spare
         # slots are masked by the live length, and every decode step stays
@@ -321,44 +393,74 @@ def prefill_tokens(model, params, input_ids, rng, *, max_new: int,
     with jax.named_scope("prefill"):
         logits, cache = forward_with_cache(model, mat(params), input_ids,
                                            cache, last_token_head=True)
-    rng, sub = jax.random.split(rng)
+    rng, sub = split_keys(rng)
     tok = sampler(logits[:, -1], sub)
     done = (tok == eos_token_id) if eos_token_id is not None \
         else jnp.zeros((B,), bool)
     return GenCarry(tok=tok, cache=cache, rng=rng, done=done)
 
 
+def decode_step(model, params, carry: GenCarry, *, sampler,
+                eos_token_id=None, flash_decode: bool = False) -> GenCarry:
+    """ONE decode iteration: forward the carry token, sample the next.
+
+    The single definition shared by :func:`decode_tokens`' scan body and
+    the serving engine's slot step (``serving/slots.py``), so the eos
+    forcing and rng-split order cannot drift between the static-batch and
+    continuous-batching paths — that shared order is what makes serving
+    outputs bit-identical to single-request ``generate()``."""
+    from .sampling import split_keys
+
+    tok, cache, rng, done = carry
+    with jax.named_scope("decode_step"):
+        lg, cache = forward_with_cache(model, params, tok[:, None], cache,
+                                       flash_decode=flash_decode)
+    rng, sub = split_keys(rng)
+    nxt = sampler(lg[:, 0], sub)
+    if eos_token_id is not None:
+        nxt = jnp.where(done, eos_token_id, nxt)
+        done = done | (nxt == eos_token_id)
+    return GenCarry(nxt, cache, rng, done)
+
+
 def decode_tokens(model, params, carry: GenCarry, *, steps: int, sampler,
-                  eos_token_id=None, flash_decode: bool = False):
+                  eos_token_id=None, flash_decode: bool = False,
+                  return_carry: bool = False):
     """Decode scan: ``steps`` more tokens after the carry's.
 
-    Returns (B, steps + 1) — the carry token plus everything it generated.
-    The KV cache threads through the scan carry, so XLA reuses (donates)
-    the cache buffers in place — cache update and attend live in the same
-    scan body with no copy between steps.
+    Returns (B, steps + 1) — the carry token plus everything it generated
+    — or ``(tokens, carry)`` with ``return_carry=True`` (the engine's
+    chunked-decode path resumes the scan from the returned carry after a
+    host-side ``done.all()`` check). The KV cache threads through the scan
+    carry, so XLA reuses (donates) the cache buffers in place — cache
+    update and attend live in the same scan body with no copy between
+    steps.
     """
-    eos = eos_token_id
 
     def step(carry, _):
-        tok, cache, rng, done = carry
-        with jax.named_scope("decode_step"):
-            lg, cache = forward_with_cache(model, params, tok[:, None], cache,
-                                           flash_decode=flash_decode)
-        rng, sub = jax.random.split(rng)
-        nxt = sampler(lg[:, 0], sub)
-        if eos is not None:
-            nxt = jnp.where(done, eos, nxt)
-            done = done | (nxt == eos)
-        return GenCarry(nxt, cache, rng, done), tok
+        nxt = decode_step(model, params, carry, sampler=sampler,
+                          eos_token_id=eos_token_id,
+                          flash_decode=flash_decode)
+        return nxt, carry.tok
 
     out, toks = lax.scan(step, carry, None, length=steps)
-    # emitted tokens 0..steps-1 plus the final carry token
-    return jnp.concatenate([toks, out.tok[None]], axis=0).T  # (B, steps + 1)
+    # emitted tokens 0..steps-1 plus the final carry token. Constrain both
+    # concat operands to an explicit replicated layout first: under TP the
+    # partitioner resolves the scan-stacked ys and the carry token to
+    # DIFFERENT shardings, and (jax 0.4.x GSPMD) reconciles them with a
+    # spurious cross-shard reduce — every emitted token id summed tp_size
+    # times. Token ids are (steps, B) int32 — replication is free next to
+    # a decode step, and the constraint is a no-op off-mesh.
+    tokens = jnp.concatenate([constrain(toks, P(None, None)),
+                              constrain(out.tok[None], P(None, None))],
+                             axis=0).T                     # (B, steps + 1)
+    return (tokens, out) if return_carry else tokens
 
 
 def generate_tokens(model, params, input_ids, rng, *, max_new: int,
                     sampler, eos_token_id=None, cache_dtype=None,
-                    flash_decode: bool = False, materialize=None):
+                    flash_decode: bool = False, materialize=None,
+                    cache_len=None):
     """Shared prefill + decode-scan generation loop, as ONE traceable fn.
 
     Used by both :class:`~deepspeed_tpu.inference.InferenceEngine` and the
@@ -376,7 +478,7 @@ def generate_tokens(model, params, input_ids, rng, *, max_new: int,
     carry = prefill_tokens(model, params, input_ids, rng, max_new=max_new,
                            sampler=sampler, eos_token_id=eos_token_id,
                            cache_dtype=cache_dtype, flash_decode=flash_decode,
-                           materialize=materialize)
+                           materialize=materialize, cache_len=cache_len)
     return decode_tokens(model, params, carry, steps=max_new - 1,
                          sampler=sampler, eos_token_id=eos_token_id,
                          flash_decode=flash_decode)

@@ -31,7 +31,7 @@ from .config import InferenceConfig
 from .decode import decode_tokens, generate_tokens, prefill_tokens
 from .quantization import (dequantize_params, quantize_params,
                            quantized_bytes, quantized_shardings)
-from .sampling import sample_logits
+from .sampling import per_request_keys, sample_logits
 
 # Compiled generate programs kept per engine (each pins an executable).
 _MAX_COMPILED_SHAPES = 32
@@ -139,7 +139,14 @@ class InferenceEngine:
                 lambda s: NamedSharding(self.mesh, s if s is not None else P()),
                 specs, is_leaf=lambda x: x is None or isinstance(x, P))
             self.params = jax.device_put(cast, shardings)
+        if cfg.decode_chunk < 0:
+            raise ValueError(f"decode_chunk must be >= 0, got "
+                             f"{cfg.decode_chunk}")
         self._gen_cache: OrderedDict = OrderedDict()
+        # split prefill/decode program caches: used by request tracing AND
+        # by the chunked-decode early-stop path (decode_chunk > 0)
+        self._prefill_cache: OrderedDict = OrderedDict()
+        self._decode_cache: OrderedDict = OrderedDict()
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._fwd = jax.jit(self._forward_impl)
         # Request tracing (observability): ring buffer + Serve/* registry.
@@ -164,8 +171,6 @@ class InferenceEngine:
                 ring_size=cfg.trace_ring_size,
                 bytes_per_step=decode_weight_bytes(self.params),
                 peak_bw=peak_bw)
-            self._prefill_cache: OrderedDict = OrderedDict()
-            self._decode_cache: OrderedDict = OrderedDict()
 
     # ------------------------------------------------------------ qkv fuse
     def _can_fuse_qkv(self, params) -> bool:
@@ -237,7 +242,7 @@ class InferenceEngine:
     # ------------------------------------------------------------- generate
     def _generate_impl(self, params, input_ids, rng, *, max_new: int,
                        temperature: float, top_k: int, top_p: float,
-                       greedy: bool):
+                       greedy: bool, cache_len=None):
         # Quantized trees stay int8/int4 through the whole decode scan —
         # the step's consumption sites dispatch per-use (generate_tokens
         # docs). Only the prefill materializes (compute-bound; dense is
@@ -250,7 +255,8 @@ class InferenceEngine:
             eos_token_id=self.config.eos_token_id,
             cache_dtype=self.compute_dtype,
             flash_decode=self.config.flash_decode_resolved(),
-            materialize=self._materialized if self.config.quantize else None)
+            materialize=self._materialized if self.config.quantize else None,
+            cache_len=cache_len)
 
     def _sampler(self, temperature: float, top_k: int, top_p: float,
                  greedy: bool):
@@ -259,22 +265,25 @@ class InferenceEngine:
 
     def _prefill_impl(self, params, input_ids, rng, *, max_new: int,
                       temperature: float, top_k: int, top_p: float,
-                      greedy: bool):
+                      greedy: bool, cache_len=None):
         return prefill_tokens(
             self.model, params, input_ids, rng, max_new=max_new,
             sampler=self._sampler(temperature, top_k, top_p, greedy),
             eos_token_id=self.config.eos_token_id,
             cache_dtype=self.compute_dtype,
             flash_decode=self.config.flash_decode_resolved(),
-            materialize=self._materialized if self.config.quantize else None)
+            materialize=self._materialized if self.config.quantize else None,
+            cache_len=cache_len)
 
     def _decode_impl(self, params, carry, *, steps: int, temperature: float,
-                     top_k: int, top_p: float, greedy: bool):
+                     top_k: int, top_p: float, greedy: bool,
+                     return_carry: bool = False):
         return decode_tokens(
             self.model, params, carry, steps=steps,
             sampler=self._sampler(temperature, top_k, top_p, greedy),
             eos_token_id=self.config.eos_token_id,
-            flash_decode=self.config.flash_decode_resolved())
+            flash_decode=self.config.flash_decode_resolved(),
+            return_carry=return_carry)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -282,12 +291,23 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: Optional[int] = None, *,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
-                 greedy: bool = False, rng: Optional[jax.Array] = None):
+                 greedy: bool = False, rng: Optional[jax.Array] = None,
+                 request_seeds=None, cache_len: Optional[int] = None):
         """(B, S) prompt ids → (B, max_new_tokens) continuations.
 
         Sampled calls draw from the engine's persistent PRNG stream (pass
-        ``rng`` explicitly for reproducibility). One program is compiled per
-        (shape, knobs) tuple and kept in a bounded LRU.
+        ``rng`` explicitly for reproducibility). ``request_seeds`` — one
+        int per row — switches to per-request sampling streams instead:
+        each row's draws are folded from its own seed, so the same request
+        reproduces bit-identically whether served alone, in any static
+        batch, or through the continuous-batching scheduler
+        (``serving.ServingEngine`` uses the same per-row chains).
+        ``cache_len`` overrides the tight ``S + max_new`` KV allocation —
+        bucket it to serve many shapes from one compiled program, and pin
+        it to the serving engine's ``max_len`` to reproduce a served
+        request exactly (cache width is part of the sampled bit-stream).
+        One program is compiled per (shape, knobs) tuple and kept in a
+        bounded LRU.
         """
         # Non-CLM guard lives in generate_tokens (shared with HybridEngine);
         # re-check here so the error surfaces before a jit trace is built.
@@ -299,36 +319,54 @@ class InferenceEngine:
                 "hidden states) instead")
         input_ids = jnp.asarray(input_ids, jnp.int32)
         max_new = int(max_new_tokens or self.config.max_out_tokens)
-        key = (input_ids.shape, max_new, float(temperature), int(top_k),
-               float(top_p), bool(greedy))
+        if request_seeds is not None:
+            if rng is not None:
+                raise ValueError("pass either rng or request_seeds, not both")
+            if len(request_seeds) != input_ids.shape[0]:
+                raise ValueError(
+                    f"request_seeds has {len(request_seeds)} entries for a "
+                    f"batch of {input_ids.shape[0]}")
+            rng = per_request_keys(request_seeds)
         rng = rng if rng is not None else self._next_rng()
+        if cache_len is not None:
+            cache_len = int(cache_len)
+        # rng shape is part of the program signature: a (B, 2) per-row key
+        # stack samples through vmapped draws, a (2,) key through one
+        key = (input_ids.shape, tuple(rng.shape), max_new, cache_len,
+               float(temperature), int(top_k), float(top_p), bool(greedy))
         knobs = dict(temperature=temperature, top_k=top_k, top_p=top_p,
                      greedy=greedy)
+        if self.config.decode_chunk > 0:
+            return self._chunked_generate(input_ids, rng, key, max_new,
+                                          knobs, cache_len)
         if self.tracer is not None:
-            return self._traced_generate(input_ids, rng, key, max_new, knobs)
+            return self._traced_generate(input_ids, rng, key, max_new,
+                                         knobs, cache_len)
         # Fast path: ONE fused prefill+decode program, nothing read back to
         # the host until the caller consumes the tokens — tracing disabled
         # means zero added synchronization.
         fn = self._cached(self._gen_cache, key, lambda: jax.jit(
-            partial(self._generate_impl, max_new=max_new, **knobs)))
+            partial(self._generate_impl, max_new=max_new,
+                    cache_len=cache_len, **knobs)))
         with self.mesh:
             return fn(self.params, input_ids, rng)
 
     @staticmethod
-    def _cached(cache: OrderedDict, key, build):
-        """Get-or-build with the engine's bounded-LRU policy (one policy,
-        three program caches: fused / prefill / decode)."""
+    def _cached(cache: OrderedDict, key, build, cap: int = _MAX_COMPILED_SHAPES):
+        """Get-or-build with the engine's bounded-LRU policy (ONE policy:
+        the fused / prefill / decode caches here and the serving engine's
+        program cache all go through this)."""
         fn = cache.get(key)
         if fn is None:
             fn = cache[key] = build()
-            if len(cache) > _MAX_COMPILED_SHAPES:
+            if len(cache) > cap:
                 cache.popitem(last=False)
         else:
             cache.move_to_end(key)
         return fn
 
     def _traced_generate(self, input_ids, rng, key, max_new: int,
-                         knobs: dict):
+                         knobs: dict, cache_len=None):
         """Request-traced generation: prefill and decode as two compiled
         programs so their wall times are separable (TTFT vs per-token
         decode). Costs one host sync between the phases; tokens match the
@@ -336,7 +374,8 @@ class InferenceEngine:
         B, S = input_ids.shape
         cold = key not in self._prefill_cache
         pf = self._cached(self._prefill_cache, key, lambda: jax.jit(
-            partial(self._prefill_impl, max_new=max_new, **knobs)))
+            partial(self._prefill_impl, max_new=max_new,
+                    cache_len=cache_len, **knobs)))
         # The carry (KV cache above all) is dead after the decode call:
         # donate it so the scan reuses the prefill cache buffers in place —
         # matching the fused path, where the cache lives in the scan carry
@@ -357,6 +396,67 @@ class InferenceEngine:
         t2 = clock()
         self.tracer.observe(batch=B, prompt_len=S, new_tokens=max_new,
                             prefill_s=t1 - t0, decode_s=t2 - t1, cold=cold)
+        return out
+
+    def _chunked_generate(self, input_ids, rng, key, max_new: int,
+                          knobs: dict, cache_len=None):
+        """Decode in ``decode_chunk``-step chunks with a host-side
+        ``done.all()`` check between chunks: a batch where every row hit
+        eos stops paying for the dead tail of max_new_tokens. Costs one
+        host sync per chunk; tokens are bit-identical to the fused path
+        (post-eos rows emit eos there too, and the early-stopped tail is
+        eos-filled here)."""
+        import numpy as np
+
+        chunk = int(self.config.decode_chunk)
+        eos = self.config.eos_token_id
+        B, S = input_ids.shape
+        cold = key not in self._prefill_cache
+        clock = self.tracer.clock if self.tracer is not None else None
+        pf = self._cached(self._prefill_cache, key, lambda: jax.jit(
+            partial(self._prefill_impl, max_new=max_new,
+                    cache_len=cache_len, **knobs)))
+        t0 = clock() if clock else 0.0
+        parts = []
+        with self.mesh:
+            carry = pf(self.params, input_ids, rng)
+            if clock:
+                jax.block_until_ready(carry)
+            t1 = clock() if clock else 0.0
+            remaining = max_new - 1
+            if remaining == 0:   # prefill's token is the whole output
+                parts.append(np.asarray(carry.tok)[:, None])
+            first = True
+            while remaining > 0:
+                steps = min(chunk, remaining)
+                # a decode chunk program compiling MID-request (e.g. the
+                # ragged final chunk of a budget an earlier early-stopped
+                # request never reached) is a cold sample too — its compile
+                # seconds must stay out of the latency reservoirs
+                cold = cold or (key, steps) not in self._decode_cache
+                # same donation contract as the traced path: the carry's
+                # KV cache is dead after the call — reuse it in place
+                dc = self._cached(
+                    self._decode_cache, (key, steps), lambda: jax.jit(
+                        partial(self._decode_impl, steps=steps,
+                                return_carry=True, **knobs),
+                        donate_argnums=(1,)))
+                seg, carry = dc(self.params, carry)
+                # chunk returns [carry_tok, d1..d_steps]; the carry token
+                # is the previous chunk's last emitted column
+                parts.append(np.asarray(seg if first else seg[:, 1:]))
+                first = False
+                remaining -= steps
+                if remaining > 0 and eos is not None \
+                        and bool(np.asarray(carry.done).all()):
+                    parts.append(np.full((B, remaining), eos, np.int32))
+                    break
+        out = jnp.asarray(np.concatenate(parts, axis=1))
+        if self.tracer is not None:
+            t2 = clock()
+            self.tracer.observe(batch=B, prompt_len=S, new_tokens=max_new,
+                                prefill_s=t1 - t0, decode_s=t2 - t1,
+                                cold=cold)
         return out
 
     def metrics_snapshot(self) -> dict:
@@ -381,15 +481,10 @@ class InferenceEngine:
         events written (0 when tracing is off)."""
         if self.tracer is None:
             return 0
-        reg = self.tracer.registry
-        if step is None:
-            step = int(reg.snapshot()["counters"].get("Serve/requests", 0))
-        events = reg.to_events(step)
-        monitor.write_events(events)
-        fl = getattr(monitor, "flush", None)
-        if fl is not None:
-            fl()
-        return len(events)
+        from ..observability.metrics import publish_registry
+
+        return publish_registry(self.tracer.registry, monitor, step,
+                                default_step_counter="Serve/requests")
 
 
 def init_inference(model, params=None, config: InferenceConfig | dict | None = None,

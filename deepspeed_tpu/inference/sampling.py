@@ -4,6 +4,14 @@ Reference analog: generation policy handled by HF ``generate`` on top of the
 reference engine; here sampling is jit-compiled alongside the decode step.
 All samplers are static-shape (top-k via ``lax.top_k``, top-p via sorted
 cumulative mass) so the whole generation loop stays one compiled program.
+
+RNG comes in two layouts, chosen by the caller's key shape:
+- one (2,) key: a single sampling stream for the whole batch (the
+  classic ``generate()`` contract — batch composition changes the draws);
+- a (B, 2) per-row key stack: every row draws from its OWN stream. A row
+  keyed from its request seed then samples identically whether it runs
+  alone, in a static batch, or through the serving scheduler — the
+  property the continuous-batching parity tests pin down.
 """
 
 from __future__ import annotations
@@ -13,9 +21,29 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def split_keys(rng):
+    """``jax.random.split`` that also accepts a (B, 2) per-row key stack —
+    each row splits its own chain, independent of every other row (and of
+    how many rows the batch happens to hold)."""
+    if rng.ndim == 2:
+        ks = jax.vmap(jax.random.split)(rng)        # (B, 2, 2)
+        return ks[:, 0], ks[:, 1]
+    return jax.random.split(rng)
+
+
+def per_request_keys(seeds) -> jnp.ndarray:
+    """(B,) request seeds → (B, 2) per-row key stack (host-side helper).
+
+    Keys are folded from the request SEED, never from the row index, so a
+    request's sampling stream is invariant to where it lands in a batch
+    or which serving slot it occupies."""
+    return jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+
+
 def sample_logits(logits, rng, *, temperature: float = 1.0, top_k: int = 0,
                   top_p: float = 1.0, greedy: bool = False):
-    """logits: (B, V) → (B,) int32 token ids."""
+    """logits: (B, V) → (B,) int32 token ids. ``rng``: one (2,) key or a
+    (B, 2) per-row stack (each row then draws from its own stream)."""
     if greedy or temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / jnp.float32(max(temperature, 1e-6))
@@ -31,4 +59,19 @@ def sample_logits(logits, rng, *, temperature: float = 1.0, top_k: int = 0,
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
                                      axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    if rng.ndim == 2:
+        # Per-row draws sample over fully REPLICATED logits: the vmapped
+        # per-key gumbel-argmax composes badly with vocab-'model'-sharded
+        # logits under GSPMD (each shard's correct index gets summed by a
+        # spurious cross-shard reduce — token id x tp_size garbage). A
+        # (B, V) gather at the sample point is noise next to a decode
+        # step, and the constraint is a no-op off-mesh, so single-chip
+        # draws are unchanged bit-for-bit. The single-key path below keeps
+        # its original sharded lowering (correct since PR 0, TP-tested).
+        from jax.sharding import PartitionSpec as P
+
+        from ..platform.mesh import constrain
+
+        logits = constrain(logits, P())
+        return jax.vmap(jax.random.categorical)(rng, logits).astype(jnp.int32)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -8,7 +8,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
                       get_registry)
 from .sinks import (JsonlSink, PrometheusTextfileSink,
                     parse_prometheus_textfile, prometheus_name)
-from .tracing import RequestRecord, RequestTracer
+from .tracing import RequestRecord, RequestTracer, ServingStats
 from .xla import TraceWindow, sample_memory
 
 __all__ = [
@@ -16,6 +16,6 @@ __all__ = [
     "get_registry",
     "JsonlSink", "PrometheusTextfileSink", "parse_prometheus_textfile",
     "prometheus_name",
-    "RequestRecord", "RequestTracer",
+    "RequestRecord", "RequestTracer", "ServingStats",
     "TraceWindow", "sample_memory",
 ]

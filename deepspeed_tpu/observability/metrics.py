@@ -232,6 +232,27 @@ class MetricsRegistry:
             self._histograms.clear()
 
 
+def publish_registry(registry: MetricsRegistry, monitor,
+                     step: Optional[int] = None,
+                     default_step_counter: Optional[str] = None) -> int:
+    """Push a registry through a monitor fan-out — a ``MonitorMaster`` or
+    anything with ``write_events([(name, value, step)])`` — flushing if the
+    monitor supports it. ``step`` defaults to the value of
+    ``default_step_counter`` (e.g. requests served): serving loops have no
+    universal step cadence, so the caller names the clock. Returns the
+    number of events written. The single implementation behind both
+    engines' ``publish_metrics``."""
+    if step is None:
+        step = int(registry.counter(default_step_counter).value) \
+            if default_step_counter else 0
+    events = registry.to_events(step)
+    monitor.write_events(events)
+    fl = getattr(monitor, "flush", None)
+    if fl is not None:
+        fl()
+    return len(events)
+
+
 _DEFAULT: Optional[MetricsRegistry] = None
 _DEFAULT_LOCK = threading.Lock()
 

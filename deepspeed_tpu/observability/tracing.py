@@ -142,3 +142,108 @@ class RequestTracer:
             "recent": recent,
         }
         return out
+
+
+class ServingStats:
+    """Scheduler-side serving accounting: the load picture the per-request
+    :class:`RequestTracer` can't see.
+
+    Where the tracer attributes ONE request's latency (TTFT/TPOT of a lone
+    ``generate()``), this records the continuous-batching picture: queue
+    depth, slot occupancy, admission/retirement counters, per-request TTFT
+    and TPOT *under load* (a request's first token waits behind whatever
+    the scheduler interleaved before it), and aggregate goodput — completed
+    tokens per second across all requests, the number static batching
+    leaves on the table. Everything lands in ``Serve/*`` names of a
+    :class:`~.metrics.MetricsRegistry`, so the same MonitorMaster sinks
+    (JSONL / Prometheus / CSV / TensorBoard) that carry ``Train/*`` carry
+    these.
+
+    ``clock`` is injectable (fake-clock scheduler tests drive admission /
+    retirement order without a device).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.clock = clock
+        self._t0: Optional[float] = None     # first admission: goodput window
+        self.completed_tokens = 0
+
+    def reset(self) -> None:
+        """Clear every Serve/* series and restart the goodput window —
+        benches call this between the warmup pass (compile-laden TTFT/TPOT
+        samples) and the measured pass."""
+        self.registry.reset()
+        self._t0 = None
+        self.completed_tokens = 0
+
+    # ---------------------------------------------------- request lifecycle
+    def on_submit(self, queue_depth: int) -> float:
+        t = self.clock()
+        r = self.registry
+        r.counter("Serve/submitted").inc()
+        r.gauge("Serve/queue_depth").set(queue_depth)
+        return t
+
+    def on_admit(self, queue_depth: int) -> float:
+        t = self.clock()
+        if self._t0 is None:
+            self._t0 = t
+        r = self.registry
+        r.counter("Serve/admitted").inc()
+        r.gauge("Serve/queue_depth").set(queue_depth)
+        return t
+
+    def on_first_token(self, submit_t: float) -> float:
+        t = self.clock()
+        self.registry.histogram("Serve/ttft_s").observe(t - submit_t)
+        return t
+
+    def on_retire(self, n_tokens: int, first_token_t: float) -> float:
+        """A request finished with ``n_tokens`` generated."""
+        t = self.clock()
+        r = self.registry
+        r.counter("Serve/retired").inc()
+        r.counter("Serve/completed_tokens").inc(n_tokens)
+        self.completed_tokens += n_tokens
+        if n_tokens > 1:
+            r.histogram("Serve/tpot_s").observe(
+                (t - first_token_t) / (n_tokens - 1))
+        if self._t0 is not None and t > self._t0:
+            r.gauge("Serve/goodput_tps").set(
+                self.completed_tokens / (t - self._t0))
+        return t
+
+    # ------------------------------------------------------- per-iteration
+    def on_iteration(self, queue_depth: int, occupied: int, slots: int,
+                     prefill_chunk: bool, decode_ran: bool = False) -> None:
+        r = self.registry
+        r.counter("Serve/iterations").inc()
+        if prefill_chunk:
+            r.counter("Serve/prefill_chunks").inc()
+        if decode_ran:
+            # decode_steps x slots is the slot-step work the batch paid —
+            # against sum(max_new) it gives the occupancy-efficiency the
+            # bench compares to static batching's dead tail
+            r.counter("Serve/decode_steps").inc()
+        r.gauge("Serve/queue_depth").set(queue_depth)
+        r.gauge("Serve/slot_occupancy").set(occupied / max(1, slots))
+
+    def snapshot(self) -> dict:
+        snap = self.registry.snapshot()
+        c, g, h = snap["counters"], snap["gauges"], snap["histograms"]
+        return {
+            "submitted": int(c.get("Serve/submitted", 0)),
+            "admitted": int(c.get("Serve/admitted", 0)),
+            "retired": int(c.get("Serve/retired", 0)),
+            "completed_tokens": int(c.get("Serve/completed_tokens", 0)),
+            "iterations": int(c.get("Serve/iterations", 0)),
+            "prefill_chunks": int(c.get("Serve/prefill_chunks", 0)),
+            "decode_steps": int(c.get("Serve/decode_steps", 0)),
+            "queue_depth": g.get("Serve/queue_depth"),
+            "slot_occupancy": g.get("Serve/slot_occupancy"),
+            "goodput_tps": g.get("Serve/goodput_tps"),
+            "ttft_s": h.get("Serve/ttft_s", {}),
+            "tpot_s": h.get("Serve/tpot_s", {}),
+        }

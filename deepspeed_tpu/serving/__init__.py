@@ -1,0 +1,17 @@
+"""Continuous-batching serving layer (reference: DeepSpeed-MII / FastGen).
+
+Built on the split ``prefill_tokens``/``decode_tokens`` programs: a
+slot-based persistent KV cache (``slots.py``), a host-side scheduler with
+chunked SplitFuse-style prefill (``scheduler.py``), and a
+``submit()/step()/drain()`` engine whose steady state reuses a bounded,
+shape-bucketed compiled-program set (``engine.py``). Outputs are
+bit-identical to single-request ``generate()`` with the same request seed
+— see docs/SERVING.md.
+"""
+
+from .engine import ServingEngine
+from .scheduler import ChunkPlan, Request, Scheduler, plan_chunks
+from .slots import init_slots, insert_request
+
+__all__ = ["ServingEngine", "Scheduler", "Request", "ChunkPlan",
+           "plan_chunks", "init_slots", "insert_request"]

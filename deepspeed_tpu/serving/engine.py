@@ -1,0 +1,297 @@
+"""ServingEngine: continuous batching over the split prefill/decode programs.
+
+Reference analog: DeepSpeed-MII / FastGen's serving loop (continuous
+batching + Dynamic SplitFuse scheduling) re-expressed for XLA's
+static-shape world. The engine owns three device assets:
+
+- a slot state (``slots.py``): ONE persistent (L, slots, KV, max_len, hd)
+  KV cache plus per-slot length/tok/rng/done vectors, advanced by ONE
+  compiled decode-step program regardless of which requests occupy it;
+- a prefill lane: per-request chunked prefill through shape-bucketed
+  chunk programs (every chunk is ``prefill_chunk`` tokens or a power-of-two
+  bucket below it), at most one chunk per iteration so running requests'
+  TPOT is never stalled by a long prompt;
+- one insert program that writes a finished prefill into its slot
+  (donated ``dynamic_update_slice`` — in place, full slot extent).
+
+Steady state therefore compiles a BOUNDED program set — decode step +
+insert + (2 x bucket count) prefill programs — and ``compiles`` counts
+every build so the bench smoke test can assert no compilation happens
+after warmup. Outputs are bit-identical to single-request
+``generate(request_seeds=[seed], cache_len=max_len)``: per-request RNG
+chains are folded from the request seed (never the slot or batch
+position), and the decode step is literally the same ``decode_step`` the
+static path scans.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference.config import ServingConfig
+from ..inference.decode import (GenCarry, decode_step, forward_with_cache,
+                                init_cache)
+from ..inference.engine import InferenceEngine
+from ..inference.sampling import per_request_keys, split_keys
+from ..observability.tracing import ServingStats
+from .scheduler import Request, Scheduler
+from .slots import init_slots, insert_request
+
+# Serving programs kept per engine; generously above the steady-state set
+# (decode step + insert + 2 programs per chunk bucket) so eviction means a
+# config bug, not normal traffic.
+_MAX_PROGRAMS = 64
+# Finished requests retained for pop_result(); a long-running server that
+# never collects results must not leak host memory without bound.
+_MAX_RESULTS = 4096
+
+
+class ServingEngine:
+    """submit()/step()/drain() continuous batching on an InferenceEngine.
+
+    ``engine`` supplies params, mesh, model, dtype, quantization and eos;
+    ``serving`` (a :class:`~..inference.config.ServingConfig` or dict)
+    supplies slots/max_len/prefill_chunk and the sampling policy. Serve/*
+    load metrics land in ``stats.registry`` — pass ``registry`` to share
+    one registry with the engine's request tracer, and ``clock`` to fake
+    time in tests.
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 serving: ServingConfig | dict | None = None,
+                 registry=None, clock=None):
+        self.engine = engine
+        if serving is None:
+            serving = engine.config.serving
+        self.cfg = ServingConfig.from_any(serving)
+        self.model = engine.model
+        mcfg = self.model.cfg
+        if getattr(mcfg, "pos_embedding", None) == "learned" \
+                and self.cfg.max_len > mcfg.max_seq:
+            raise ValueError(
+                f"serving max_len={self.cfg.max_len} exceeds the model's "
+                f"learned-position table (max_seq={mcfg.max_seq})")
+        self._flash = engine.config.flash_decode_resolved()
+        if self._flash and self.cfg.max_len % 128 != 0:
+            raise ValueError(
+                f"flash_decode needs max_len to be a multiple of 128 "
+                f"(Pallas lane blocks), got {self.cfg.max_len} — round up "
+                "or set flash_decode=False")
+        self._eos = engine.config.eos_token_id
+        self._sampler = engine._sampler(self.cfg.temperature, self.cfg.top_k,
+                                        self.cfg.top_p, self.cfg.greedy)
+        self._mat = engine._materialized if engine.config.quantize else None
+        kw = {"clock": clock} if clock is not None else {}
+        self.stats = ServingStats(registry=registry, **kw)
+        self.sched = Scheduler(self.cfg.slots, self.cfg.max_len,
+                               self.cfg.prefill_chunk,
+                               max_queue=self.cfg.max_queue,
+                               eos_token_id=self._eos, stats=self.stats)
+        self._programs: OrderedDict = OrderedDict()
+        self.compiles = 0        # program builds — bounded in steady state
+        # finished requests awaiting pickup, BOUNDED (oldest evicted): a
+        # server whose caller consumes step()'s return values — or
+        # pop_result() — never grows this; one that ignores results still
+        # can't leak without bound
+        self.results: OrderedDict[int, Request] = OrderedDict()
+        # (request, chunk plan, next chunk idx, device prefill cache, rng)
+        self._prefill = None
+        with self.engine.mesh:
+            self._state = self._prog("init_slots", lambda: jax.jit(
+                lambda: init_slots(mcfg, self.cfg.slots, self.cfg.max_len,
+                                   engine.compute_dtype)))()
+
+    # ----------------------------------------------------------- programs
+    def _prog(self, key, build):
+        """InferenceEngine._cached's bounded LRU + a compile counter
+        (every build is one XLA compilation — the smoke test asserts the
+        count freezes after warmup)."""
+        def counted():
+            self.compiles += 1
+            return build()
+
+        return InferenceEngine._cached(self._programs, key, counted,
+                                       cap=_MAX_PROGRAMS)
+
+    def _chunk_impl(self, params, cache, ids, start):
+        """Intermediate prefill chunk: extend the request cache; the head
+        is never computed (nothing consumes the logits, XLA removes it)."""
+        cache = cache._replace(length=start)
+        mat = self._mat if self._mat is not None else (lambda p: p)
+        _, cache = forward_with_cache(self.model, mat(params), ids, cache)
+        return cache
+
+    def _final_impl(self, params, cache, ids, start, last_index, true_len,
+                    rng):
+        """Final prefill chunk: extend the cache AND sample the first token
+        from the last real position (``last_index`` — right-padded buckets
+        put it before the chunk end), leaving the cache at ``true_len``."""
+        cache = cache._replace(length=start)
+        mat = self._mat if self._mat is not None else (lambda p: p)
+        logits, cache = forward_with_cache(
+            self.model, mat(params), ids, cache, last_token_head=True,
+            last_index=last_index)
+        rng, sub = split_keys(rng)
+        tok = self._sampler(logits[:, -1], sub)
+        done = (tok == self._eos) if self._eos is not None \
+            else jnp.zeros(tok.shape, bool)
+        return GenCarry(tok=tok, cache=cache._replace(length=true_len),
+                        rng=rng, done=done)
+
+    def _step_impl(self, params, carry):
+        return decode_step(self.model, params, carry, sampler=self._sampler,
+                           eos_token_id=self._eos, flash_decode=self._flash)
+
+    # ------------------------------------------------------------- intake
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               seed: int = 0) -> int:
+        """Queue one request; returns its request id. Tokens sample with
+        a per-request RNG folded from ``seed`` — bit-identical (up to eos
+        truncation) to ``engine.generate(prompt[None], max_new,
+        request_seeds=[seed], cache_len=<serving max_len>, ...)`` with the
+        same sampling knobs; ``cache_len`` must match because the cache
+        width is part of the sampled bit-stream."""
+        max_new = int(max_new_tokens or self.engine.config.max_out_tokens)
+        req = self.sched.submit(prompt, max_new, seed)
+        return req.rid
+
+    # ------------------------------------------------------------ serving
+    def step(self) -> list[Request]:
+        """One serving iteration: <= 1 prefill chunk + 1 decode step over
+        the occupied slots. Returns requests that finished this iteration
+        (their ``tokens`` lists are final; also kept in ``results``)."""
+        finished: list[Request] = []
+        ran_chunk = ran_decode = False
+        with self.engine.mesh:
+            # admission: start the head-of-queue request's prefill
+            if self._prefill is None:
+                req = self.sched.pop_next()
+                if req is not None:
+                    cache = self._prog("init_cache", lambda: jax.jit(
+                        lambda: init_cache(self.model.cfg, 1,
+                                           self.cfg.max_len,
+                                           self.engine.compute_dtype)))()
+                    self._prefill = (req, self.sched.plan(req), 0, cache,
+                                     per_request_keys([req.seed]))
+            # prefill lane: one bucket-shaped chunk per iteration
+            if self._prefill is not None:
+                finished += self._prefill_advance()
+                ran_chunk = True
+            # decode lane: every occupied slot advances one token
+            if self.sched.running:
+                step = self._prog("step", lambda: jax.jit(
+                    self._step_impl, donate_argnums=(1,)))
+                self._state = step(self.engine.params, self._state)
+                # ONE fused host read-back per iteration (tok + done
+                # together): the per-iteration sync is the scheduler's
+                # steering cost — don't pay it twice
+                toks, dones = jax.device_get((self._state.tok,
+                                              self._state.done))
+                finished += self.sched.on_step(toks, dones)
+                ran_decode = True
+        self.stats.on_iteration(self.sched.queue_depth, self.sched.occupancy,
+                                self.cfg.slots, ran_chunk, ran_decode)
+        for req in finished:
+            self.results[req.rid] = req
+            if len(self.results) > _MAX_RESULTS:
+                self.results.popitem(last=False)
+        return finished
+
+    def _prefill_advance(self) -> list[Request]:
+        req, plan, idx, cache, rng = self._prefill
+        ch = plan[idx]
+        ids = jnp.asarray(ch.ids[None], jnp.int32)
+        params = self.engine.params
+        if not ch.final:
+            fwd = self._prog(("chunk", ch.size), lambda: jax.jit(
+                self._chunk_impl, donate_argnums=(1,)))
+            cache = fwd(params, cache, ids, jnp.int32(ch.start))
+            self._prefill = (req, plan, idx + 1, cache, rng)
+            return []
+        fin = self._prog(("final", ch.size), lambda: jax.jit(
+            self._final_impl, donate_argnums=(1,)))
+        pf = fin(params, cache, ids, jnp.int32(ch.start),
+                 jnp.int32(ch.last_index), jnp.int32(ch.true_len), rng)
+        self._prefill = None
+        first_tok = int(np.asarray(pf.tok)[0])
+        if req.max_new == 1 or bool(np.asarray(pf.done)[0]):
+            return [self.sched.complete_at_prefill(req, first_tok)]
+        slot = self.sched.place(req, first_tok)
+        # donate only the slot state: the batch-1 prefill buffers have
+        # different shapes and could never alias the slot cache anyway
+        ins = self._prog("insert", lambda: jax.jit(
+            insert_request, donate_argnums=(0,)))
+        self._state = ins(self._state, jnp.int32(slot), pf)
+        return []
+
+    def drain(self, max_iterations: int = 1_000_000) -> dict[int, Request]:
+        """Run until queue and slots are empty; returns ``results``."""
+        it = 0
+        while not self.sched.idle or self._prefill is not None:
+            self.step()
+            it += 1
+            if it > max_iterations:
+                raise RuntimeError(
+                    f"serving failed to drain in {max_iterations} "
+                    "iterations — scheduler wedged?")
+        return self.results
+
+    def pop_result(self, rid: int) -> Optional[Request]:
+        """Collect (and release) a finished request; None if not finished
+        or already collected."""
+        return self.results.pop(rid, None)
+
+    def serve_batch(self, prompts, max_new_tokens=None, seeds=None) -> list:
+        """Convenience: submit a list of (ragged) prompts, drain, return
+        each request's tokens as an int32 array, in submission order.
+        ``max_new_tokens`` and ``seeds`` may be scalars or per-request
+        lists. Results are collected (popped) — repeated calls on one
+        engine don't accumulate host state."""
+        n = len(prompts)
+
+        def expand(v, default):
+            # per-request list/tuple/ndarray OR one scalar for everyone
+            if v is None:
+                return [default] * n
+            if isinstance(v, (list, tuple, np.ndarray)):
+                if len(v) != n:
+                    raise ValueError(f"expected {n} per-request values, "
+                                     f"got {len(v)}")
+                return [x if x is None else int(x) for x in v]
+            return [int(v)] * n
+
+        mn = expand(max_new_tokens, None)
+        sd = expand(seeds, 0)
+        rids = [self.submit(p, mn[i], seed=sd[i]) for i, p in
+                enumerate(prompts)]
+        want = set(rids)
+        got: dict[int, Request] = {}
+        it = 0
+        while len(got) < n:
+            for req in self.step():
+                if req.rid in want:
+                    got[req.rid] = req
+                    self.results.pop(req.rid, None)
+            it += 1
+            if it > 1_000_000:
+                raise RuntimeError("serve_batch failed to finish — "
+                                   "scheduler wedged?")
+        return [np.asarray(got[r].tokens, np.int32) for r in rids]
+
+    # ------------------------------------------------------------ metrics
+    def metrics_snapshot(self) -> dict:
+        return {"compiles": self.compiles, **self.stats.snapshot()}
+
+    def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
+        """Push ``Serve/*`` through a monitor fan-out (same contract as
+        ``InferenceEngine.publish_metrics`` — the serving loop owns the
+        cadence)."""
+        from ..observability.metrics import publish_registry
+
+        return publish_registry(self.stats.registry, monitor, step,
+                                default_step_counter="Serve/iterations")

@@ -1,0 +1,226 @@
+"""Host-side continuous-batching scheduler: queue, slots, chunk plans.
+
+Reference analog: DeepSpeed-MII / FastGen's Dynamic SplitFuse scheduler —
+the policy half of continuous batching, split from the device half
+(``slots.py`` / ``engine.py``) so it runs on plain numpy + floats and is
+testable with a fake clock and no accelerator.
+
+Policy, per serving iteration (see ``ServingEngine.step``):
+
+1. admission — if a slot is free, no prefill is in flight, and the queue
+   is non-empty, the head request starts prefilling;
+2. chunked prefill — at most ONE prompt chunk runs per iteration, so a
+   long prompt never stalls running requests' TPOT for more than a chunk
+   (Dynamic SplitFuse's interleave-heterogeneous-work principle, applied
+   as program interleaving instead of a fused megabatch — static shapes
+   stay static);
+3. decode — every occupied slot advances one token;
+4. retirement — rows that hit eos or their max_new free their slot
+   immediately; the slot is reusable the very next iteration.
+
+Chunk plans are shape-bucketed: every chunk is either exactly
+``prefill_chunk`` tokens or a power-of-two bucket below it, so the steady
+state reuses a compiled-program set bounded by the bucket count — no
+matter what prompt lengths traffic brings (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..observability.tracing import ServingStats
+
+_MIN_BUCKET = 8   # smallest residual-chunk program; below this, right-pad
+
+
+@dataclasses.dataclass
+class ChunkPlan:
+    """One prefill chunk: run ``ids`` (already bucket-sized) with the cache
+    length rewound to ``start``; ``final`` chunks also sample the first
+    token from position ``last_index`` and set the cache to ``true_len``.
+
+    Two bucketing tricks keep shapes bounded WITHOUT corrupting the cache:
+    - overlap: a residual of r tokens re-runs the last ``size`` >= r prompt
+      tokens (recomputing a suffix writes bit-identical KV, so rewinding
+      ``start`` is free) — used whenever the prompt is long enough;
+    - right-pad: short prompts pad up to the bucket; the pad's garbage KV
+      lands at positions >= ``true_len``, which the attention mask already
+      ignores and the first decode steps progressively overwrite.
+    """
+
+    start: int                    # cache position this chunk writes from
+    ids: np.ndarray               # (size,) int32 token ids (padded if needed)
+    final: bool = False
+    last_index: int = 0           # position of the last REAL token in ids
+    true_len: int = 0             # prompt length the cache ends at
+
+    @property
+    def size(self) -> int:
+        return len(self.ids)
+
+
+def _pow2_ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def plan_chunks(prompt: np.ndarray, chunk: int) -> list:
+    """Split one prompt into bucket-shaped prefill chunks.
+
+    Full ``chunk``-size chunks cover the head of the prompt; the residual
+    runs as the smallest power-of-two bucket >= max(residual, 8), via
+    overlap when the prompt affords it, else right-padding."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    P = len(prompt)
+    if P < 1:
+        raise ValueError("empty prompt")
+    k = (P - 1) // chunk                 # full chunks before the residual
+    r = P - k * chunk                    # residual, in (0, chunk]
+    plans = [ChunkPlan(start=i * chunk, ids=prompt[i * chunk:(i + 1) * chunk])
+             for i in range(k)]
+    b = max(_MIN_BUCKET, _pow2_ceil(r))
+    if P >= b:        # overlap: recompute the last b prompt tokens
+        plans.append(ChunkPlan(start=P - b, ids=prompt[P - b:], final=True,
+                               last_index=b - 1, true_len=P))
+    else:             # short prompt: right-pad to the bucket
+        ids = np.concatenate([prompt, np.zeros(b - P, np.int32)])
+        plans.append(ChunkPlan(start=0, ids=ids, final=True,
+                               last_index=P - 1, true_len=P))
+    return plans
+
+
+@dataclasses.dataclass
+class Request:
+    """One served request, host-side."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new: int
+    seed: int
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    slot: int = -1
+    tokens: list = dataclasses.field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_t is not None
+
+
+class Scheduler:
+    """Queue + slot bookkeeping; all decisions, no device code.
+
+    The engine asks ``pop_next()`` for the next request to prefill, then
+    ``place()``s it into a slot (or ``complete_at_prefill()`` if its first
+    token already finished it), and reports every decode step through
+    ``on_step`` — which appends tokens, retires rows at eos / max_new, and
+    frees their slots. FIFO admission; retirement order is whatever the
+    tokens dictate.
+    """
+
+    def __init__(self, slots: int, max_len: int, prefill_chunk: int,
+                 max_queue: int = 0, eos_token_id: Optional[int] = None,
+                 stats: Optional[ServingStats] = None):
+        self.slots = slots
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        self.max_queue = max_queue
+        self.eos_token_id = eos_token_id
+        self.stats = stats if stats is not None else ServingStats()
+        self.queue: deque[Request] = deque()
+        self.free: list[int] = list(range(slots))
+        self.running: dict[int, Request] = {}
+        self._next_rid = 0
+
+    # -------------------------------------------------------------- intake
+    def submit(self, prompt, max_new: int, seed: int = 0) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new ({max_new}) exceeds the "
+                f"slot capacity max_len={self.max_len} — raise "
+                f"serving.max_len or trim the request")
+        if self.max_queue and len(self.queue) >= self.max_queue:
+            raise RuntimeError(
+                f"serving queue full ({self.max_queue}); apply backpressure")
+        req = Request(rid=self._next_rid, prompt=prompt, max_new=int(max_new),
+                      seed=int(seed))
+        self._next_rid += 1
+        self.queue.append(req)
+        req.submit_t = self.stats.on_submit(len(self.queue))
+        return req
+
+    # ----------------------------------------------------------- admission
+    def pop_next(self) -> Optional[Request]:
+        """Head-of-queue request to start prefilling, if a slot is free.
+        The engine guarantees at most one prefill in flight."""
+        if not self.queue or not self.free:
+            return None
+        req = self.queue.popleft()
+        self.stats.on_admit(len(self.queue))
+        return req
+
+    def plan(self, req: Request) -> list:
+        return plan_chunks(req.prompt, self.prefill_chunk)
+
+    def place(self, req: Request, first_tok: int) -> int:
+        """Prefill finished: record the first token, occupy a slot."""
+        req.first_token_t = self.stats.on_first_token(req.submit_t)
+        req.tokens.append(int(first_tok))
+        slot = self.free.pop(0)
+        req.slot = slot
+        self.running[slot] = req
+        return slot
+
+    def complete_at_prefill(self, req: Request, first_tok: int) -> Request:
+        """max_new == 1, or the first token was eos: done without ever
+        occupying a slot."""
+        req.first_token_t = self.stats.on_first_token(req.submit_t)
+        req.tokens.append(int(first_tok))
+        req.finish_t = self.stats.on_retire(len(req.tokens),
+                                            req.first_token_t)
+        return req
+
+    # -------------------------------------------------------------- decode
+    def on_step(self, toks: np.ndarray, dones: np.ndarray) -> list:
+        """Account one slot decode step: per-slot next tokens + done flags
+        (device read-back). Returns the requests retired this step."""
+        finished = []
+        for slot in sorted(self.running):
+            req = self.running[slot]
+            req.tokens.append(int(toks[slot]))
+            if bool(dones[slot]) or len(req.tokens) >= req.max_new:
+                req.finish_t = self.stats.on_retire(len(req.tokens),
+                                                    req.first_token_t)
+                del self.running[slot]
+                self.free.append(slot)
+                finished.append(req)
+        return finished
+
+    # ------------------------------------------------------------- readout
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def occupancy(self) -> int:
+        return len(self.running)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.running

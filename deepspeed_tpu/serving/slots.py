@@ -1,0 +1,63 @@
+"""Slot-based decode state: one persistent KV cache, per-slot everything.
+
+Reference analog: DeepSpeed-MII / FastGen's blocked-KV "ragged batching"
+state. TPU-native translation: instead of a paged block table (dynamic
+indirection is hostile to XLA's static shapes), the serving state is ONE
+``(L, slots, KV, max_len, hd)`` cache — the same layout ``init_cache``
+allocates, via the shared :func:`~..inference.decode.cache_layout` — plus
+per-slot ``length`` / ``tok`` / ``rng`` / ``done`` vectors. A finished
+slot is immediately reusable: insertion overwrites the slot's FULL cache
+extent with the freshly prefilled request's cache (one donated
+``dynamic_update_slice``), so stale KV from the previous occupant can
+never leak into a successor's attention, and the decode step stays one
+static-shape program no matter which requests come and go.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..inference.decode import GenCarry, KVCache, cache_layout
+
+__all__ = ["init_slots", "insert_request"]
+
+
+def init_slots(cfg, slots: int, max_len: int, dtype=None) -> GenCarry:
+    """Empty slot state: all slots idle (``done``), length 0.
+
+    The carry is a plain :class:`~..inference.decode.GenCarry` whose cache
+    ``length`` is a (slots,) vector — the decode stack's per-slot paths key
+    off that shape, so the same ``decode_step`` serves both worlds."""
+    shape, dtype = cache_layout(cfg, slots, max_len, dtype)
+    cache = KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                    length=jnp.zeros((slots,), jnp.int32))
+    return GenCarry(tok=jnp.zeros((slots,), jnp.int32), cache=cache,
+                    rng=jnp.zeros((slots, 2), jnp.uint32),
+                    done=jnp.ones((slots,), bool))
+
+
+def insert_request(state: GenCarry, slot, pf: GenCarry) -> GenCarry:
+    """Write a freshly prefilled request (batch-1 carry, same ``max_len``)
+    into slot ``slot``.
+
+    ``slot`` is a traced i32 scalar, so ONE compiled program inserts into
+    any slot. The caller jits this with the state donated: the slot
+    cache updates in place — no second copy of the (L, slots, KV, max_len,
+    hd) buffers ever exists. The update spans the slot's full ``max_len``
+    extent (the prefill cache is allocated at the slot's capacity), which
+    is what guarantees a retired request's stale KV is fully overwritten
+    before the new occupant's first decode step."""
+    kc = state.cache
+    k = lax.dynamic_update_slice(kc.k, pf.cache.k.astype(kc.k.dtype),
+                                 (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(kc.v, pf.cache.v.astype(kc.v.dtype),
+                                 (0, slot, 0, 0, 0))
+    length = lax.dynamic_update_slice(
+        kc.length, pf.cache.length.reshape(1).astype(jnp.int32), (slot,))
+    tok = lax.dynamic_update_slice(state.tok, pf.tok.astype(jnp.int32),
+                                   (slot,))
+    rng = lax.dynamic_update_slice(state.rng, pf.rng, (slot, 0))
+    done = lax.dynamic_update_slice(state.done, pf.done, (slot,))
+    return GenCarry(tok=tok, cache=KVCache(k=k, v=v, length=length),
+                    rng=rng, done=done)
