@@ -202,6 +202,40 @@ class CheckpointConfig(ConfigModel):
     # pointer only flips once the commit is durable (wait_for_checkpoint /
     # the next save/load). Opt-in, like the reference's Nebula engine.
     async_save: bool = False
+    # Integrity manifest level (resilience/integrity.py): "size" writes and
+    # checks per-file sizes + the commit marker (default; catches torn
+    # writes), "checksum" adds per-file sha256 (catches bit rot; costs a
+    # full read-back of the checkpoint at save AND load), "off" restores
+    # pre-resilience trust-the-directory behavior. Load-time failures fall
+    # back to the newest VERIFIED tag (docs/RESILIENCE.md).
+    verify: Literal["off", "size", "checksum"] = "size"
+    # Prune to the newest K tags after each durable commit (0 = keep all).
+    # The tag just written and whatever 'latest' names are never pruned.
+    keep_last: int = 0
+
+
+class ResilienceConfig(ConfigModel):
+    """Crash-safety + runaway-failure guards (docs/RESILIENCE.md).
+
+    ``resume: "auto"`` makes engine construction load the newest loadable
+    checkpoint under ``resume_dir`` (verified-manifest fallback included)
+    and continue — the restart loop (elasticity/agent.py) and a fresh
+    launch then share one code path. An empty/missing directory is a
+    fresh run, not an error.
+
+    ``max_consecutive_bad_steps`` halts training with a typed
+    :class:`~deepspeed_tpu.resilience.guards.NonFiniteLossError` after K
+    consecutive bad optimizer steps — fp16 overflow skips, or a
+    non-finite loss — instead of burning the remaining budget on a
+    collapsed run. Counted exactly per-step on the offload path (the
+    finite flag is already read back each step); on the in-device path it
+    is evaluated at report boundaries from the ``skipped_steps`` delta,
+    so the halt lands within one ``steps_per_print`` window of the
+    collapse (0 = off)."""
+
+    resume: Literal["none", "auto"] = "none"
+    resume_dir: Optional[str] = None
+    max_consecutive_bad_steps: int = 0
 
 
 class DataTypesConfig(ConfigModel):
@@ -386,6 +420,7 @@ class Config(ConfigModel):
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     gradient_compression: GradientCompressionConfig = Field(
         default_factory=GradientCompressionConfig)

@@ -100,6 +100,7 @@ def run_elastic(argv=None) -> int:
     restarts = 0
     port = args.master_port
     last_world = None
+    last_rc = None
     while True:
         world = probe_world(args)
         check_world(args, world)
@@ -107,7 +108,13 @@ def run_elastic(argv=None) -> int:
             print(f"[dstpu-elastic] membership change: world {last_world} "
                   f"-> {world}", file=sys.stderr, flush=True)
         last_world = world
+        # incarnation + last-exit-cause ride the child env: the engine
+        # records them as Train/restarts + Train/last_exit_code, so every
+        # sink (incl. the Prometheus textfile) shows which incarnation is
+        # running and why the previous one died
         env = dict(os.environ, DSTPU_ELASTIC_RESTART=str(restarts))
+        if last_rc is not None:
+            env["DSTPU_ELASTIC_LAST_RC"] = str(last_rc)
         cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
                "--nproc", str(world), "--master_port", str(port)]
         if args.hostfile:
@@ -118,6 +125,7 @@ def run_elastic(argv=None) -> int:
         print(f"[dstpu-elastic] incarnation {restarts}: world={world} "
               f"port={port}", file=sys.stderr, flush=True)
         rc = subprocess.call(cmd, env=env)
+        last_rc = rc
         if rc == 0:
             print(f"[dstpu-elastic] job finished after {restarts} restart(s)",
                   file=sys.stderr, flush=True)

@@ -42,6 +42,21 @@ class ServingConfig:
     top_k: int = 0
     top_p: float = 1.0
     greedy: bool = False
+    # ---- request guards (resilience layer, docs/RESILIENCE.md) ----
+    # Default per-request deadlines on the serving clock, in seconds
+    # (0 = none; submit() accepts per-request overrides). TTFT is measured
+    # submit → first token (queue wait included); total is submit → retire.
+    # Expired requests finish with RequestStatus.TIMEOUT.
+    ttft_deadline_s: float = 0.0
+    total_deadline_s: float = 0.0
+    # Decode-step watchdog: a serving decode step whose wall time exceeds
+    # this logs + counts Serve/watchdog_stalls and flips health() to
+    # degraded (0 = off). Measured around the step's EXISTING host
+    # read-back — the watchdog adds no syncs.
+    watchdog_s: float = 0.0
+    # Deterministic fault injection (resilience.chaos.ChaosConfig | dict).
+    # None/disabled = the engine builds no chaos machinery at all.
+    chaos: "object | None" = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -53,6 +68,14 @@ class ServingConfig:
                 f"bucket set), got {c}")
         if self.max_len < c:
             raise ValueError(f"max_len={self.max_len} < prefill_chunk={c}")
+        for knob in ("ttft_deadline_s", "total_deadline_s", "watchdog_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0, "
+                                 f"got {getattr(self, knob)}")
+        if self.chaos is not None:
+            from ..resilience.chaos import ChaosConfig
+
+            self.chaos = ChaosConfig.from_any(self.chaos)
 
     @classmethod
     def from_any(cls, cfg: "ServingConfig | dict | None") -> "ServingConfig":

@@ -401,26 +401,45 @@ def prefill_tokens(model, params, input_ids, rng, *, max_new: int,
 
 
 def decode_step(model, params, carry: GenCarry, *, sampler,
-                eos_token_id=None, flash_decode: bool = False) -> GenCarry:
+                eos_token_id=None, flash_decode: bool = False,
+                logit_guard: bool = False, poison_row=None):
     """ONE decode iteration: forward the carry token, sample the next.
 
     The single definition shared by :func:`decode_tokens`' scan body and
     the serving engine's slot step (``serving/slots.py``), so the eos
     forcing and rng-split order cannot drift between the static-batch and
     continuous-batching paths — that shared order is what makes serving
-    outputs bit-identical to single-request ``generate()``."""
+    outputs bit-identical to single-request ``generate()``.
+
+    ``logit_guard=True`` (the serving step) additionally returns a (B,)
+    bool of per-row logit finiteness — ``(carry, ok)`` — computed on
+    device and read back fused with the step's existing tok/done sync, so
+    the guard adds ZERO host syncs. Sampling is unchanged either way.
+
+    ``poison_row`` (chaos only; a traced i32 scalar, -1 = none) overwrites
+    that one row's logits with NaN before sampling — AFTER the forward, so
+    the poison can never reach the KV cache or any other row. ``where``
+    with a false mask returns the original logits bit-exactly, so a chaos
+    program running with poison_row=-1 matches the clean program."""
     from .sampling import split_keys
 
     tok, cache, rng, done = carry
     with jax.named_scope("decode_step"):
         lg, cache = forward_with_cache(model, params, tok[:, None], cache,
                                        flash_decode=flash_decode)
+    if poison_row is not None:
+        bad = jnp.arange(lg.shape[0], dtype=jnp.int32)[:, None, None] \
+            == poison_row
+        lg = jnp.where(bad, jnp.float32(float("nan")), lg)
     rng, sub = split_keys(rng)
     nxt = sampler(lg[:, 0], sub)
     if eos_token_id is not None:
         nxt = jnp.where(done, eos_token_id, nxt)
         done = done | (nxt == eos_token_id)
-    return GenCarry(nxt, cache, rng, done)
+    out = GenCarry(nxt, cache, rng, done)
+    if logit_guard:
+        return out, jnp.all(jnp.isfinite(lg), axis=(1, 2))
+    return out
 
 
 def decode_tokens(model, params, carry: GenCarry, *, steps: int, sampler,

@@ -215,6 +215,34 @@ class ServingStats:
                 self.completed_tokens / (t - self._t0))
         return t
 
+    # ------------------------------------------------------ guard outcomes
+    def on_shed(self, queue_depth: int) -> None:
+        """A submit was rejected (queue full / draining) — the SHED path."""
+        self.registry.counter("Serve/shed").inc()
+        self.registry.gauge("Serve/queue_depth").set(queue_depth)
+
+    def on_abort(self, status) -> float:
+        """A request terminated with a non-OK :class:`RequestStatus`
+        (TIMEOUT / CANCELLED / NONFINITE): per-status counter, no goodput
+        credit (aborted tokens are not completed work)."""
+        t = self.clock()
+        name = getattr(status, "value", str(status))
+        self.registry.counter(f"Serve/{name}").inc()
+        self.registry.counter("Serve/aborted").inc()
+        return t
+
+    def on_watchdog_stall(self, step_s: float, threshold_s: float) -> None:
+        """One decode step exceeded the watchdog budget."""
+        r = self.registry
+        r.counter("Serve/watchdog_stalls").inc()
+        r.gauge("Serve/last_stall_s").set(step_s)
+        r.gauge("Serve/watchdog_s").set(threshold_s)
+
+    def on_results_evicted(self) -> None:
+        """The bounded results store dropped its oldest finished request
+        (nobody collected it)."""
+        self.registry.counter("Serve/results_evicted").inc()
+
     # ------------------------------------------------------- per-iteration
     def on_iteration(self, queue_depth: int, occupied: int, slots: int,
                      prefill_chunk: bool, decode_ran: bool = False) -> None:
@@ -241,6 +269,15 @@ class ServingStats:
             "iterations": int(c.get("Serve/iterations", 0)),
             "prefill_chunks": int(c.get("Serve/prefill_chunks", 0)),
             "decode_steps": int(c.get("Serve/decode_steps", 0)),
+            # guard outcomes (resilience layer): sheds, per-status aborts,
+            # watchdog stalls, results-store evictions
+            "shed": int(c.get("Serve/shed", 0)),
+            "aborted": int(c.get("Serve/aborted", 0)),
+            "timeout": int(c.get("Serve/timeout", 0)),
+            "cancelled": int(c.get("Serve/cancelled", 0)),
+            "nonfinite": int(c.get("Serve/nonfinite", 0)),
+            "watchdog_stalls": int(c.get("Serve/watchdog_stalls", 0)),
+            "results_evicted": int(c.get("Serve/results_evicted", 0)),
             "queue_depth": g.get("Serve/queue_depth"),
             "slot_occupancy": g.get("Serve/slot_occupancy"),
             "goodput_tps": g.get("Serve/goodput_tps"),

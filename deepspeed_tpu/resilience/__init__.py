@@ -1,0 +1,40 @@
+"""Resilience layer: typed failure taxonomy, deterministic fault injection,
+and the guards the chaos suite proves.
+
+Reference analog: the reference stack hardens the same seams through its
+elastic agent (restart-on-membership-change) and the Nebula async
+checkpoint service (durable commit markers); serving-side guards follow
+the DeepSpeed-MII production deployment shape (deadlines, cancellation,
+health probes). Here the failure modes are *reproducible on demand*
+(``chaos.py``) so every guard has an end-to-end test:
+
+- ``guards``     — :class:`RequestStatus` and the typed errors callers can
+  catch without string-matching (:class:`QueueFullError`,
+  :class:`NonFiniteLossError`, :class:`CheckpointIntegrityError`);
+- ``chaos``      — seeded, config/env-gated injection points: non-finite
+  logits on decode step N, hung step, process kill between the checkpoint
+  state write and the ``latest`` flip, queue flood, simulated SIGTERM
+  preemption. Zero overhead and inert when disabled;
+- ``integrity``  — checkpoint manifests (per-file checksums, commit marker
+  written last), load-time verification, newest-verified-tag fallback and
+  keep-last-K pruning;
+- ``preempt``    — :class:`PreemptionGuard`: SIGTERM awaits the in-flight
+  async save and flips ``latest`` before exit.
+
+See docs/RESILIENCE.md for the full guard semantics.
+"""
+
+from .chaos import ChaosConfig, ChaosMonkey, kill_point, preempt_step
+from .guards import (CheckpointIntegrityError, NonFiniteLossError,
+                     QueueFullError, RequestStatus)
+from .integrity import (newest_verified_tag, prune_tags, verify_tag,
+                        write_manifest)
+from .preempt import PreemptionGuard
+
+__all__ = [
+    "RequestStatus", "QueueFullError", "NonFiniteLossError",
+    "CheckpointIntegrityError",
+    "ChaosConfig", "ChaosMonkey", "kill_point", "preempt_step",
+    "write_manifest", "verify_tag", "newest_verified_tag", "prune_tags",
+    "PreemptionGuard",
+]

@@ -1,0 +1,73 @@
+"""Typed failure taxonomy: statuses and errors callers can branch on.
+
+The pre-resilience code signalled failures with bare ``RuntimeError``s and
+left callers inferring request outcomes from token shapes. Every guard in
+this layer instead lands in exactly one of these types, so backpressure,
+retry, and triage logic never string-matches a message.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class RequestStatus(enum.Enum):
+    """Terminal outcome of a served request (``Request.status``).
+
+    ``OK``        — finished normally (eos or max_new reached);
+    ``NONFINITE`` — the per-row logit guard saw NaN/Inf in this request's
+                    logits and retired it (other slots are untouched —
+                    the parity test pins bit-identity);
+    ``TIMEOUT``   — a TTFT or total-wall deadline expired;
+    ``CANCELLED`` — ``cancel(rid)`` retired it;
+    ``SHED``      — rejected at admission (queue full / draining) — the
+                    status carried by :class:`QueueFullError`.
+    """
+
+    OK = "ok"
+    NONFINITE = "nonfinite"
+    TIMEOUT = "timeout"
+    CANCELLED = "cancelled"
+    SHED = "shed"
+
+
+class QueueFullError(RuntimeError):
+    """``submit()`` rejected a request: queue at capacity or the engine is
+    draining. Subclasses ``RuntimeError`` so pre-resilience callers that
+    caught the old bare error keep working; new callers catch THIS type
+    and backpressure on ``.status`` / ``.queue_depth`` instead of parsing
+    the message."""
+
+    status = RequestStatus.SHED
+
+    def __init__(self, message: str, queue_depth: int | None = None,
+                 max_queue: int | None = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
+class NonFiniteLossError(RuntimeError):
+    """The training-side sentinel: raised after K consecutive bad optimizer
+    steps (fp16 overflow skips, or non-finite loss at a report boundary)
+    so a collapsed run halts instead of burning the remaining budget.
+    Carries the streak and the last loss for the post-mortem."""
+
+    def __init__(self, message: str, streak: int = 0,
+                 last_loss: float | None = None):
+        super().__init__(message)
+        self.streak = streak
+        self.last_loss = last_loss
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint tag failed manifest verification (missing commit
+    marker, size mismatch, checksum mismatch) and no fallback was
+    possible — or the caller pinned an explicit tag, where silent
+    fallback would be worse than failing."""
+
+    def __init__(self, message: str, tag: str | None = None,
+                 reason: str | None = None):
+        super().__init__(message)
+        self.tag = tag
+        self.reason = reason

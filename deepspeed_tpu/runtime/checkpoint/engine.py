@@ -11,7 +11,14 @@ targets are specified as abstract (shape, sharding) and tensorstore reshards.
 Layout per tag directory:
     <dir>/<tag>/state/...      sharded TrainState (master params, moments, step)
     <dir>/<tag>/meta.json      config + model metadata
+    <dir>/<tag>/manifest.json  integrity manifest — the commit marker,
+                               written LAST (resilience/integrity.py)
     <dir>/latest               tag pointer (same contract as the reference)
+
+Commit protocol (crash-safe by ordering, chaos-tested): state → meta →
+manifest → ``latest``. A death anywhere in between leaves ``latest`` at
+the previous durable checkpoint, and load-time verification falls back
+to the newest VERIFIED tag if the pointed-at one is torn.
 """
 
 from __future__ import annotations
@@ -19,13 +26,17 @@ from __future__ import annotations
 import json
 import os
 from pathlib import Path
-from typing import Any
+from typing import Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from ...utils.logging import log_dist
+from ...resilience import chaos
+from ...resilience.guards import CheckpointIntegrityError
+from ...resilience.integrity import (list_tags, newest_verified_tag,
+                                     prune_tags, verify_tag, write_manifest)
+from ...utils.logging import log_dist, warning_once
 
 
 def _checkpointer(engine=None):
@@ -89,18 +100,35 @@ def _validate_tag(engine, tag: str) -> None:
         log_dist(f"WARNING: {msg}")
 
 
+def _commit_tag(engine, base: Path, tag: str) -> None:
+    """The durable-commit epilogue, shared by the sync and async paths:
+    write the manifest (the commit marker — LAST artifact inside the
+    tag), flip ``latest``, prune old tags. Rank 0 only; the chaos kill
+    points bracket exactly the window the crash-mid-commit test targets."""
+    chaos.kill_point(chaos.KILL_AFTER_STATE_WRITE)
+    if jax.process_index() == 0:
+        level = getattr(engine.config.checkpoint, "verify", "size")
+        write_manifest(base / tag, level,
+                       extra={"global_steps": engine.global_steps})
+        chaos.kill_point(chaos.KILL_BEFORE_LATEST_FLIP)
+        (base / "latest").write_text(tag)
+        keep = int(getattr(engine.config.checkpoint, "keep_last", 0) or 0)
+        if keep:
+            prune_tags(base, keep, protect={tag})
+
+
 def wait_for_checkpoint(engine) -> None:
-    """Block until any in-flight async save has committed, then flip the
-    'latest' pointer — so a crash mid-commit leaves 'latest' at the previous
-    DURABLE checkpoint, never at a half-written one."""
+    """Block until any in-flight async save has committed, then write the
+    manifest and flip the 'latest' pointer — so a crash mid-commit leaves
+    'latest' at the previous DURABLE checkpoint, never at a half-written
+    one, and every tag 'latest' ever names carries a commit marker."""
     ck = getattr(engine, "_async_ckptr", None)
     if ck is not None:
         ck.wait_until_finished()
     pending = getattr(engine, "_pending_latest", None)
     if pending is not None:
         base, tag = pending
-        if jax.process_index() == 0:
-            (Path(base) / "latest").write_text(tag)
+        _commit_tag(engine, Path(base), tag)
         engine._pending_latest = None
 
 
@@ -153,25 +181,68 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None) -> str:
             meta["moq"] = {"bits": moq.bits, "initial_eig": moq.initial_eig,
                            "history": moq.history}
         (path / "meta.json").write_text(json.dumps(meta, indent=2))
-        if not is_async:
-            (base / "latest").write_text(tag)
     if is_async:
-        # 'latest' flips only after the background commit is durable
+        # manifest + 'latest' flip only after the background commit is
+        # durable (wait_for_checkpoint → _commit_tag)
         engine._pending_latest = (str(base), tag)
+    else:
+        _commit_tag(engine, base, tag)
     log_dist(f"saved checkpoint {path}"
              + (" (async, committing in background)" if is_async else ""),
              ranks=[0])
     return str(path)
 
 
+def _resolve_verified_tag(engine, base: Path, tag: str | None) -> str:
+    """Pick the tag to restore: ``latest`` (or the explicit ``tag``),
+    verified against its manifest; on corruption fall back to the newest
+    tag that DOES verify. Explicit tags never fall back silently —
+    restoring a different checkpoint than the one the caller pinned would
+    be worse than failing."""
+    level = getattr(engine.config.checkpoint, "verify", "size")
+    explicit = tag is not None
+    if tag is None:
+        latest = base / "latest"
+        if latest.exists():
+            tag = latest.read_text().strip()
+        else:
+            # no pointer (crash before the first flip, or manual surgery):
+            # the newest verified tag is the best truth available
+            tag = newest_verified_tag(base, level)
+            if tag is None:
+                raise FileNotFoundError(
+                    f"no 'latest' tag file and no loadable tag in {base}")
+            log_dist(f"load_checkpoint: no 'latest' pointer in {base}; "
+                     f"using newest verified tag {tag!r}", ranks=[0],
+                     level="WARNING")
+    status, reason = verify_tag(base / tag, level)
+    if status == "legacy":
+        warning_once(f"checkpoint {tag!r} has no integrity manifest "
+                     "(pre-resilience save?) — loading unverified; re-save "
+                     "to get crash-safe commits")
+    elif status == "corrupt":
+        if explicit:
+            raise CheckpointIntegrityError(
+                f"checkpoint tag {tag!r} failed verification ({reason}); "
+                "refusing to restore a pinned tag from torn bytes",
+                tag=tag, reason=reason)
+        fb = newest_verified_tag(base, level, exclude={tag})
+        if fb is None:
+            raise CheckpointIntegrityError(
+                f"checkpoint {tag!r} failed verification ({reason}) and no "
+                f"older verified tag exists in {base}", tag=tag,
+                reason=reason)
+        log_dist(f"load_checkpoint: tag {tag!r} failed verification "
+                 f"({reason}) — falling back to newest verified tag {fb!r}",
+                 ranks=[0], level="WARNING")
+        tag = fb
+    return tag
+
+
 def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
     wait_for_checkpoint(engine)   # an in-flight save must commit first
     base = Path(load_dir).absolute()
-    if tag is None:
-        latest = base / "latest"
-        if not latest.exists():
-            raise FileNotFoundError(f"no 'latest' tag file in {base}")
-        tag = latest.read_text().strip()
+    tag = _resolve_verified_tag(engine, base, tag)
     _validate_tag(engine, tag)
     if engine.config.checkpoint.load_universal:
         # universal-by-construction: every checkpoint already restores onto
@@ -285,5 +356,41 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
             log_dist("load_checkpoint: MoQ enabled but the checkpoint "
                      "carries no schedule (pre-MoQ save?) — QAT restarts "
                      f"at start_bits={moq.bits}", ranks=[0])
+    # Re-baseline the non-finite sentinel: the restored state carries the
+    # run's HISTORICAL skipped_steps total — without this, the first
+    # report boundary after a resume would read all of history as one
+    # fresh all-skipped window and halt a healthy run (and resume="auto"
+    # would then halt every incarnation the same way).
+    if hasattr(engine, "_skipped_total_prev") and not to_host:
+        engine._skipped_total_prev = float(
+            np.asarray(engine.state.skipped_steps))
+    if hasattr(engine, "_bad_step_streak"):
+        engine._bad_step_streak = 0
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})", ranks=[0])
     return str(path)
+
+
+def auto_resume(engine, load_dir: str | None) -> Optional[str]:
+    """``resilience.resume == "auto"``: restore the newest loadable
+    checkpoint under ``load_dir`` if the directory holds any, else start
+    fresh. Returns the restored path or None (fresh run). This is what
+    makes a restart-loop incarnation (elasticity/agent.py) and a manual
+    relaunch indistinguishable: both just construct the engine."""
+    if not load_dir:
+        raise ValueError(
+            'resilience.resume == "auto" requires resilience.resume_dir '
+            "(the directory save_checkpoint writes to)")
+    base = Path(load_dir).absolute()
+    if not base.is_dir() or not list_tags(base):
+        log_dist(f"auto-resume: no checkpoints in {base} — fresh run",
+                 ranks=[0])
+        return None
+    try:
+        return load_checkpoint(engine, str(base))
+    except FileNotFoundError as e:
+        # tag dirs exist but none is committed (e.g. the FIRST save of the
+        # run died mid-state-write): that's a fresh run, not an error —
+        # there was never a durable checkpoint to lose
+        log_dist(f"auto-resume: no committed checkpoint in {base} ({e}) — "
+                 "fresh run", ranks=[0], level="WARNING")
+        return None

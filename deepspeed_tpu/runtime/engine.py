@@ -18,6 +18,7 @@ engine with ``train_batch`` / ``eval_batch`` / ``save_checkpoint`` /
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional
@@ -538,6 +539,41 @@ class Engine:
             from ..profiling import FlopsProfiler
 
             self.flops_profiler = FlopsProfiler(self.config.flops_profiler, self)
+        # ---- resilience (docs/RESILIENCE.md) ----
+        res = self.config.resilience
+        # non-finite/skip sentinel state (counted exactly per step on the
+        # offload path, per report window on the in-device path)
+        self._max_bad_steps = int(res.max_consecutive_bad_steps or 0)
+        self._bad_step_streak = 0
+        self._skipped_total_prev = 0.0
+        # chaos: simulated SIGTERM preemption at a fixed step (env-gated;
+        # None in production — the per-step cost is one `is not None`)
+        from ..resilience import chaos as _chaos
+
+        self._chaos_preempt = _chaos.preempt_step()
+        # elastic-restart visibility: the agent exports the incarnation
+        # index and the previous incarnation's exit code; recording them
+        # here puts Train/restarts in every sink (incl. the Prometheus
+        # textfile) from the first report boundary of the new incarnation
+        try:
+            restarts = int(os.environ.get("DSTPU_ELASTIC_RESTART", "0") or 0)
+        except ValueError:
+            restarts = 0
+        if restarts > 0:
+            self.metrics.counter("Train/restarts").inc(restarts)
+            try:
+                last_rc = os.environ.get("DSTPU_ELASTIC_LAST_RC")
+                if last_rc is not None:
+                    self.metrics.gauge("Train/last_exit_code").set(
+                        float(int(last_rc)))
+            except ValueError:
+                pass
+        # auto-resume LAST: the engine is fully built, so this is exactly
+        # a user-issued load_checkpoint (verified-tag fallback included)
+        if res.resume == "auto" and not self._abstract:
+            from .checkpoint.engine import auto_resume
+
+            auto_resume(self, res.resume_dir)
 
     def _pinned_host_outputs_work(self) -> bool:
         """Compile AND run a trivial pinned_host-output jit: advertised
@@ -786,6 +822,10 @@ class Engine:
         out = {"loss": float(metrics["loss"]), "grad_norm": gnorm, "lr": lr,
                "loss_scale": float(scale), "skipped": 0 if finite else 1,
                "bwd_s": t_bwd, "host_step_s": t_host}
+        # offload reads the finite flag back every step anyway — the
+        # sentinel counts exactly, window 1
+        self._note_bad_steps((not finite) or not math.isfinite(out["loss"]),
+                             1, out["loss"])
         if self.global_steps % self.config.steps_per_print == 0:
             stats = self.throughput.stop(report=True)
             log_dist(f"step={self.global_steps} loss={out['loss']:.4f} "
@@ -1231,6 +1271,41 @@ class Engine:
                     pass
         return out
 
+    # ----------------------------------------------------------- resilience
+    def _note_bad_steps(self, bad: bool, window: int, last_loss: float) -> None:
+        """Non-finite sentinel: ``bad`` covers ``window`` consecutive
+        optimizer steps (1 on the offload path, ``steps_per_print`` on the
+        in-device path). K consecutive bad steps halt with a typed error —
+        a collapsed run (loss-scale death spiral, NaN weights) must stop
+        burning budget, and the supervisor must see a *typed* cause."""
+        if not self._max_bad_steps:
+            return
+        self._bad_step_streak = self._bad_step_streak + window if bad else 0
+        if self._bad_step_streak >= self._max_bad_steps:
+            from ..resilience.guards import NonFiniteLossError
+
+            raise NonFiniteLossError(
+                f"halting: {self._bad_step_streak} consecutive bad optimizer "
+                f"steps (threshold {self._max_bad_steps}) — non-finite loss "
+                "or every step skipped on overflow; last loss "
+                f"{last_loss!r} at global step {self.global_steps}. Resume "
+                "from the last good checkpoint with a lower lr / higher "
+                "initial loss scale.",
+                streak=self._bad_step_streak, last_loss=last_loss)
+
+    def _sentinel_at_boundary(self, loss: float) -> None:
+        """In-device path: evaluate the sentinel from the report window's
+        ``skipped_steps`` delta (the boundary already synced the state, so
+        reading the counter adds no extra device wait)."""
+        if not self._max_bad_steps:
+            return
+        window = int(self.config.steps_per_print)
+        skipped_total = float(self.state.skipped_steps)
+        all_skipped = (skipped_total - self._skipped_total_prev) >= window
+        self._skipped_total_prev = skipped_total
+        self._note_bad_steps(all_skipped or not math.isfinite(loss),
+                             window, loss)
+
     # -------------------------------------------------------- observability
     def _record_step_metrics(self, metrics: dict, stats: Optional[dict],
                              extra_gauges: Optional[dict] = None) -> None:
@@ -1292,6 +1367,11 @@ class Engine:
                 "engine was built with abstract_state=True (AOT probe "
                 "mode): no state is materialized — only compile_train_step "
                 "is available")
+        if self._chaos_preempt is not None \
+                and self.global_steps == self._chaos_preempt:
+            from ..resilience import chaos as _chaos
+
+            _chaos.deliver_preemption()
         self._check_flops_nominal(batch)
         if self._trace_window is not None:
             # windowed XLA capture: opens entering trace_steps[0], closes
@@ -1368,6 +1448,7 @@ class Engine:
                 for name, ms in self.timers.log(reset=True).items():
                     self.metrics.gauge(f"Train/time_{name}_ms").set(ms)
             if boundary:
+                self._sentinel_at_boundary(metrics["loss"])
                 log_dist(f"step={self.global_steps} loss={metrics['loss']:.4f} "
                          f"lr={metrics['lr']:.3e} gnorm={metrics['grad_norm']:.3f}",
                          ranks=[0])

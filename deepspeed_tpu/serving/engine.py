@@ -39,6 +39,9 @@ from ..inference.decode import (GenCarry, decode_step, forward_with_cache,
 from ..inference.engine import InferenceEngine
 from ..inference.sampling import per_request_keys, split_keys
 from ..observability.tracing import ServingStats
+from ..resilience.chaos import ChaosMonkey
+from ..resilience.guards import QueueFullError, RequestStatus
+from ..utils.logging import warning_once
 from .scheduler import Request, Scheduler
 from .slots import init_slots, insert_request
 
@@ -49,6 +52,10 @@ _MAX_PROGRAMS = 64
 # Finished requests retained for pop_result(); a long-running server that
 # never collects results must not leak host memory without bound.
 _MAX_RESULTS = 4096
+# health() reports degraded for this many iterations after a watchdog
+# stall, then recovers — one slow step during warmup must not mark the
+# replica unhealthy forever (the cumulative stall COUNT never resets).
+_DEGRADED_WINDOW = 64
 
 
 class ServingEngine:
@@ -91,7 +98,9 @@ class ServingEngine:
         self.sched = Scheduler(self.cfg.slots, self.cfg.max_len,
                                self.cfg.prefill_chunk,
                                max_queue=self.cfg.max_queue,
-                               eos_token_id=self._eos, stats=self.stats)
+                               eos_token_id=self._eos, stats=self.stats,
+                               ttft_deadline_s=self.cfg.ttft_deadline_s,
+                               total_deadline_s=self.cfg.total_deadline_s)
         self._programs: OrderedDict = OrderedDict()
         self.compiles = 0        # program builds — bounded in steady state
         # finished requests awaiting pickup, BOUNDED (oldest evicted): a
@@ -99,8 +108,19 @@ class ServingEngine:
         # pop_result() — never grows this; one that ignores results still
         # can't leak without bound
         self.results: OrderedDict[int, Request] = OrderedDict()
+        self._max_results = _MAX_RESULTS
         # (request, chunk plan, next chunk idx, device prefill cache, rng)
         self._prefill = None
+        # resilience state: chaos only exists when explicitly enabled —
+        # disabled serving carries a single `is not None` check per step
+        self.chaos: Optional[ChaosMonkey] = None
+        if self.cfg.chaos is not None and self.cfg.chaos.enabled:
+            self.chaos = ChaosMonkey(self.cfg.chaos)
+        self._draining = False
+        self._any_deadlines = False
+        self._last_step_s = 0.0
+        self._last_stall_iter: Optional[int] = None
+        self._iterations = 0
         with self.engine.mesh:
             self._state = self._prog("init_slots", lambda: jax.jit(
                 lambda: init_slots(mcfg, self.cfg.slots, self.cfg.max_len,
@@ -144,29 +164,86 @@ class ServingEngine:
                         rng=rng, done=done)
 
     def _step_impl(self, params, carry):
+        # logit_guard: the (B,) per-row finiteness flags ride the step's
+        # existing fused read-back — the guard costs zero extra host syncs
         return decode_step(self.model, params, carry, sampler=self._sampler,
-                           eos_token_id=self._eos, flash_decode=self._flash)
+                           eos_token_id=self._eos, flash_decode=self._flash,
+                           logit_guard=True)
+
+    def _step_chaos_impl(self, params, carry, poison_row):
+        """Chaos build of the step: identical program + a traced poison-row
+        scalar (-1 = clean; `where` on a false mask is bit-exact), so one
+        compiled program covers every iteration of a chaos run."""
+        return decode_step(self.model, params, carry, sampler=self._sampler,
+                           eos_token_id=self._eos, flash_decode=self._flash,
+                           logit_guard=True, poison_row=poison_row)
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               seed: int = 0) -> int:
+               seed: int = 0, ttft_deadline_s: Optional[float] = None,
+               total_deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its request id. Tokens sample with
         a per-request RNG folded from ``seed`` — bit-identical (up to eos
         truncation) to ``engine.generate(prompt[None], max_new,
         request_seeds=[seed], cache_len=<serving max_len>, ...)`` with the
         same sampling knobs; ``cache_len`` must match because the cache
-        width is part of the sampled bit-stream."""
+        width is part of the sampled bit-stream.
+
+        ``ttft_deadline_s`` / ``total_deadline_s`` override the config
+        defaults for this request (0 disables). Raises
+        :class:`~..resilience.guards.QueueFullError` (status ``SHED``)
+        when the queue is at ``max_queue`` or the engine is draining."""
+        if self._draining:
+            self.stats.on_shed(self.sched.queue_depth)
+            raise QueueFullError("serving engine is draining; request shed",
+                                 queue_depth=self.sched.queue_depth,
+                                 max_queue=self.cfg.max_queue)
         max_new = int(max_new_tokens or self.engine.config.max_out_tokens)
-        req = self.sched.submit(prompt, max_new, seed)
+        req = self.sched.submit(prompt, max_new, seed,
+                                ttft_deadline_s=ttft_deadline_s,
+                                total_deadline_s=total_deadline_s)
+        if req.deadline_ttft is not None or req.deadline_total is not None:
+            self._any_deadlines = True
         return req.rid
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Cancel a request wherever it currently lives — queue, prefill
+        lane, or decode slot. Returns the request (status ``CANCELLED``,
+        also placed in ``results``) or None if it already finished / is
+        unknown."""
+        if self._prefill is not None and self._prefill[0].rid == rid:
+            req = self._prefill[0]
+            self._prefill = None
+            self.sched.abort(req, RequestStatus.CANCELLED,
+                             "cancelled during prefill")
+        else:
+            req = self.sched.cancel(rid)
+        if req is not None:
+            self._store_result(req)
+        return req
 
     # ------------------------------------------------------------ serving
     def step(self) -> list[Request]:
-        """One serving iteration: <= 1 prefill chunk + 1 decode step over
-        the occupied slots. Returns requests that finished this iteration
-        (their ``tokens`` lists are final; also kept in ``results``)."""
+        """One serving iteration: deadline sweep + <= 1 prefill chunk + 1
+        decode step over the occupied slots. Returns requests that
+        finished this iteration — normally (status ``OK``) or through a
+        guard (``TIMEOUT`` / ``NONFINITE``); all are also kept in
+        ``results``. Chaos disabled adds nothing to the device work and
+        no host syncs beyond the step's one fused read-back."""
         finished: list[Request] = []
         ran_chunk = ran_decode = False
+        chaos = self.chaos
+        if chaos is not None:
+            it = chaos.on_iteration()
+            if it == 0 and chaos.cfg.flood_submits:
+                self._chaos_flood(chaos.cfg.flood_submits)
+        # deadline sweep FIRST: an expired queued request never spends a
+        # prefill chunk, an expired running one frees its slot for this
+        # very iteration's admission. _any_deadlines means some live or
+        # past request carried one — a deadline-free server never pays
+        # the sweep (or its clock read)
+        if self._any_deadlines:
+            finished += self._expire_deadlines()
         with self.engine.mesh:
             # admission: start the head-of-queue request's prefill
             if self._prefill is None:
@@ -184,23 +261,85 @@ class ServingEngine:
                 ran_chunk = True
             # decode lane: every occupied slot advances one token
             if self.sched.running:
-                step = self._prog("step", lambda: jax.jit(
-                    self._step_impl, donate_argnums=(1,)))
-                self._state = step(self.engine.params, self._state)
-                # ONE fused host read-back per iteration (tok + done
-                # together): the per-iteration sync is the scheduler's
-                # steering cost — don't pay it twice
-                toks, dones = jax.device_get((self._state.tok,
-                                              self._state.done))
+                t0 = self.stats.clock()
+                if chaos is not None:
+                    chaos.maybe_hang(it)
+                    poison = chaos.poison_slot(self.sched.running.keys())
+                    step = self._prog("step_chaos", lambda: jax.jit(
+                        self._step_chaos_impl, donate_argnums=(1,)))
+                    self._state, ok = step(self.engine.params, self._state,
+                                           jnp.int32(poison))
+                else:
+                    step = self._prog("step", lambda: jax.jit(
+                        self._step_impl, donate_argnums=(1,)))
+                    self._state, ok = step(self.engine.params, self._state)
+                # ONE fused host read-back per iteration (tok + done +
+                # per-row logit finiteness together): the per-iteration
+                # sync is the scheduler's steering cost — don't pay it
+                # twice, and don't let the guard add a second one
+                toks, dones, oks = jax.device_get(
+                    (self._state.tok, self._state.done, ok))
+                self._last_step_s = self.stats.clock() - t0
+                wd = self.cfg.watchdog_s
+                if wd and self._last_step_s > wd:
+                    self._last_stall_iter = self._iterations
+                    self.stats.on_watchdog_stall(self._last_step_s, wd)
+                    warning_once(
+                        f"serving watchdog: a decode step exceeded "
+                        f"{wd:.3f}s (see Serve/last_stall_s for the "
+                        "latest measurement; further stalls only count)")
+                if not oks.all():
+                    # retire ONLY the poisoned rows, before on_step can
+                    # append their garbage tokens; every other slot's
+                    # bookkeeping (and output bits) is untouched
+                    bad = [s for s in np.nonzero(~oks)[0]
+                           if int(s) in self.sched.running]
+                    finished += self.sched.retire_nonfinite(bad)
                 finished += self.sched.on_step(toks, dones)
                 ran_decode = True
         self.stats.on_iteration(self.sched.queue_depth, self.sched.occupancy,
                                 self.cfg.slots, ran_chunk, ran_decode)
+        self._iterations += 1
         for req in finished:
-            self.results[req.rid] = req
-            if len(self.results) > _MAX_RESULTS:
-                self.results.popitem(last=False)
+            self._store_result(req)
         return finished
+
+    def _store_result(self, req: Request) -> None:
+        self.results[req.rid] = req
+        if len(self.results) > self._max_results:
+            self.results.popitem(last=False)
+            self.stats.on_results_evicted()
+            warning_once(
+                f"serving results store hit its cap ({self._max_results}); "
+                "evicting oldest finished requests — collect results via "
+                "step()'s return value or pop_result() (further evictions "
+                "count in Serve/results_evicted)")
+
+    def _expire_deadlines(self) -> list[Request]:
+        """One deadline sweep over queue + slots + the prefill lane."""
+        now = self.stats.clock()
+        expired = self.sched.expire_deadlines(now)
+        if self._prefill is not None:
+            req = self._prefill[0]
+            if (req.deadline_ttft is not None and now >= req.deadline_ttft) \
+                    or (req.deadline_total is not None
+                        and now >= req.deadline_total):
+                self._prefill = None
+                expired.append(self.sched.abort(
+                    req, RequestStatus.TIMEOUT,
+                    "deadline expired during prefill"))
+        return expired
+
+    def _chaos_flood(self, n: int) -> None:
+        """Chaos queue flood: slam ``n`` junk one-token submits through the
+        normal intake. With ``max_queue`` set, the overflow sheds through
+        QueueFullError — exactly the backpressure path under test."""
+        for i in range(n):
+            try:
+                self.submit(np.asarray([1], np.int32), 1,
+                            seed=int(self.chaos.rng.integers(1 << 30)))
+            except QueueFullError:
+                pass  # the shed IS the scenario; counted in Serve/shed
 
     def _prefill_advance(self) -> list[Request]:
         req, plan, idx, cache, rng = self._prefill
@@ -229,8 +368,26 @@ class ServingEngine:
         self._state = ins(self._state, jnp.int32(slot), pf)
         return []
 
+    def begin_drain(self) -> None:
+        """Graceful drain mode: stop ADMITTING new submits (they shed with
+        :class:`QueueFullError`, status ``SHED``) while queued and running
+        requests keep being served to completion. ``health()`` reports
+        ``ready: False`` so load balancers rotate the replica out."""
+        self._draining = True
+
+    def end_drain(self) -> None:
+        """Reopen intake after a drain (e.g. a cancelled rollout)."""
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def drain(self, max_iterations: int = 1_000_000) -> dict[int, Request]:
-        """Run until queue and slots are empty; returns ``results``."""
+        """Graceful shutdown: enter drain mode, run until queue and slots
+        are empty, return ``results``. Intake stays closed afterwards —
+        call :meth:`end_drain` to reopen."""
+        self.begin_drain()
         it = 0
         while not self.sched.idle or self._prefill is not None:
             self.step()
@@ -284,6 +441,42 @@ class ServingEngine:
         return [np.asarray(got[r].tokens, np.int32) for r in rids]
 
     # ------------------------------------------------------------ metrics
+    def health(self) -> dict:
+        """Liveness/readiness snapshot for probes, also exported as
+        ``Serve/*`` gauges (so the Prometheus textfile carries the same
+        truth the probe endpoint returns). ``ready`` means "will accept a
+        submit right now": not draining and not at queue capacity.
+        ``degraded`` flags a watchdog stall within the last
+        ``_DEGRADED_WINDOW`` iterations — and recovers once steps are
+        healthy again (the cumulative ``watchdog_stalls`` count doesn't)."""
+        snap = self.stats.registry.snapshot()
+        stalls = int(snap["counters"].get("Serve/watchdog_stalls", 0))
+        queue_full = bool(self.cfg.max_queue
+                          and self.sched.queue_depth >= self.cfg.max_queue)
+        degraded = (self._last_stall_iter is not None
+                    and self._iterations - self._last_stall_iter
+                    <= _DEGRADED_WINDOW)
+        out = {
+            "state": "draining" if self._draining else "serving",
+            "ready": not self._draining and not queue_full,
+            "degraded": degraded,
+            "queue_depth": self.sched.queue_depth,
+            "occupancy": self.sched.occupancy,
+            "slots": self.cfg.slots,
+            "prefill_inflight": self._prefill is not None,
+            "iterations": self._iterations,
+            "last_step_s": self._last_step_s,
+            "watchdog_stalls": stalls,
+            "results_held": len(self.results),
+        }
+        self.stats.registry.set_gauges({
+            "Serve/ready": float(out["ready"]),
+            "Serve/draining": float(self._draining),
+            "Serve/degraded": float(degraded),
+            "Serve/last_step_s": self._last_step_s,
+        })
+        return out
+
     def metrics_snapshot(self) -> dict:
         return {"compiles": self.compiles, **self.stats.snapshot()}
 

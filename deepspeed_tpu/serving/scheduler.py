@@ -33,6 +33,7 @@ from typing import Optional
 import numpy as np
 
 from ..observability.tracing import ServingStats
+from ..resilience.guards import QueueFullError, RequestStatus
 
 _MIN_BUCKET = 8   # smallest residual-chunk program; below this, right-pad
 
@@ -97,7 +98,12 @@ def plan_chunks(prompt: np.ndarray, chunk: int) -> list:
 
 @dataclasses.dataclass
 class Request:
-    """One served request, host-side."""
+    """One served request, host-side.
+
+    ``status`` is the terminal outcome (:class:`RequestStatus`) — callers
+    branch on it instead of inferring from token shapes. ``deadline_ttft``
+    / ``deadline_total`` are ABSOLUTE times on the stats clock (submit
+    time + the configured budgets), None when no deadline applies."""
 
     rid: int
     prompt: np.ndarray
@@ -108,6 +114,10 @@ class Request:
     finish_t: Optional[float] = None
     slot: int = -1
     tokens: list = dataclasses.field(default_factory=list)
+    status: RequestStatus = RequestStatus.OK
+    error: str = ""
+    deadline_ttft: Optional[float] = None
+    deadline_total: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:
@@ -116,6 +126,10 @@ class Request:
     @property
     def finished(self) -> bool:
         return self.finish_t is not None
+
+    @property
+    def ok(self) -> bool:
+        return self.finished and self.status is RequestStatus.OK
 
 
 class Scheduler:
@@ -131,20 +145,26 @@ class Scheduler:
 
     def __init__(self, slots: int, max_len: int, prefill_chunk: int,
                  max_queue: int = 0, eos_token_id: Optional[int] = None,
-                 stats: Optional[ServingStats] = None):
+                 stats: Optional[ServingStats] = None,
+                 ttft_deadline_s: float = 0.0,
+                 total_deadline_s: float = 0.0):
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
         self.max_queue = max_queue
         self.eos_token_id = eos_token_id
         self.stats = stats if stats is not None else ServingStats()
+        self.ttft_deadline_s = float(ttft_deadline_s)
+        self.total_deadline_s = float(total_deadline_s)
         self.queue: deque[Request] = deque()
         self.free: list[int] = list(range(slots))
         self.running: dict[int, Request] = {}
         self._next_rid = 0
 
     # -------------------------------------------------------------- intake
-    def submit(self, prompt, max_new: int, seed: int = 0) -> Request:
+    def submit(self, prompt, max_new: int, seed: int = 0,
+               ttft_deadline_s: Optional[float] = None,
+               total_deadline_s: Optional[float] = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -156,13 +176,23 @@ class Scheduler:
                 f"slot capacity max_len={self.max_len} — raise "
                 f"serving.max_len or trim the request")
         if self.max_queue and len(self.queue) >= self.max_queue:
-            raise RuntimeError(
-                f"serving queue full ({self.max_queue}); apply backpressure")
+            self.stats.on_shed(len(self.queue))
+            raise QueueFullError(
+                f"serving queue full ({self.max_queue}); apply backpressure",
+                queue_depth=len(self.queue), max_queue=self.max_queue)
         req = Request(rid=self._next_rid, prompt=prompt, max_new=int(max_new),
                       seed=int(seed))
         self._next_rid += 1
         self.queue.append(req)
         req.submit_t = self.stats.on_submit(len(self.queue))
+        ttft = self.ttft_deadline_s if ttft_deadline_s is None \
+            else float(ttft_deadline_s)
+        total = self.total_deadline_s if total_deadline_s is None \
+            else float(total_deadline_s)
+        if ttft > 0:
+            req.deadline_ttft = req.submit_t + ttft
+        if total > 0:
+            req.deadline_total = req.submit_t + total
         return req
 
     # ----------------------------------------------------------- admission
@@ -205,12 +235,85 @@ class Scheduler:
             req = self.running[slot]
             req.tokens.append(int(toks[slot]))
             if bool(dones[slot]) or len(req.tokens) >= req.max_new:
+                req.status = RequestStatus.OK
                 req.finish_t = self.stats.on_retire(len(req.tokens),
                                                     req.first_token_t)
                 del self.running[slot]
                 self.free.append(slot)
                 finished.append(req)
         return finished
+
+    # ------------------------------------------------------------- guards
+    def abort(self, req: Request, status: RequestStatus,
+              error: str = "") -> Request:
+        """Terminate ``req`` with a non-OK status: free its slot if it
+        holds one, record the typed outcome, count it in Serve/*. The
+        engine uses this for requests it holds itself (the in-flight
+        prefill); queue/slot residents go through :meth:`cancel` /
+        :meth:`expire_deadlines`."""
+        if req.slot >= 0 and req.slot in self.running \
+                and self.running[req.slot] is req:
+            del self.running[req.slot]
+            self.free.append(req.slot)
+        req.status = status
+        req.error = error
+        req.finish_t = self.stats.on_abort(status)
+        return req
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Cancel a queued or running request by id; returns it (status
+        ``CANCELLED``) or None if this scheduler doesn't hold it (already
+        finished, unknown, or held by the engine's prefill lane)."""
+        for req in self.queue:
+            if req.rid == rid:
+                self.queue.remove(req)
+                self.stats.registry.gauge("Serve/queue_depth").set(
+                    len(self.queue))
+                return self.abort(req, RequestStatus.CANCELLED,
+                                  "cancelled while queued")
+        for slot, req in list(self.running.items()):
+            if req.rid == rid:
+                return self.abort(req, RequestStatus.CANCELLED,
+                                  "cancelled while decoding")
+        return None
+
+    def expire_deadlines(self, now: float) -> list:
+        """Retire every request whose deadline passed: queued requests
+        against BOTH deadlines (a request that cannot make TTFT from the
+        queue is dead weight), running requests against the total-wall
+        one (their first token already landed). Returns the expired
+        requests, status ``TIMEOUT``."""
+        expired = []
+        for req in [r for r in self.queue
+                    if (r.deadline_ttft is not None and now >= r.deadline_ttft)
+                    or (r.deadline_total is not None
+                        and now >= r.deadline_total)]:
+            self.queue.remove(req)
+            which = "ttft" if (req.deadline_ttft is not None
+                              and now >= req.deadline_ttft) else "total"
+            expired.append(self.abort(req, RequestStatus.TIMEOUT,
+                                      f"{which} deadline expired in queue"))
+        if expired:
+            self.stats.registry.gauge("Serve/queue_depth").set(len(self.queue))
+        for slot, req in list(self.running.items()):
+            if req.deadline_total is not None and now >= req.deadline_total:
+                expired.append(self.abort(req, RequestStatus.TIMEOUT,
+                                          "total deadline expired"))
+        return expired
+
+    def retire_nonfinite(self, bad_slots) -> list:
+        """The per-row logit guard tripped: retire exactly the poisoned
+        slots' requests with ``NONFINITE``. Called BEFORE ``on_step``
+        accounting, so the poisoned row's garbage token of this step is
+        never appended; every other slot's bookkeeping is untouched."""
+        out = []
+        for slot in bad_slots:
+            req = self.running.get(int(slot))
+            if req is not None:
+                out.append(self.abort(
+                    req, RequestStatus.NONFINITE,
+                    f"non-finite logits in slot {int(slot)}"))
+        return out
 
     # ------------------------------------------------------------- readout
     @property

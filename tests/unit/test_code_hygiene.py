@@ -9,6 +9,14 @@ belongs in ``utils/logging`` (human logs) or the observability layer
 Exempt: modules whose *stdout is their interface* — CLI report/bench
 entry points and the autotuner's worker JSON protocol. Adding a module
 here needs that justification, not convenience.
+
+Bare ``except:`` and silent ``except Exception: pass`` are forbidden too
+(resilience layer discipline): a swallowed exception is an invisible
+failure mode — exactly what the typed-error taxonomy in
+``resilience/guards.py`` exists to prevent. Catch the narrowest type you
+can name; if a site truly must swallow everything (destructors,
+best-effort probes on exotic backends), it goes in the allowlist WITH the
+justification next to it.
 """
 
 import re
@@ -52,3 +60,75 @@ def test_print_allowlist_entries_exist():
     """A deleted/renamed module must not leave a stale exemption behind."""
     missing = [rel for rel in PRINT_ALLOWED if not (PKG / rel).exists()]
     assert not missing, f"stale PRINT_ALLOWED entries: {missing}"
+
+
+# --------------------------------------------------------- except hygiene
+# except-Exception-pass sites that may stay, each with its justification
+# (count per file, so a NEW silent swallow in the same file still fails):
+EXCEPT_PASS_ALLOWED = {
+    "ops/aio.py": 1,                  # __del__: a destructor must never raise
+    "observability/xla.py": 1,        # best-effort device sync before
+                                      # stop_trace — the trace must close
+    "platform/accelerator.py": 1,     # defensive barrier on exotic backends
+    "runtime/engine.py": 1,           # memory_analysis attr probe (fields
+                                      # vary across jax versions)
+    "runtime/offload.py": 1,          # copy_to_host_async is not on every
+                                      # backend; the sync path still runs
+}
+
+_BARE_EXCEPT = re.compile(r"^\s*except\s*:")
+_BROAD_EXCEPT = re.compile(r"^\s*except\s+(Exception|BaseException)\s*:")
+
+
+def _silent_swallows(lines):
+    """Line numbers of ``except Exception:`` (or BaseException) whose first
+    following statement is ``pass`` — comments/blank lines between don't
+    launder the swallow."""
+    out = []
+    for i, line in enumerate(lines):
+        if not _BROAD_EXCEPT.match(line):
+            continue
+        for nxt in lines[i + 1:]:
+            body = nxt.split("#", 1)[0].strip()
+            if not body:
+                continue
+            if body == "pass":
+                out.append(i + 1)
+            break
+    return out
+
+
+def test_no_bare_or_silent_except_in_library_code():
+    bare, silent = [], []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        lines = path.read_text().splitlines()
+        for lineno, line in enumerate(lines, 1):
+            if _BARE_EXCEPT.match(line):
+                bare.append(f"{rel}:{lineno}")
+        hits = _silent_swallows(lines)
+        if len(hits) > EXCEPT_PASS_ALLOWED.get(rel, 0):
+            silent += [f"{rel}:{n}" for n in hits]
+    assert not bare, (
+        "bare `except:` in library code — catch a named exception type "
+        "(see resilience/guards.py for the typed taxonomy):\n"
+        + "\n".join(bare))
+    assert not silent, (
+        "silent `except Exception: pass` beyond the justified allowlist — "
+        "catch the narrowest type, or add an EXCEPT_PASS_ALLOWED entry "
+        "WITH its justification:\n" + "\n".join(silent))
+
+
+def test_except_pass_allowlist_is_tight():
+    """Fixed sites must leave the allowlist (stale exemptions hide new
+    swallows), and every listed module must still exist."""
+    stale = []
+    for rel, allowed in EXCEPT_PASS_ALLOWED.items():
+        p = PKG / rel
+        if not p.exists():
+            stale.append(f"{rel} (deleted)")
+            continue
+        hits = len(_silent_swallows(p.read_text().splitlines()))
+        if hits < allowed:
+            stale.append(f"{rel} (allows {allowed}, found {hits})")
+    assert not stale, f"stale EXCEPT_PASS_ALLOWED entries: {stale}"
