@@ -149,9 +149,15 @@ class MonitorConfig(ConfigModel):
     wandb: dict[str, Any] = Field(default_factory=dict)
     # Machine-readable sinks (observability/sinks.py): JSONL event log and
     # Prometheus textfile exporter. Same shape as the other backends:
-    # {"enabled": true, "output_path": ..., "job_name": ...}.
+    # {"enabled": true, "output_path": ..., "job_name": ...}. The JSONL
+    # sink additionally accepts "rotate_mb" (size-based rollover at flush
+    # boundaries; 0/absent = unbounded, the pre-rotation behavior).
     jsonl: dict[str, Any] = Field(default_factory=dict)
     prometheus: dict[str, Any] = Field(default_factory=dict)
+    # Per-request JSONL log (observability/export.py RequestLogSink): one
+    # JSON record per retired serving request, wired to a ServingEngine
+    # via engine.attach_monitor(monitor). Same config shape.
+    request_log: dict[str, Any] = Field(default_factory=dict)
 
     def any_enabled(self) -> bool:
         """A backend-level ``"enabled": true`` must not be silently ignored
@@ -161,7 +167,8 @@ class MonitorConfig(ConfigModel):
                     or self.csv_monitor.get("enabled")
                     or self.wandb.get("enabled")
                     or self.jsonl.get("enabled")
-                    or self.prometheus.get("enabled"))
+                    or self.prometheus.get("enabled")
+                    or self.request_log.get("enabled"))
 
 
 class ObservabilityConfig(ConfigModel):
@@ -178,6 +185,21 @@ class ObservabilityConfig(ConfigModel):
     # around, e.g. [100, 104]; None = no capture.
     trace_steps: Optional[list[int]] = None
     trace_dir: str = "./xla_trace"
+    # Lifecycle span events (observability/spans.py): train_step spans
+    # plus one span per wall-clock-breakdown timer window (the spans
+    # only carry data when wall_clock_breakdown is on — they re-emit its
+    # timers, adding no clock reads of their own). Off by default.
+    spans: bool = False
+    spans_ring: int = 4096
+    # Flight recorder (observability/flight.py): when set, the engine
+    # keeps a black box and dumps it on a NonFiniteLossError halt, a
+    # PreemptionGuard SIGTERM, or engine.dump_flight(). None = off.
+    flight_dir: Optional[str] = None
+    flight_max_dumps: int = 8
+    # Anomaly detection (observability.slo.SLOConfig dict, training
+    # subset): step_time_mad_k > 0 flags Train/step_time_s samples past
+    # median + k*MAD into Train/step_time_regressions + flight markers.
+    slo: dict[str, Any] = Field(default_factory=dict)
 
 
 class CommsLoggerConfig(ConfigModel):

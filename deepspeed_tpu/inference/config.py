@@ -57,6 +57,26 @@ class ServingConfig:
     # Deterministic fault injection (resilience.chaos.ChaosConfig | dict).
     # None/disabled = the engine builds no chaos machinery at all.
     chaos: "object | None" = None
+    # ---- observability: spans / flight recorder / SLOs ----
+    # Lifecycle span events (observability/spans.py): queued → prefill
+    # chunks → slot placement → decode residency → retired(status), plus
+    # per-step and occupancy events. Host-side ring only — zero added
+    # device syncs and zero new compiled programs (the bench compile
+    # freeze stays the acceptance gate). Off by default.
+    spans: bool = False
+    spans_ring: int = 4096
+    # Flight recorder (observability/flight.py): when set, the engine
+    # keeps a black box (span ring + metric snapshots + recent request
+    # records) and dumps it to this directory on a watchdog stall or on
+    # flight.dump(). None = no recorder built.
+    flight_dir: "str | None" = None
+    flight_max_dumps: int = 8
+    # Declarative SLO targets + anomaly detection
+    # (observability.slo.SLOConfig | dict): TTFT/TPOT p99 targets and
+    # error budget scored into Serve/slo_*_burn gauges, a median+MAD
+    # decode-step regression detector, and a compile-storm detector.
+    # None = no scoring machinery built.
+    slo: "object | None" = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -76,6 +96,13 @@ class ServingConfig:
             from ..resilience.chaos import ChaosConfig
 
             self.chaos = ChaosConfig.from_any(self.chaos)
+        if self.spans_ring < 1:
+            raise ValueError(f"spans_ring must be >= 1, "
+                             f"got {self.spans_ring}")
+        if self.slo is not None:
+            from ..observability.slo import SLOConfig
+
+            self.slo = SLOConfig.from_any(self.slo)
 
     @classmethod
     def from_any(cls, cfg: "ServingConfig | dict | None") -> "ServingConfig":

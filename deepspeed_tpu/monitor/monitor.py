@@ -121,6 +121,12 @@ class MonitorMaster:
             from ..observability.sinks import PrometheusTextfileSink
 
             self.writers.append(PrometheusTextfileSink(cfg.prometheus))
+        if getattr(cfg, "request_log", {}).get("enabled"):
+            from ..observability.export import RequestLogSink
+
+            # per-request records, not scalar events: serving engines find
+            # this writer via ServingEngine.attach_monitor(monitor)
+            self.writers.append(RequestLogSink(cfg.request_log))
 
     @property
     def enabled(self) -> bool:

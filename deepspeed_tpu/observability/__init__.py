@@ -1,13 +1,23 @@
 """Serving & training observability: metrics core, request tracing,
-machine-readable sinks, and XLA profiler integration.
+lifecycle spans, flight recorder, Perfetto export, SLO/anomaly
+detection, machine-readable sinks, and XLA profiler integration.
 
-See ``docs/OBSERVABILITY.md`` for the metric namespace and runbook.
+See ``docs/OBSERVABILITY.md`` for the metric namespace and runbook, and
+``python -m deepspeed_tpu.observability.doctor`` for file-based triage.
 """
 
+from .export import (RequestLogSink, request_record, to_chrome_trace,
+                     validate_chrome_trace, write_chrome_trace)
+from .flight import (FlightRecorder, newest_flight_record,
+                     read_flight_record)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
                       get_registry)
 from .sinks import (JsonlSink, PrometheusTextfileSink,
-                    parse_prometheus_textfile, prometheus_name)
+                    format_prometheus_value, parse_prometheus_textfile,
+                    prometheus_name)
+from .slo import (CompileStormDetector, MedianMADDetector, SLOConfig,
+                  SLOScorer)
+from .spans import SpanEvent, SpanRecorder
 from .tracing import RequestRecord, RequestTracer, ServingStats
 from .xla import TraceWindow, sample_memory
 
@@ -15,7 +25,12 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Reservoir",
     "get_registry",
     "JsonlSink", "PrometheusTextfileSink", "parse_prometheus_textfile",
-    "prometheus_name",
+    "prometheus_name", "format_prometheus_value",
     "RequestRecord", "RequestTracer", "ServingStats",
+    "SpanEvent", "SpanRecorder",
+    "FlightRecorder", "newest_flight_record", "read_flight_record",
+    "RequestLogSink", "request_record", "to_chrome_trace",
+    "validate_chrome_trace", "write_chrome_trace",
+    "SLOConfig", "SLOScorer", "MedianMADDetector", "CompileStormDetector",
     "TraceWindow", "sample_memory",
 ]

@@ -1,0 +1,140 @@
+"""Ops triage CLI: ``python -m deepspeed_tpu.observability.doctor``.
+
+Pretty-prints the three artifacts the runbooks point at, from files
+alone (no running engine, no device):
+
+- the newest Prometheus textfile (``*.prom``) — current gauges;
+- the newest per-request log (``*.requests.jsonl``) — last requests,
+  grouped by terminal status;
+- the newest flight record (``flight_*/``) — reason, markers, the
+  slowest spans, and where the trace.json lives for Perfetto.
+
+Usage::
+
+    python -m deepspeed_tpu.observability.doctor [--dir ./monitor]
+        [--flight-dir <dir>] [--requests N]
+
+Stdout is this module's interface (it is a CLI report tool, exempt from
+the bare-print lint like ``env_report.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+from collections import Counter as _Counter
+from pathlib import Path
+
+
+def _newest(dirpath: Path, pattern: str):
+    cands = sorted(dirpath.glob(pattern),
+                   key=lambda p: (p.stat().st_mtime, p.name))
+    return cands[-1] if cands else None
+
+
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and not math.isfinite(v):
+        from .sinks import format_prometheus_value
+
+        return format_prometheus_value(v)     # the NaN/+Inf/-Inf spellings
+    if isinstance(v, float) and v and abs(v) < 1e-3:
+        return f"{v:.3e}"
+    return f"{v:g}" if isinstance(v, float) else str(v)
+
+
+def report_prometheus(d: Path) -> None:
+    from .sinks import parse_prometheus_textfile
+
+    prom = _newest(d, "*.prom")
+    if prom is None:
+        print(f"[prom] no *.prom under {d}")
+        return
+    vals = parse_prometheus_textfile(prom.read_text())
+    print(f"[prom] {prom} ({len(vals)} metrics)")
+    # every metric, serving first, then training, then the rest — a
+    # process that both trains and serves shows both halves
+    shown: set[str] = set()
+    for prefix in ("dstpu_serve_", "dstpu_train_", ""):
+        for k, v in sorted(vals.items()):
+            if k.startswith(prefix) and k not in shown:
+                shown.add(k)
+                print(f"  {k:<44s} {_fmt(v)}")
+
+
+def report_requests(d: Path, limit: int) -> None:
+    log = _newest(d, "*.requests.jsonl")
+    if log is None:
+        print(f"[requests] no *.requests.jsonl under {d}")
+        return
+    from .flight import load_jsonl_tolerant
+
+    rows, skipped = load_jsonl_tolerant(log)
+    by_status = _Counter(r.get("status", "?") for r in rows)
+    torn = f" {skipped} torn line(s) skipped" if skipped else ""
+    print(f"[requests] {log} ({len(rows)} records){torn} "
+          + " ".join(f"{k}={n}" for k, n in sorted(by_status.items())))
+    for r in rows[-limit:]:
+        ttft = r.get("ttft_s")
+        qw = r.get("queue_wait_s")
+        print(f"  rid={str(r.get('rid')):<6} {r.get('status', '?'):<10} "
+              f"tokens={r.get('tokens')} "
+              f"ttft={_fmt(ttft) if ttft is not None else '-'} "
+              f"queue_wait={_fmt(qw) if qw is not None else '-'}"
+              + (f" error={r['error']}" if r.get("error") else ""))
+
+
+def report_flight(d: Path, slow: int = 5) -> None:
+    from .flight import newest_flight_record, read_flight_record
+
+    rec_dir = newest_flight_record(d)
+    if rec_dir is None:
+        print(f"[flight] no flight_* record under {d}")
+        return
+    rec = read_flight_record(rec_dir)
+    mf = rec["manifest"]
+    print(f"[flight] {rec_dir}")
+    print(f"  reason={mf.get('reason')} at {mf.get('wall_time')} "
+          f"events={mf.get('events')} requests={mf.get('requests')}")
+    markers = [e for e in rec["events"] if e.get("kind") == "marker"]
+    for m in markers[-8:]:
+        meta = dict(m.get("meta", {}))
+        name = meta.pop("name", "?")
+        extra = " ".join(f"{k}={_fmt(v) if isinstance(v, float) else v}"
+                         for k, v in meta.items())
+        print(f"  marker t={m['t0']:.6g} {name} {extra}".rstrip())
+    spans = [e for e in rec["events"] if "t1" in e]
+    spans.sort(key=lambda e: e["t1"] - e["t0"], reverse=True)
+    if spans:
+        print(f"  slowest spans (of {len(spans)}):")
+        for e in spans[:slow]:
+            who = " ".join(f"{k}={e[k]}" for k in ("rid", "slot", "step")
+                           if k in e)
+            print(f"    {e['kind']:<14s} {e['t1'] - e['t0']:.6g}s {who}")
+    if rec.get("trace") is not None:
+        print(f"  perfetto: load {rec_dir}/trace.json at "
+              "https://ui.perfetto.dev")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.observability.doctor",
+        description="Pretty-print the latest .prom, request log, and "
+                    "flight record for ops triage.")
+    ap.add_argument("--dir", default="./monitor",
+                    help="monitor output directory (default ./monitor)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="flight-record directory (default: --dir)")
+    ap.add_argument("--requests", type=int, default=8,
+                    help="recent request rows to show (default 8)")
+    args = ap.parse_args(argv)
+    d = Path(args.dir)
+    report_prometheus(d)
+    report_requests(d, args.requests)
+    report_flight(Path(args.flight_dir) if args.flight_dir else d)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

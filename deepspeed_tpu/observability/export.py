@@ -1,0 +1,277 @@
+"""Span export: Chrome-trace-event JSON (Perfetto) + per-request JSONL log.
+
+Two machine-readable views of the span ring (``spans.py``):
+
+- :func:`to_chrome_trace` renders the events in the Chrome trace-event
+  format Perfetto loads directly: the serving process as one pid with
+  the queue, the prefill lane, the decode step, and every slot as its
+  own named track; requests as complete (``X``) spans nested on their
+  tracks; queue depth / slot occupancy as counter (``C``) tracks; SLO /
+  anomaly / watchdog markers as instant (``i``) events. Training spans
+  land under a second pid. ``ts`` is microseconds relative to the
+  earliest event, per the spec.
+- :class:`RequestLogSink` is a MonitorMaster-compatible writer that
+  additionally accepts whole request records (one JSON object per
+  retired request) — the request-level ground truth the scalar
+  ``(name, value, step)`` event contract cannot carry.
+
+:func:`validate_chrome_trace` is the schema gate the tests (and the
+flight recorder's own smoke assertion) run over every generated trace:
+required keys, known phases, non-negative durations, sorted timestamps,
+matched B/E nesting.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Optional
+
+from . import spans as S
+from .sinks import JsonlSink
+
+# pids in the exported trace: one "process" per engine kind.
+PID_SERVING = 1
+PID_TRAIN = 2
+
+# Fixed serving tids; slots start at _TID_SLOT0 (slot k → tid k + 10).
+_TID_QUEUE = 1
+_TID_PREFILL = 2
+_TID_STEP = 3
+_TID_MARKERS = 4
+_TID_SLOT0 = 10
+
+_TRAIN_TIDS = {"train_step": 1}   # phases allocate 2.. in first-seen order
+
+
+def _sec_to_us(t: float, origin: float) -> float:
+    return max(0.0, (t - origin) * 1e6)
+
+
+def _slot_tid(slot) -> int:
+    return _TID_SLOT0 + int(slot)
+
+
+def to_chrome_trace(events: Iterable[S.SpanEvent],
+                    job_name: str = "deepspeed_tpu") -> dict:
+    """Span events → a Chrome trace-event JSON object (Perfetto-loadable).
+
+    Events are emitted sorted by ``ts`` and every span uses the complete
+    (``X``) phase — no B/E pairing for a ring buffer whose head may have
+    evicted a B while keeping its E."""
+    evs = list(events)
+    if not evs:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "otherData": {"job": job_name}}
+    origin = min(e.t0 for e in evs)
+    out: list[dict] = []
+    used_tids: dict[int, set] = {PID_SERVING: set(), PID_TRAIN: set()}
+    train_tids = dict(_TRAIN_TIDS)
+
+    def add(pid, tid, ph, name, ts, dur=None, args=None):
+        ev = {"name": name, "ph": ph, "pid": pid, "tid": tid,
+              "ts": round(ts, 3)}
+        if dur is not None:
+            ev["dur"] = round(max(0.0, dur), 3)
+        if ph == "i":
+            ev["s"] = "p"             # process-scoped instant
+        if args:
+            ev["args"] = args
+        used_tids[pid].add(tid)
+        out.append(ev)
+
+    for e in evs:
+        ts = _sec_to_us(e.t0, origin)
+        dur = None if e.t1 is None else (e.t1 - e.t0) * 1e6
+        args = {k: v for k, v in e.meta.items()}
+        if e.rid is not None:
+            args["rid"] = e.rid
+        if e.step is not None:
+            args["step"] = e.step
+        if e.kind == S.QUEUED:
+            add(PID_SERVING, _TID_QUEUE, "X", f"queued rid={e.rid}", ts,
+                dur or 0.0, args)
+        elif e.kind == S.PREFILL_CHUNK:
+            add(PID_SERVING, _TID_PREFILL, "X",
+                f"prefill rid={e.rid} chunk={e.meta.get('chunk', '?')}",
+                ts, dur or 0.0, args)
+        elif e.kind == S.PLACED:
+            add(PID_SERVING, _slot_tid(e.slot), "i",
+                f"placed rid={e.rid}", ts, None, args)
+        elif e.kind == S.DECODE_RESIDENCY:
+            add(PID_SERVING, _slot_tid(e.slot), "X",
+                f"decode rid={e.rid}", ts, dur or 0.0, args)
+        elif e.kind == S.RETIRED:
+            add(PID_SERVING,
+                _slot_tid(e.slot) if e.slot is not None and e.slot >= 0
+                else _TID_QUEUE, "i",
+                f"retired rid={e.rid} [{e.meta.get('status', '?')}]",
+                ts, None, args)
+        elif e.kind == S.DECODE_STEP:
+            add(PID_SERVING, _TID_STEP, "X", "decode_step", ts,
+                dur or 0.0, args)
+        elif e.kind == S.OCCUPANCY:
+            # one counter track per sample name — Perfetto draws them as
+            # stacked value timelines
+            for k, v in e.meta.items():
+                out.append({"name": k, "ph": "C", "pid": PID_SERVING,
+                            "tid": 0, "ts": round(ts, 3),
+                            "args": {k: v}})
+        elif e.kind == S.MARKER:
+            nm = e.meta.get("name", "marker")
+            add(PID_SERVING, _TID_MARKERS, "i", f"marker:{nm}", ts, None,
+                args)
+        elif e.kind == S.TRAIN_STEP:
+            add(PID_TRAIN, train_tids["train_step"], "X", "train_step",
+                ts, dur or 0.0, args)
+        elif e.kind == S.TRAIN_PHASE:
+            phase = e.meta.get("phase", "phase")
+            tid = train_tids.setdefault(phase, len(train_tids) + 1)
+            add(PID_TRAIN, tid, "X", phase, ts, dur or 0.0, args)
+        else:   # unknown kind: keep it visible rather than dropping it
+            add(PID_SERVING, _TID_MARKERS, "i", f"event:{e.kind}", ts,
+                None, args)
+
+    out.sort(key=lambda ev: ev["ts"])
+    meta: list[dict] = []
+
+    def name_meta(pid, name):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "ts": 0.0, "args": {"name": name}})
+
+    def thread_meta(pid, tid, name):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "ts": 0.0, "args": {"name": name}})
+
+    if used_tids[PID_SERVING] or any(ev["pid"] == PID_SERVING
+                                     for ev in out):
+        name_meta(PID_SERVING, f"{job_name}:serving")
+        for tid, nm in ((_TID_QUEUE, "queue"), (_TID_PREFILL, "prefill"),
+                        (_TID_STEP, "decode-step"),
+                        (_TID_MARKERS, "markers")):
+            if tid in used_tids[PID_SERVING]:
+                thread_meta(PID_SERVING, tid, nm)
+        for tid in sorted(t for t in used_tids[PID_SERVING]
+                          if t >= _TID_SLOT0):
+            thread_meta(PID_SERVING, tid, f"slot {tid - _TID_SLOT0}")
+    if used_tids[PID_TRAIN]:
+        name_meta(PID_TRAIN, f"{job_name}:train")
+        for phase, tid in train_tids.items():
+            if tid in used_tids[PID_TRAIN]:
+                thread_meta(PID_TRAIN, tid, phase)
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms",
+            "otherData": {"job": job_name}}
+
+
+def write_chrome_trace(events: Iterable[S.SpanEvent], path,
+                       job_name: str = "deepspeed_tpu") -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(to_chrome_trace(events, job_name)),
+                    encoding="utf-8")
+    return path
+
+
+# ----------------------------------------------------------------- validator
+_KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s",
+                 "t", "f"}
+
+
+def validate_chrome_trace(trace: dict) -> list[str]:
+    """Schema gate over a trace-event JSON object; returns the list of
+    problems (empty = valid). Checks: the ``traceEvents`` envelope,
+    per-event required keys, known phases, non-negative ``ts``/``dur``,
+    timestamps sorted among non-metadata events, and matched B/E nesting
+    per (pid, tid)."""
+    problems: list[str] = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["missing or non-list traceEvents"]
+    last_ts: Optional[float] = None
+    stacks: dict[tuple, list] = {}
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        missing = [k for k in ("name", "ph", "pid", "tid", "ts")
+                   if k not in ev]
+        if missing:
+            problems.append(f"event {i}: missing keys {missing}")
+            continue
+        if ph not in _KNOWN_PHASES:
+            problems.append(f"event {i}: unknown phase {ph!r}")
+            continue
+        ts = ev["ts"]
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if ph == "M":
+            continue                  # metadata: outside the timeline
+        if last_ts is not None and ts < last_ts:
+            problems.append(f"event {i}: ts {ts} < previous {last_ts} "
+                            "(events must be sorted)")
+        last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i}: X event needs dur >= 0, "
+                                f"got {dur!r}")
+        elif ph == "B":
+            stacks.setdefault((ev["pid"], ev["tid"]), []).append(ev["name"])
+        elif ph == "E":
+            stack = stacks.get((ev["pid"], ev["tid"]), [])
+            if not stack:
+                problems.append(f"event {i}: E without matching B on "
+                                f"(pid={ev['pid']}, tid={ev['tid']})")
+            else:
+                stack.pop()
+    for (pid, tid), stack in stacks.items():
+        if stack:
+            problems.append(f"unclosed B events on (pid={pid}, tid={tid}): "
+                            f"{stack}")
+    return problems
+
+
+# ------------------------------------------------------------- request log
+def request_record(req, queue_wait_s: Optional[float] = None) -> dict:
+    """One retired serving request → a flat JSON-able record (the
+    per-request row of the request log and of flight dumps)."""
+    status = getattr(req.status, "value", str(req.status))
+    admit_t = getattr(req, "admit_t", None)
+    if queue_wait_s is None and admit_t is not None:
+        queue_wait_s = admit_t - req.submit_t
+    ttft = (req.first_token_t - req.submit_t
+            if req.first_token_t is not None else None)
+    tpot = None
+    n = len(req.tokens)
+    if (req.finish_t is not None and req.first_token_t is not None
+            and n > 1):
+        tpot = (req.finish_t - req.first_token_t) / (n - 1)
+    return {
+        "rid": req.rid, "status": status, "prompt_len": req.prompt_len,
+        "max_new": req.max_new, "tokens": n, "slot": req.slot,
+        "submit_t": req.submit_t, "first_token_t": req.first_token_t,
+        "finish_t": req.finish_t, "ttft_s": ttft, "tpot_s": tpot,
+        "queue_wait_s": queue_wait_s, "error": req.error or None,
+    }
+
+
+class RequestLogSink(JsonlSink):
+    """Per-request JSONL log riding the MonitorMaster fan-out.
+
+    A :class:`~.sinks.JsonlSink` whose payload is whole request records
+    (engines call :meth:`log_request`), not scalar events — so it
+    inherits the persistent handle, flush boundaries, and ``rotate_mb``
+    rotation. Implements the writer contract so MonitorMaster owns its
+    lifecycle like every other sink."""
+
+    SUFFIX = ".requests.jsonl"
+    FLUSH_EVERY = 16
+
+    def log_request(self, record: dict) -> None:
+        self._write_line(json.dumps(record, separators=(",", ":")))
+
+    def write_events(self, events) -> None:
+        """Scalar metric events are not this sink's payload (the JSONL
+        event log already carries them) — accept and drop."""

@@ -1,0 +1,259 @@
+"""Crash/stall flight recorder: the post-mortem artifact.
+
+When the PR-4 guards fire — a watchdog stall, a ``NonFiniteLossError``
+halt, a ``PreemptionGuard`` SIGTERM — the operator today gets a gauge
+flip and nothing else. The flight recorder holds the last-N lifecycle
+events (the span ring), the most recent retired-request records, and a
+set of metric snapshot providers; :meth:`dump` freezes all of it into a
+timestamped directory:
+
+- ``manifest.json`` — reason, wall time, event/record counts;
+- ``events.jsonl``  — the span ring, one event per line;
+- ``metrics.json``  — every registered snapshot provider's output;
+- ``requests.jsonl``— recent retired requests (serving engines);
+- ``trace.json``    — the Chrome-trace/Perfetto export of the ring.
+
+Recording cost follows the span discipline: host-side floats in bounded
+deques, zero device syncs, zero new programs. ``note()`` markers are the
+"why" trail — every SLO burn / anomaly / watchdog firing writes one, so
+the dump explains the action that was taken. Dumping is capped
+(``max_dumps``) so a stall storm cannot fill the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..utils.logging import log_dist
+from . import spans as S
+
+
+def _json_default(o):
+    # numpy values reach dumps() from metric snapshots: scalars via
+    # .item(), arrays via .tolist() (.item() RAISES on size != 1, and a
+    # serializer crash here would lose the dump on the very failure path
+    # it exists to record)
+    if getattr(o, "size", 1) == 1:
+        f = getattr(o, "item", None)
+        if callable(f):
+            return f()
+    f = getattr(o, "tolist", None)
+    if callable(f):
+        return f()
+    return str(o)
+
+
+class FlightRecorder:
+    """Bounded black box + dump-to-directory.
+
+    ``spans`` is the engine's :class:`~.spans.SpanRecorder` (or None —
+    markers and snapshots still dump without the timeline).
+    ``snapshots`` maps name → zero-arg callable returning a JSON-able
+    dict; providers are called at dump time only. ``clock`` stamps
+    marker events (injectable, like every other observability clock);
+    directory names use wall time via ``time.strftime`` because they
+    are operator-facing filenames, not measured intervals."""
+
+    def __init__(self, dump_dir, spans: Optional[S.SpanRecorder] = None,
+                 snapshots: Optional[dict[str, Callable[[], dict]]] = None,
+                 recent_requests: int = 64, max_dumps: int = 8,
+                 clock: Optional[Callable[[], float]] = None,
+                 job_name: str = "deepspeed_tpu"):
+        self.dump_dir = Path(dump_dir)
+        self.spans = spans
+        self.snapshots: dict[str, Callable[[], dict]] = dict(snapshots or {})
+        self.clock = clock if clock is not None else (
+            spans.clock if spans is not None else time.perf_counter)
+        self.job_name = job_name
+        self.max_dumps = int(max_dumps)
+        self.dumps: list[Path] = []
+        self._markers = S.SpanRecorder(capacity=256, clock=self.clock)
+        self._requests: deque[dict] = deque(maxlen=int(recent_requests))
+        # RLock for the same reason as SpanRecorder: dump() runs inside
+        # signal handlers (PreemptionGuard) on the main thread, which may
+        # have been interrupted while holding this lock in on_request()
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ recording
+    def add_snapshot_provider(self, name: str,
+                              fn: Callable[[], dict]) -> None:
+        self.snapshots[name] = fn
+
+    def note(self, name: str, t: Optional[float] = None,
+             **meta) -> S.SpanEvent:
+        """Record a "why" marker — into the engine span ring too (when
+        present), so the Perfetto timeline shows the firing in place."""
+        if self.spans is not None:
+            return self.spans.marker(name, t=t, **meta)
+        return self._markers.marker(name, t=t, **meta)
+
+    def on_request(self, record: dict) -> None:
+        """Keep one retired request's record (bounded)."""
+        with self._lock:
+            self._requests.append(record)
+
+    # ---------------------------------------------------------------- dump
+    def _events(self) -> list[S.SpanEvent]:
+        evs = self._markers.events()
+        if self.spans is not None:
+            evs += self.spans.events()
+        evs.sort(key=lambda e: e.t0)
+        return evs
+
+    def dump(self, reason: str = "manual") -> Optional[Path]:
+        """Freeze the black box into ``<dump_dir>/flight_<stamp>_<reason>``.
+        Returns the directory, or None once ``max_dumps`` is reached (the
+        rings keep recording; only new directories stop)."""
+        with self._lock:
+            if self.max_dumps and len(self.dumps) >= self.max_dumps:
+                return None
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in reason)[:48] or "manual"
+            try:
+                d = self.dump_dir / f"flight_{stamp}_{safe}"
+                k = 0
+                while d.exists():      # same second, same reason: suffix
+                    k += 1
+                    d = self.dump_dir / f"flight_{stamp}_{safe}.{k}"
+                d.mkdir(parents=True)
+            except OSError as e:
+                # full/read-only disk: losing the dump is acceptable;
+                # raising OSError out of the watchdog, the nonfinite
+                # halt, or the SIGTERM handler — replacing the error the
+                # resilience layer is watching for — is not
+                log_dist(f"flight recorder: dump to {self.dump_dir} "
+                         f"failed ({e!r})", ranks=[0], level="WARNING")
+                return None
+            self.dumps.append(d)
+            requests = list(self._requests)
+        events = self._events()
+        snaps: dict[str, object] = {}
+        for name, fn in self.snapshots.items():
+            try:
+                snaps[name] = fn()
+            except Exception as e:   # a broken provider must not lose the
+                snaps[name] = {"error": repr(e)}   # rest of the dump
+        # per-artifact guards: dump() runs on failure paths (watchdog
+        # stall, SIGTERM) — one unserializable artifact must not raise out
+        # of the serving loop and lose the rest of the post-mortem
+        def _write(name, write):
+            try:
+                write()
+            except Exception as e:
+                try:
+                    (d / (name + ".error")).write_text(repr(e),
+                                                       encoding="utf-8")
+                except OSError:
+                    pass
+
+        def _w_manifest():
+            (d / "manifest.json").write_text(json.dumps({
+                "reason": reason, "job": self.job_name,
+                "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "clock_now": self.clock(),
+                "events": len(events), "requests": len(requests),
+                "snapshot_providers": sorted(snaps),
+            }, indent=2, default=_json_default), encoding="utf-8")
+
+        def _w_events():
+            with open(d / "events.jsonl", "w", encoding="utf-8") as f:
+                for ev in events:
+                    f.write(json.dumps(ev.as_dict(), separators=(",", ":"),
+                                       default=_json_default) + "\n")
+
+        def _w_metrics():
+            (d / "metrics.json").write_text(
+                json.dumps(snaps, indent=2, default=_json_default),
+                encoding="utf-8")
+
+        def _w_requests():
+            with open(d / "requests.jsonl", "w", encoding="utf-8") as f:
+                for rec in requests:
+                    f.write(json.dumps(rec, separators=(",", ":"),
+                                       default=_json_default) + "\n")
+
+        def _w_trace():
+            from .export import write_chrome_trace
+
+            write_chrome_trace(events, d / "trace.json", self.job_name)
+
+        _write("manifest.json", _w_manifest)
+        _write("events.jsonl", _w_events)
+        _write("metrics.json", _w_metrics)
+        _write("requests.jsonl", _w_requests)
+        _write("trace.json", _w_trace)
+        log_dist(f"flight recorder: dumped {len(events)} events to {d} "
+                 f"(reason: {reason})", ranks=[0], level="WARNING")
+        return d
+
+
+def load_jsonl_tolerant(path) -> tuple[list, int]:
+    """Parse a JSONL file, SKIPPING torn lines — ``(rows, skipped)``.
+
+    The artifacts the triage tools read are left by crashed processes; a
+    half-written trailing record is the expected state, not a reason to
+    abort. Shared by :func:`read_flight_record` and the doctor CLI so
+    both agree on what a torn artifact parses to."""
+    rows: list = []
+    skipped = 0
+    for line in Path(path).read_text(errors="replace").splitlines():
+        if not line.strip():
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            skipped += 1
+    return rows, skipped
+
+
+def newest_flight_record(dump_dir) -> Optional[Path]:
+    """Most recent ``flight_*`` directory under ``dump_dir`` (mtime order),
+    or None — the doctor CLI's and the runbook's entry point."""
+    d = Path(dump_dir)
+    if not d.is_dir():
+        return None
+    cands = [p for p in d.iterdir()
+             if p.is_dir() and p.name.startswith("flight_")]
+    if not cands:
+        return None
+    return max(cands, key=lambda p: (p.stat().st_mtime, p.name))
+
+
+def read_flight_record(record_dir) -> dict:
+    """Load one flight record back into a dict (doctor CLI + tests):
+    ``{"manifest", "events", "metrics", "requests"}``.
+
+    Torn artifacts — a dump interrupted by the very crash it was
+    recording — degrade instead of raising: unparseable whole-file JSON
+    reads back empty/None, torn JSONL lines are skipped and counted in
+    ``torn_lines``. The triage path must survive every half-written
+    state a dying process can leave."""
+
+    def _json_or(path: Path, default):
+        try:
+            return json.loads(path.read_text(errors="replace"))
+        except (OSError, json.JSONDecodeError):
+            return default
+
+    d = Path(record_dir)
+    out = {"path": str(d), "torn_lines": 0}
+    mf = d / "manifest.json"
+    out["manifest"] = _json_or(mf, {}) if mf.exists() else {}
+    mx = d / "metrics.json"
+    out["metrics"] = _json_or(mx, {}) if mx.exists() else {}
+    for name in ("events", "requests"):
+        p = d / f"{name}.jsonl"
+        rows: list = []
+        if p.exists():
+            rows, skipped = load_jsonl_tolerant(p)
+            out["torn_lines"] += skipped
+        out[name] = rows
+    tr = d / "trace.json"
+    out["trace"] = _json_or(tr, None) if tr.exists() else None
+    return out

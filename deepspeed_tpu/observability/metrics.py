@@ -41,6 +41,11 @@ class Reservoir:
         self.size = int(size)
         self._buf: list[float] = []
         self._idx = 0          # next write slot once the buffer is full
+        # sort cache, invalidated on add(): a publish pass reads the same
+        # window several times (SLO scoring + event export), and re-sorting
+        # up to 1024 samples per histogram per read doubles the lock-held
+        # work for nothing. Guarded by the owning Histogram's lock.
+        self._sorted: Optional[list[float]] = None
 
     def add(self, value: float) -> None:
         v = float(value)
@@ -49,6 +54,12 @@ class Reservoir:
         else:
             self._buf[self._idx] = v
             self._idx = (self._idx + 1) % self.size
+        self._sorted = None
+
+    def _sorted_buf(self) -> list[float]:
+        if self._sorted is None:
+            self._sorted = sorted(self._buf)
+        return self._sorted
 
     def __len__(self) -> int:
         return len(self._buf)
@@ -60,14 +71,14 @@ class Reservoir:
         """Nearest-rank percentile over the window (q in [0, 100])."""
         if not self._buf:
             return math.nan
-        s = sorted(self._buf)
+        s = self._sorted_buf()
         rank = max(1, math.ceil(q / 100.0 * len(s)))
         return s[min(rank, len(s)) - 1]
 
     def percentiles(self, qs: Iterable[float] = DEFAULT_PERCENTILES) -> dict:
         if not self._buf:
             return {f"p{_fmt_q(q)}": math.nan for q in qs}
-        s = sorted(self._buf)
+        s = self._sorted_buf()
         out = {}
         for q in qs:
             rank = max(1, math.ceil(q / 100.0 * len(s)))

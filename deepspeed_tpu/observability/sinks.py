@@ -19,45 +19,85 @@ tooling wants something it can ``json.loads`` or scrape.
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import time
 from pathlib import Path
-from typing import Sequence
+from typing import Callable, Sequence
 
 
 class JsonlSink:
-    """Append-only JSONL event log with a persistent file handle."""
+    """Append-only JSONL event log with a persistent file handle.
 
-    def __init__(self, cfg: dict):
+    ``rotate_mb`` (config, default 0 = off) bounds the file for
+    long-running serving jobs: at flush boundaries only (the persistent
+    handle is never churned per event), a file past the limit rolls to
+    ``<name>.jsonl.1`` (one generation kept — the rolling window plus
+    whatever external log shipping already collected) and a fresh file
+    takes over. ``clock`` stamps event wall time and is injectable; the
+    default is ``time.time`` because a log record's timestamp is
+    calendar time, not a measured interval."""
+
+    # subclass seams (RequestLogSink): filename suffix + flush cadence
+    SUFFIX = ".jsonl"
+    FLUSH_EVERY = 64
+
+    def __init__(self, cfg: dict, clock: Callable[[], float] = time.time):
         path = Path(cfg.get("output_path", "./monitor")) / (
-            cfg.get("job_name", "DeepSpeedTpuJob") + ".jsonl")
+            cfg.get("job_name", "DeepSpeedTpuJob") + self.SUFFIX)
         path.parent.mkdir(parents=True, exist_ok=True)
         self.path = path
+        self.clock = clock
         self._f = open(path, "a", encoding="utf-8")
         # 0 = rely on close(); N = fsync-less flush every N events
-        self._flush_every = int(cfg.get("flush_every", 64))
+        self._flush_every = int(cfg.get("flush_every", self.FLUSH_EVERY))
         self._pending = 0
+        self._rotate_bytes = int(float(cfg.get("rotate_mb", 0))
+                                 * 1024 * 1024)
+        self.rotations = 0
+
+    def _write_line(self, line: str) -> None:
+        self._f.write(line + "\n")
+        self._pending += 1
+        # the size check keeps rotate_mb honest even with flush_every=0
+        # ("rely on close()"): a standalone sink must not grow unbounded
+        # just because nothing else calls flush()
+        if (self._flush_every and self._pending >= self._flush_every) or \
+                (self._rotate_bytes and not self._f.closed
+                 and self._f.tell() >= self._rotate_bytes):
+            self.flush()
 
     def write_events(self, events: Sequence[tuple]) -> None:
-        now = time.time()
+        now = self.clock()
         for name, value, step in events:
-            self._f.write(json.dumps(
+            self._write_line(json.dumps(
                 {"name": name, "value": float(value), "step": int(step),
-                 "time": now}, separators=(",", ":")) + "\n")
-            self._pending += 1
-        if self._flush_every and self._pending >= self._flush_every:
-            self.flush()
+                 "time": now}, separators=(",", ":")))
+
+    def _maybe_rotate(self) -> None:
+        # flush-boundary-only: the handle persists between rotations, and
+        # a half-written line can never straddle a roll (we just flushed)
+        if not self._rotate_bytes or self._f.closed:
+            return
+        if self._f.tell() < self._rotate_bytes:
+            return
+        self._f.close()
+        os.replace(self.path, self.path.with_name(self.path.name + ".1"))
+        self._f = open(self.path, "a", encoding="utf-8")
+        self.rotations += 1
 
     def flush(self) -> None:
         self._pending = 0
         if not self._f.closed:
             self._f.flush()
+        self._maybe_rotate()
 
     def close(self) -> None:
         if not self._f.closed:
             self._f.flush()
             self._f.close()
+        self._pending = 0
 
 
 _PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -86,6 +126,7 @@ class PrometheusTextfileSink:
         self.path = d / (cfg.get("job_name", "DeepSpeedTpuJob") + ".prom")
         self.prefix = cfg.get("prefix", "dstpu")
         self._values: dict[str, float] = {}
+        self._source: dict[str, str] = {}    # sanitized -> original name
         self._step = 0
         self._dirty = False
 
@@ -93,7 +134,9 @@ class PrometheusTextfileSink:
         # buffered: the textfile is rewritten at flush() (report boundaries
         # / close), not per event batch
         for name, value, step in events:
-            self._values[prometheus_name(name, self.prefix)] = float(value)
+            pn = prometheus_name(name, self.prefix)
+            self._values[pn] = float(value)
+            self._source[pn] = name
             self._step = max(self._step, int(step))
             self._dirty = True
 
@@ -103,11 +146,15 @@ class PrometheusTextfileSink:
         # The step is its own gauge, NOT a label: a step label would mint a
         # brand-new Prometheus series per metric per step (label sets key
         # series), fragmenting graphs and blowing up TSDB head cardinality.
-        lines = [f"# TYPE {prometheus_name('step', self.prefix)} gauge",
-                 f"{prometheus_name('step', self.prefix)} {self._step}"]
+        step_name = prometheus_name("step", self.prefix)
+        lines = [f"# HELP {step_name} deepspeed_tpu metric 'step'",
+                 f"# TYPE {step_name} gauge",
+                 f"{step_name} {self._step}"]
         for name in sorted(self._values):
+            lines.append(f"# HELP {name} deepspeed_tpu metric "
+                         f"{self._source.get(name, name)!r}")
             lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {self._values[name]:.10g}")
+            lines.append(f"{name} {format_prometheus_value(self._values[name])}")
         tmp = self.path.with_suffix(".prom.tmp")
         tmp.write_text("\n".join(lines) + "\n", encoding="utf-8")
         os.replace(tmp, self.path)
@@ -115,6 +162,17 @@ class PrometheusTextfileSink:
 
     def close(self) -> None:
         self.flush()
+
+
+def format_prometheus_value(v: float) -> str:
+    """Exposition-format scalar: non-finite values spell ``+Inf`` /
+    ``-Inf`` / ``NaN`` (a bare ``nan``/``inf`` from ``%g`` is rejected by
+    strict scrapers)."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return f"{v:.10g}"
 
 
 def parse_prometheus_textfile(text: str) -> dict[str, float]:

@@ -1,0 +1,278 @@
+"""Declarative SLOs + anomaly detection over the metric registry.
+
+Three detectors, all host-side arithmetic over already-recorded floats
+(no device work, no syncs):
+
+- :class:`SLOScorer` — declarative targets (TTFT/TPOT p99, error-rate
+  budget) scored into ``Serve/slo_*`` burn-rate gauges. Burn rate is
+  ``observed / target``: 1.0 means exactly on budget, 2.0 means the p99
+  is twice the target — the multi-window burn-rate alerting shape SRE
+  books recommend, reduced to the rolling window the reservoirs keep.
+- :class:`MedianMADDetector` — rolling median + MAD outlier test for
+  step-time regressions (``Train/step_time_s``, the serving decode
+  step). Median/MAD instead of mean/stddev because one genuine stall
+  must not drag the baseline up and mask the next one.
+- :class:`CompileStormDetector` — watches a monotonically increasing
+  compile counter; a burst of recompiles after warmup (shape drift, a
+  config bug evicting the program cache) is a latency cliff operators
+  need attributed.
+
+Every firing lands as a counter bump, a gauge, and a flight-recorder
+marker (when one is attached) — the dump then *explains* why it was
+taken instead of showing a bare timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Optional
+
+from .metrics import MetricsRegistry
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Declarative serving/training SLO + anomaly knobs (all off by
+    default; 0 disables the corresponding detector)."""
+
+    # p99 latency targets over the rolling histogram window, seconds.
+    ttft_p99_s: float = 0.0
+    tpot_p99_s: float = 0.0
+    # Error budget: max acceptable fraction of non-OK terminal requests
+    # (timeouts, non-finite retirements, sheds) among all terminated.
+    error_rate: float = 0.0
+    # Step-time regression: flag a step slower than median + k * MAD over
+    # the rolling window (k = this knob; 0 disables).
+    step_time_mad_k: float = 0.0
+    step_time_window: int = 64
+    step_time_min_samples: int = 16
+    # Compile storm: more than this many new compiles inside one trailing
+    # window of iterations/steps, after the warmup grace (0 disables).
+    compile_storm_threshold: int = 0
+    compile_storm_window: int = 32
+    compile_storm_grace: int = 64
+
+    def __post_init__(self):
+        for knob in ("ttft_p99_s", "tpot_p99_s", "error_rate",
+                     "step_time_mad_k"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0, "
+                                 f"got {getattr(self, knob)}")
+        if self.error_rate > 1:
+            raise ValueError(f"error_rate is a fraction in [0, 1], "
+                             f"got {self.error_rate}")
+        for knob in ("step_time_window", "step_time_min_samples",
+                     "compile_storm_window"):
+            if getattr(self, knob) < 1:
+                raise ValueError(f"{knob} must be >= 1, "
+                                 f"got {getattr(self, knob)}")
+
+    @property
+    def any_enabled(self) -> bool:
+        return bool(self.ttft_p99_s or self.tpot_p99_s or self.error_rate
+                    or self.step_time_mad_k or self.compile_storm_threshold)
+
+    @classmethod
+    def from_any(cls, cfg: "SLOConfig | dict | None") -> "SLOConfig | None":
+        if cfg is None or isinstance(cfg, cls):
+            return cfg
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(f"unknown slo config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+# Non-OK terminal outcomes charged against the error budget. SHED counts:
+# a shed request is a request the service failed to serve.
+_ERROR_COUNTERS = ("Serve/timeout", "Serve/nonfinite", "Serve/shed")
+
+
+class SLOScorer:
+    """Scores one registry against one :class:`SLOConfig`.
+
+    ``score()`` reads the rolling ``Serve/ttft_s`` / ``Serve/tpot_s``
+    reservoirs and the terminal-status counters, writes
+    ``Serve/slo_{ttft,tpot,error}_burn`` gauges plus a cumulative
+    ``Serve/slo_violations`` counter, and notes each NEW violation into
+    the flight recorder. Violations edge-trigger: a burn that stays > 1
+    across many score() calls marks once until it recovers below 1."""
+
+    # error-rate burn is computed over the outcomes of the last this-many
+    # score() passes, mirroring the rolling-reservoir semantics of the
+    # latency burns — lifetime counters would let a million healthy
+    # requests mask the first ten thousand of a total outage
+    ERROR_WINDOW_SCORES = 32
+
+    def __init__(self, cfg: SLOConfig, registry: MetricsRegistry,
+                 flight=None):
+        self.cfg = cfg
+        self.registry = registry
+        self.flight = flight
+        self._breached: set[str] = set()
+        self._err_hist: deque[tuple[float, float]] = deque(
+            maxlen=self.ERROR_WINDOW_SCORES)
+        self._prev_errors = 0.0
+        self._prev_total = 0.0
+
+    def _mark(self, which: str, burn: float, observed: float,
+              target: float) -> None:
+        r = self.registry
+        r.gauge(f"Serve/slo_{which}_burn").set(burn)
+        if burn <= 1.0:
+            self._breached.discard(which)
+            return
+        if which in self._breached:      # still breached: already marked
+            return
+        self._breached.add(which)
+        r.counter("Serve/slo_violations").inc()
+        if self.flight is not None:
+            self.flight.note(f"slo_{which}_breach", burn=round(burn, 4),
+                             observed=observed, target=target)
+
+    def score(self) -> dict:
+        """One scoring pass; returns ``{which: burn}`` for the enabled
+        targets (NaN burn while the window is still empty)."""
+        snap = self.registry.snapshot()
+        hist, counters = snap["histograms"], snap["counters"]
+        out: dict[str, float] = {}
+        for which, target, series in (
+                ("ttft", self.cfg.ttft_p99_s, "Serve/ttft_s"),
+                ("tpot", self.cfg.tpot_p99_s, "Serve/tpot_s")):
+            if not target:
+                continue
+            p99 = hist.get(series, {}).get("p99", math.nan)
+            burn = p99 / target
+            out[which] = burn
+            if not math.isnan(burn):
+                self._mark(which, burn, p99, target)
+        if self.cfg.error_rate:
+            errors = sum(counters.get(n, 0) for n in _ERROR_COUNTERS)
+            total = errors + counters.get("Serve/retired", 0)
+            # rolling window over score() passes: push this pass's delta,
+            # rate the window — recent traffic, not process history
+            self._err_hist.append((errors - self._prev_errors,
+                                   total - self._prev_total))
+            self._prev_errors, self._prev_total = errors, total
+            win_err = sum(e for e, _ in self._err_hist)
+            win_total = sum(t for _, t in self._err_hist)
+            if win_total > 0:
+                rate = win_err / win_total
+                burn = rate / self.cfg.error_rate
+                out["error"] = burn
+                self._mark("error", burn, rate, self.cfg.error_rate)
+            else:
+                out["error"] = math.nan
+        return out
+
+
+class MedianMADDetector:
+    """Rolling median + MAD step-time regression detector.
+
+    ``observe(v)`` returns True when ``v > median + k * MAD`` over the
+    trailing window (MAD floored at 5% of the median so a perfectly
+    steady window — MAD 0 — doesn't flag micro-jitter). The offending
+    sample is NOT added to the window, so a stall can't poison its own
+    baseline; recovery samples re-enter normally. A shift that PERSISTS
+    (``REGIME_SHIFT_FIRES`` consecutive outliers — e.g. occupancy
+    legitimately grew and every step is now slower) is adopted as the
+    new baseline instead of firing forever and flooding the flight ring
+    with one marker per step."""
+
+    # consecutive outliers after which the detector stops flagging and
+    # starts admitting samples — a regime shift, not a regression
+    REGIME_SHIFT_FIRES = 16
+
+    def __init__(self, k: float = 0.0, window: int = 64,
+                 min_samples: int = 16):
+        if window < 1 or min_samples < 1:
+            raise ValueError("window and min_samples must be >= 1")
+        self.k = float(k)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self._buf: deque[float] = deque(maxlen=self.window)
+        self._consecutive = 0
+        self.fired = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.k > 0
+
+    def stats(self) -> tuple[float, float]:
+        s = sorted(self._buf)
+        n = len(s)
+        if not n:
+            return math.nan, math.nan
+        med = s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+        dev = sorted(abs(v - med) for v in s)
+        mad = dev[n // 2] if n % 2 else 0.5 * (dev[n // 2 - 1] + dev[n // 2])
+        return med, mad
+
+    def observe(self, v: float) -> bool:
+        v = float(v)
+        if not self.enabled:
+            return False
+        if len(self._buf) >= self.min_samples:
+            med, mad = self.stats()
+            floor = 0.05 * med
+            if v > med + self.k * max(mad, floor):
+                self._consecutive += 1
+                if self._consecutive <= self.REGIME_SHIFT_FIRES:
+                    self.fired += 1
+                    return True
+                # persistent: adopt the new regime — admit the sample so
+                # the median converges to it, and stop flagging
+                self._buf.append(v)
+                return False
+        self._consecutive = 0
+        self._buf.append(v)
+        return False
+
+
+class CompileStormDetector:
+    """Burst detector over a monotonically increasing compile counter.
+
+    ``update(iteration, compiles)`` returns the number of new compiles in
+    the trailing ``window`` when it exceeds ``threshold`` (else 0). The
+    first ``grace`` iterations are warmup — bucket-shaped programs are
+    *supposed* to compile there. Edge-triggered per storm: fires once on
+    the RISING edge (window count crosses the threshold) and stays
+    silent until the window drains back below it — an ongoing storm is
+    one storm, not one firing per iteration."""
+
+    def __init__(self, threshold: int = 0, window: int = 32,
+                 grace: int = 64):
+        self.threshold = int(threshold)
+        self.window = int(window)
+        self.grace = int(grace)
+        self._hist: deque[tuple[int, int]] = deque()   # (iteration, total)
+        self._in_storm = False
+        self.fired = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def update(self, iteration: int, compiles: int) -> int:
+        if not self.enabled:
+            return 0
+        self._hist.append((int(iteration), int(compiles)))
+        # drop pre-grace entries too: warmup compiles are *supposed* to
+        # happen, and leaving them in the deque would count them in the
+        # first post-grace trailing window — a false storm at the boundary
+        while self._hist and (self._hist[0][0] < iteration - self.window
+                              or self._hist[0][0] < self.grace):
+            self._hist.popleft()
+        if iteration < self.grace:
+            return 0
+        new = compiles - self._hist[0][1]
+        if new <= self.threshold:
+            self._in_storm = False
+            return 0
+        if self._in_storm:
+            return 0
+        self._in_storm = True
+        self.fired += 1
+        return new

@@ -1,0 +1,158 @@
+"""Request-lifecycle span events: the time-attribution layer.
+
+The PR-2 metrics answer *aggregate* questions ("what is p99 TTFT"); a
+span ring answers *attribution* questions ("which request blew its TTFT
+SLO and where did the time go — queue, chunked prefill, or decode
+co-tenancy"), the same transparent-tracking need T3 motivates for
+compute/collective overlap. Every lifecycle edge the serving scheduler
+and training engine already stamp (``submit_t`` / ``first_token_t`` /
+retirement, the wall-clock-breakdown timers) becomes a typed
+:class:`SpanEvent` in a bounded, thread-safe ring buffer.
+
+Cost discipline: recording is host-side floats into a deque under a
+lock — no device buffers, no host↔device syncs, no new compiled
+programs. Engines hold ``spans = None`` when disabled, so the hot path
+pays one ``is not None`` and the ``bench_serving.py --smoke``
+compile-freeze gate stays green. Timestamps come from the owner's
+injectable clock (the same one ``ServingStats`` fakes in tests).
+
+The ring is the substrate for two consumers: the Chrome-trace/Perfetto
+export (``export.py``) and the crash/stall flight recorder
+(``flight.py``), which snapshots the last-N events into a post-mortem
+artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+# ------------------------------------------------------------- event kinds
+# Serving request lifecycle (rid-carrying):
+QUEUED = "queued"                  # span: submit → admission (queue wait)
+PREFILL_CHUNK = "prefill_chunk"    # span: one bucket-shaped chunk dispatch
+PLACED = "placed"                  # instant: request occupied a slot
+DECODE_RESIDENCY = "decode"        # span: first token → retirement, in slot
+RETIRED = "retired"                # instant: terminal status lands
+# Serving engine cadence (no rid):
+DECODE_STEP = "decode_step"        # span: one slot decode step (all slots)
+OCCUPANCY = "occupancy"            # counter: slots occupied / queue depth
+# Training engine cadence:
+TRAIN_STEP = "train_step"          # span: one train_batch() call
+TRAIN_PHASE = "train_phase"        # span: a wall-clock-breakdown timer
+                                   # interval (batch_prep/step_dispatch/
+                                   # step_sync, fwd/bwd/host_step offload)
+# Cross-cutting:
+MARKER = "marker"                  # instant: SLO burn, anomaly, watchdog,
+                                   # compile storm — the "why" of a dump
+
+_COUNTER_KINDS = frozenset({OCCUPANCY})
+_INSTANT_KINDS = frozenset({PLACED, RETIRED, MARKER})
+
+
+@dataclasses.dataclass
+class SpanEvent:
+    """One typed lifecycle event. ``t1 is None`` marks an instant event;
+    counters carry their samples in ``meta``."""
+
+    kind: str
+    t0: float
+    t1: Optional[float] = None
+    rid: Optional[int] = None
+    slot: Optional[int] = None
+    step: Optional[int] = None
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return 0.0 if self.t1 is None else self.t1 - self.t0
+
+    @property
+    def instant(self) -> bool:
+        return self.t1 is None
+
+    def as_dict(self) -> dict:
+        out = {"kind": self.kind, "t0": self.t0}
+        if self.t1 is not None:
+            out["t1"] = self.t1
+        for k in ("rid", "slot", "step"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        if self.meta:
+            out["meta"] = self.meta
+        return out
+
+
+class SpanRecorder:
+    """Bounded thread-safe ring of :class:`SpanEvent`.
+
+    ``capacity`` bounds host memory for the life of the process (a busy
+    replica emits a handful of events per iteration; 4096 covers minutes
+    of context around a fault, which is what a post-mortem needs — the
+    JSONL sinks carry the unbounded history). ``clock`` is only used by
+    the convenience emitters that stamp "now" themselves; callers that
+    already hold timestamps (the scheduler's ``submit_t``, the decode
+    window's ``t0``) pass them explicitly so spans and metrics agree to
+    the exact float."""
+
+    def __init__(self, capacity: int = 4096,
+                 clock: Callable[[], float] = time.perf_counter):
+        if capacity <= 0:
+            raise ValueError(f"span ring capacity must be > 0, "
+                             f"got {capacity}")
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._ring: deque[SpanEvent] = deque(maxlen=self.capacity)
+        # RLock, not Lock: the PreemptionGuard SIGTERM handler notes a
+        # marker from the MAIN thread — which may be interrupted inside
+        # emit() holding this very lock; a non-reentrant lock would
+        # deadlock the handler through the whole grace window
+        self._lock = threading.RLock()
+        self._emitted = 0
+
+    # ------------------------------------------------------------ recording
+    def emit(self, kind: str, t0: float, t1: Optional[float] = None, *,
+             rid: Optional[int] = None, slot: Optional[int] = None,
+             step: Optional[int] = None, **meta) -> SpanEvent:
+        ev = SpanEvent(kind=kind, t0=float(t0),
+                       t1=None if t1 is None else float(t1),
+                       rid=rid, slot=slot, step=step, meta=meta)
+        with self._lock:
+            self._ring.append(ev)
+            self._emitted += 1
+        return ev
+
+    def marker(self, name: str, t: Optional[float] = None,
+               **meta) -> SpanEvent:
+        """Instant MARKER event ("why" annotations: SLO burn, anomaly,
+        watchdog stall, compile storm)."""
+        return self.emit(MARKER, self.clock() if t is None else t,
+                         name=name, **meta)
+
+    def counter(self, t: Optional[float] = None, **samples) -> SpanEvent:
+        """OCCUPANCY counter sample (queue depth, slots occupied, ...)."""
+        return self.emit(OCCUPANCY, self.clock() if t is None else t,
+                         **samples)
+
+    # -------------------------------------------------------------- readout
+    def events(self) -> list[SpanEvent]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def emitted(self) -> int:
+        """Total events ever emitted (ring evictions included)."""
+        with self._lock:
+            return self._emitted
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()

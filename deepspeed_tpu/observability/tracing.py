@@ -186,13 +186,19 @@ class ServingStats:
         r.gauge("Serve/queue_depth").set(queue_depth)
         return t
 
-    def on_admit(self, queue_depth: int) -> float:
+    def on_admit(self, queue_depth: int,
+                 submit_t: Optional[float] = None) -> float:
         t = self.clock()
         if self._t0 is None:
             self._t0 = t
         r = self.registry
         r.counter("Serve/admitted").inc()
         r.gauge("Serve/queue_depth").set(queue_depth)
+        if submit_t is not None:
+            # admission wait: how long the request sat in the queue before
+            # the scheduler picked it (previously only recoverable by
+            # hand-subtracting TTFT components)
+            r.histogram("Serve/queue_wait_s").observe(t - submit_t)
         return t
 
     def on_first_token(self, submit_t: float) -> float:
@@ -283,4 +289,5 @@ class ServingStats:
             "goodput_tps": g.get("Serve/goodput_tps"),
             "ttft_s": h.get("Serve/ttft_s", {}),
             "tpot_s": h.get("Serve/tpot_s", {}),
+            "queue_wait_s": h.get("Serve/queue_wait_s", {}),
         }

@@ -102,5 +102,12 @@ class PreemptionGuard:
         self.engine.wait_for_checkpoint()
         log_dist("preemption: checkpoint durable; 'latest' flipped",
                  ranks=[0], level="WARNING")
+        flight = getattr(self.engine, "flight", None)
+        if flight is not None:
+            # leave the black box next to the checkpoint: the next
+            # incarnation's operator sees what the dying one was doing
+            flight.note("preemption_sigterm", signum=int(signum),
+                        exit_code=self.exit_code)
+            flight.dump("preemption")
         if self.exit_on_signal:
             raise SystemExit(self.exit_code)

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
+from ..observability.spans import TRAIN_PHASE, TRAIN_STEP
 from ..platform.accelerator import get_accelerator
 from ..platform.mesh import (BATCH_AXES, MeshSpec, build_mesh, dp_world_size)
 from ..utils.logging import log_dist, logger
@@ -514,6 +515,46 @@ class Engine:
                 obs.trace_steps, obs.trace_dir,
                 sync_fn=lambda: jax.block_until_ready(
                     self.compute_params if self.offload else self.state))
+        # span ring + flight recorder + step-time anomaly detector (the
+        # training half of the serving engine's observability trio); all
+        # default-off, each None costing one `is not None` on the hot path
+        self.spans = None
+        if obs.spans:
+            from ..observability.spans import SpanRecorder
+
+            self.spans = SpanRecorder(obs.spans_ring)
+        self.flight = None
+        if obs.flight_dir:
+            from ..observability.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                obs.flight_dir, spans=self.spans,
+                snapshots={"train": self.metrics_snapshot},
+                max_dumps=obs.flight_max_dumps, job_name="train")
+        self._step_anomaly = None
+        if obs.slo:
+            from ..observability.slo import MedianMADDetector, SLOConfig
+
+            slo = SLOConfig.from_any(obs.slo)
+            if slo.step_time_mad_k:
+                self._step_anomaly = MedianMADDetector(
+                    slo.step_time_mad_k, slo.step_time_window,
+                    slo.step_time_min_samples)
+            # an enabled knob the training engine has no machinery for
+            # must not be silently ignored (same stance as
+            # MonitorConfig.any_enabled): ttft/tpot/error-rate and the
+            # compile-storm detector are serving-side — the operator who
+            # set them believes detection is on
+            unwired = [k for k in ("ttft_p99_s", "tpot_p99_s",
+                                   "error_rate",
+                                   "compile_storm_threshold")
+                       if getattr(slo, k)]
+            if unwired:
+                log_dist(
+                    f"observability.slo: {unwired} are serving-side "
+                    "knobs — the training engine only wires "
+                    "step_time_mad_k; set them under the serving "
+                    "config's `slo` block instead", level="WARNING")
         mb, gas = self.config.train_micro_batch_size_per_gpu, self.config.gradient_accumulation_steps
         try:
             peak = peak_flops_for(self.acc.current_device()) * len(jax.devices())
@@ -819,6 +860,13 @@ class Engine:
             self._offload_ls, metrics["grads_finite"], self.config.fp16)
         t_host = _time.perf_counter() - t1
         self.global_steps += 1
+        if self.spans is not None:
+            t2 = t1 + t_host
+            self.spans.emit(TRAIN_STEP, t0, t2, step=self.global_steps)
+            self.spans.emit(TRAIN_PHASE, t0, t0 + t_bwd,
+                            step=self.global_steps, phase="bwd")
+            self.spans.emit(TRAIN_PHASE, t1, t2, step=self.global_steps,
+                            phase="host_step")
         out = {"loss": float(metrics["loss"]), "grad_norm": gnorm, "lr": lr,
                "loss_scale": float(scale), "skipped": 0 if finite else 1,
                "bwd_s": t_bwd, "host_step_s": t_host}
@@ -1284,6 +1332,14 @@ class Engine:
         if self._bad_step_streak >= self._max_bad_steps:
             from ..resilience.guards import NonFiniteLossError
 
+            if self.flight is not None:
+                # the halt is the post-mortem moment: freeze the black box
+                # BEFORE unwinding so the dump shows the collapse window
+                self.flight.note("nonfinite_halt",
+                                 streak=self._bad_step_streak,
+                                 last_loss=last_loss,
+                                 step=self.global_steps)
+                self.flight.dump("nonfinite_halt")
             raise NonFiniteLossError(
                 f"halting: {self._bad_step_streak} consecutive bad optimizer "
                 f"steps (threshold {self._max_bad_steps}) — non-finite loss "
@@ -1323,6 +1379,20 @@ class Engine:
                     gauges[f"Train/{key}"] = stats[key]
             self.metrics.histogram("Train/step_time_s").observe(
                 stats["step_time_s"])
+            if self._step_anomaly is not None \
+                    and self._step_anomaly.observe(stats["step_time_s"]):
+                self.metrics.counter("Train/step_time_regressions").inc()
+                med, mad = self._step_anomaly.stats()
+                self.metrics.gauge("Train/step_time_baseline_s").set(med)
+                log_dist(
+                    f"step-time regression: {stats['step_time_s']:.4f}s vs "
+                    f"rolling median {med:.4f}s (MAD {mad:.4f}s) at step "
+                    f"{self.global_steps}", ranks=[0], level="WARNING")
+                if self.flight is not None:
+                    self.flight.note("step_time_regression",
+                                     step_s=stats["step_time_s"],
+                                     median_s=med, mad_s=mad,
+                                     step=self.global_steps)
         self.metrics.set_gauges(gauges)
         if metrics.get("skipped"):
             self.metrics.counter("Train/skipped_steps").inc(
@@ -1349,6 +1419,13 @@ class Engine:
         """Machine-readable view of the training registry (the serving
         analog lives on ``InferenceEngine.metrics_snapshot``)."""
         return self.metrics.snapshot()
+
+    def dump_flight(self, reason: str = "manual"):
+        """Freeze the flight recorder (observability/flight.py) now;
+        None when no recorder is configured or the dump cap is reached."""
+        if self.flight is None:
+            return None
+        return self.flight.dump(reason)
 
     def close(self) -> None:
         """Teardown: close any open XLA trace window and the monitor's
@@ -1380,6 +1457,7 @@ class Engine:
         if self.offload:
             return self._train_batch_offload(batch)
         wcb = self.config.wall_clock_breakdown
+        t_step0 = self.spans.clock() if self.spans is not None else 0.0
         self.throughput.start()
         if wcb:
             self.timers.start("batch_prep")
@@ -1468,6 +1546,18 @@ class Engine:
                 self._emit_monitor_events(extra)
         else:
             self.throughput.stop(report=False)
+        if self.spans is not None:
+            self.spans.emit(TRAIN_STEP, t_step0, self.spans.clock(),
+                            step=self.global_steps)
+            if wcb:
+                # re-emit the wall-clock-breakdown timer windows as phase
+                # spans (last completed interval per timer; no new clocks)
+                for name in ("batch_prep", "step_dispatch", "step_sync"):
+                    tm = self.timers(name)
+                    if tm.last_stop > 0:
+                        self.spans.emit(TRAIN_PHASE, tm.last_start,
+                                        tm.last_stop,
+                                        step=self.global_steps, phase=name)
         # Profiler fires OUTSIDE the throughput window (its extra timed step
         # + one-time AOT compile must not pollute samples/s accounting).
         if self.flops_profiler and self.flops_profiler.should_fire():

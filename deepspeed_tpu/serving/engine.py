@@ -38,6 +38,8 @@ from ..inference.decode import (GenCarry, decode_step, forward_with_cache,
                                 init_cache)
 from ..inference.engine import InferenceEngine
 from ..inference.sampling import per_request_keys, split_keys
+from ..observability import spans as _spans
+from ..observability.export import request_record
 from ..observability.tracing import ServingStats
 from ..resilience.chaos import ChaosMonkey
 from ..resilience.guards import QueueFullError, RequestStatus
@@ -95,12 +97,49 @@ class ServingEngine:
         self._mat = engine._materialized if engine.config.quantize else None
         kw = {"clock": clock} if clock is not None else {}
         self.stats = ServingStats(registry=registry, **kw)
+        # ---- observability: spans / flight / SLO (docs/OBSERVABILITY.md).
+        # All default-off; disabled they cost the hot path `is not None`
+        # checks only — no clock reads, no syncs, no programs.
+        self.spans: Optional[_spans.SpanRecorder] = None
+        if self.cfg.spans:
+            self.spans = _spans.SpanRecorder(self.cfg.spans_ring,
+                                             clock=self.stats.clock)
+        self.flight = None
+        if self.cfg.flight_dir is not None:
+            from ..observability.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self.cfg.flight_dir, spans=self.spans,
+                snapshots={"serving": self.metrics_snapshot,
+                           "health": self.health},
+                max_dumps=self.cfg.flight_max_dumps,
+                clock=self.stats.clock, job_name="serving")
+        self.slo = None
+        self._step_anomaly = None
+        self._compile_storm = None
+        if self.cfg.slo is not None and self.cfg.slo.any_enabled:
+            from ..observability.slo import (CompileStormDetector,
+                                            MedianMADDetector, SLOScorer)
+
+            slo = self.cfg.slo
+            self.slo = SLOScorer(slo, self.stats.registry,
+                                 flight=self.flight)
+            if slo.step_time_mad_k:
+                self._step_anomaly = MedianMADDetector(
+                    slo.step_time_mad_k, slo.step_time_window,
+                    slo.step_time_min_samples)
+            if slo.compile_storm_threshold:
+                self._compile_storm = CompileStormDetector(
+                    slo.compile_storm_threshold, slo.compile_storm_window,
+                    slo.compile_storm_grace)
+        self._request_logs: list = []
         self.sched = Scheduler(self.cfg.slots, self.cfg.max_len,
                                self.cfg.prefill_chunk,
                                max_queue=self.cfg.max_queue,
                                eos_token_id=self._eos, stats=self.stats,
                                ttft_deadline_s=self.cfg.ttft_deadline_s,
-                               total_deadline_s=self.cfg.total_deadline_s)
+                               total_deadline_s=self.cfg.total_deadline_s,
+                               spans=self.spans)
         self._programs: OrderedDict = OrderedDict()
         self.compiles = 0        # program builds — bounded in steady state
         # finished requests awaiting pickup, BOUNDED (oldest evicted): a
@@ -279,15 +318,50 @@ class ServingEngine:
                 # twice, and don't let the guard add a second one
                 toks, dones, oks = jax.device_get(
                     (self._state.tok, self._state.done, ok))
-                self._last_step_s = self.stats.clock() - t0
+                t1 = self.stats.clock()
+                self._last_step_s = t1 - t0
+                if self.spans is not None:
+                    # reuses the t0/t1 the watchdog already measures — the
+                    # span layer adds no clock reads to the decode window
+                    self.spans.emit(_spans.DECODE_STEP, t0, t1,
+                                    step=self._iterations,
+                                    slots=len(self.sched.running))
                 wd = self.cfg.watchdog_s
                 if wd and self._last_step_s > wd:
+                    # rising edge: the previous iteration was healthy. A
+                    # stall STORM (every step slow — threshold too low, or
+                    # a degraded device) must not burn the max_dumps
+                    # budget that a later terminal post-mortem (SIGTERM,
+                    # nonfinite halt) will need — dump once per episode,
+                    # mark every stall.
+                    new_episode = self._last_stall_iter != \
+                        self._iterations - 1
                     self._last_stall_iter = self._iterations
                     self.stats.on_watchdog_stall(self._last_step_s, wd)
                     warning_once(
                         f"serving watchdog: a decode step exceeded "
                         f"{wd:.3f}s (see Serve/last_stall_s for the "
                         "latest measurement; further stalls only count)")
+                    if self.flight is not None:
+                        # the black box IS the post-mortem: stamp why,
+                        # then freeze the last-N events + snapshots
+                        self.flight.note("watchdog_stall", t=t1,
+                                         step_s=self._last_step_s,
+                                         threshold_s=wd,
+                                         iteration=self._iterations)
+                        if new_episode:
+                            self.flight.dump("watchdog_stall")
+                if self._step_anomaly is not None \
+                        and self._step_anomaly.observe(self._last_step_s):
+                    r = self.stats.registry
+                    r.counter("Serve/step_time_regressions").inc()
+                    med, mad = self._step_anomaly.stats()
+                    r.gauge("Serve/step_time_baseline_s").set(med)
+                    if self.flight is not None:
+                        self.flight.note("step_time_regression", t=t1,
+                                         step_s=self._last_step_s,
+                                         median_s=med, mad_s=mad,
+                                         iteration=self._iterations)
                 if not oks.all():
                     # retire ONLY the poisoned rows, before on_step can
                     # append their garbage tokens; every other slot's
@@ -299,12 +373,34 @@ class ServingEngine:
                 ran_decode = True
         self.stats.on_iteration(self.sched.queue_depth, self.sched.occupancy,
                                 self.cfg.slots, ran_chunk, ran_decode)
+        if self.spans is not None:
+            self.spans.counter(queue_depth=self.sched.queue_depth,
+                               occupancy=self.sched.occupancy)
+        if self._compile_storm is not None:
+            new = self._compile_storm.update(self._iterations, self.compiles)
+            if new:
+                self.stats.registry.counter("Serve/compile_storms").inc()
+                warning_once(
+                    f"serving compile storm: {new} new programs within "
+                    f"{self._compile_storm.window} iterations after "
+                    "warmup — shape drift or program-cache eviction "
+                    "(see docs/SERVING.md bucket tuning)")
+                if self.flight is not None:
+                    self.flight.note("compile_storm", new_compiles=new,
+                                     total_compiles=self.compiles,
+                                     iteration=self._iterations)
         self._iterations += 1
         for req in finished:
             self._store_result(req)
         return finished
 
     def _store_result(self, req: Request) -> None:
+        if self._request_logs or self.flight is not None:
+            rec = request_record(req)
+            for sink in self._request_logs:
+                sink.log_request(rec)
+            if self.flight is not None:
+                self.flight.on_request(rec)
         self.results[req.rid] = req
         if len(self.results) > self._max_results:
             self.results.popitem(last=False)
@@ -346,16 +442,26 @@ class ServingEngine:
         ch = plan[idx]
         ids = jnp.asarray(ch.ids[None], jnp.int32)
         params = self.engine.params
+        sp = self.spans
+        ct0 = sp.clock() if sp is not None else 0.0
         if not ch.final:
             fwd = self._prog(("chunk", ch.size), lambda: jax.jit(
                 self._chunk_impl, donate_argnums=(1,)))
             cache = fwd(params, cache, ids, jnp.int32(ch.start))
+            if sp is not None:
+                # dispatch wall time: honest on CPU, a lower bound where
+                # the chunk overlaps the async device queue
+                sp.emit(_spans.PREFILL_CHUNK, ct0, sp.clock(), rid=req.rid,
+                        chunk=idx, size=ch.size, final=False)
             self._prefill = (req, plan, idx + 1, cache, rng)
             return []
         fin = self._prog(("final", ch.size), lambda: jax.jit(
             self._final_impl, donate_argnums=(1,)))
         pf = fin(params, cache, ids, jnp.int32(ch.start),
                  jnp.int32(ch.last_index), jnp.int32(ch.true_len), rng)
+        if sp is not None:
+            sp.emit(_spans.PREFILL_CHUNK, ct0, sp.clock(), rid=req.rid,
+                    chunk=idx, size=ch.size, final=True)
         self._prefill = None
         first_tok = int(np.asarray(pf.tok)[0])
         if req.max_new == 1 or bool(np.asarray(pf.done)[0]):
@@ -480,11 +586,37 @@ class ServingEngine:
     def metrics_snapshot(self) -> dict:
         return {"compiles": self.compiles, **self.stats.snapshot()}
 
+    def score_slo(self) -> dict:
+        """One SLO scoring pass (``Serve/slo_*_burn`` gauges + flight
+        markers on new breaches); {} when no SLO config is set. Runs
+        inside ``publish_metrics`` so a normal serving loop needs no
+        extra call."""
+        return self.slo.score() if self.slo is not None else {}
+
+    def attach_monitor(self, monitor) -> None:
+        """Adopt a MonitorMaster's request-log writers: every retired
+        request is logged as one JSON record through the fan-out's
+        ``RequestLogSink`` (config ``monitor.request_log``). Scalar
+        metrics still flow via :meth:`publish_metrics` — call that on the
+        loop's cadence as before."""
+        for w in getattr(monitor, "writers", []):
+            if hasattr(w, "log_request") and w not in self._request_logs:
+                self._request_logs.append(w)
+
+    def dump_flight(self, reason: str = "manual"):
+        """Freeze the flight recorder now (ops triage / shutdown hook);
+        returns the dump directory or None (no recorder / dump cap)."""
+        if self.flight is None:
+            return None
+        return self.flight.dump(reason)
+
     def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
         """Push ``Serve/*`` through a monitor fan-out (same contract as
         ``InferenceEngine.publish_metrics`` — the serving loop owns the
-        cadence)."""
+        cadence). Scores SLOs first so the burn gauges ride the same
+        flush."""
         from ..observability.metrics import publish_registry
 
+        self.score_slo()
         return publish_registry(self.stats.registry, monitor, step,
                                 default_step_counter="Serve/iterations")

@@ -32,6 +32,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import spans as _spans
 from ..observability.tracing import ServingStats
 from ..resilience.guards import QueueFullError, RequestStatus
 
@@ -110,6 +111,7 @@ class Request:
     max_new: int
     seed: int
     submit_t: float = 0.0
+    admit_t: Optional[float] = None       # left the queue (prefill starts)
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     slot: int = -1
@@ -147,7 +149,8 @@ class Scheduler:
                  max_queue: int = 0, eos_token_id: Optional[int] = None,
                  stats: Optional[ServingStats] = None,
                  ttft_deadline_s: float = 0.0,
-                 total_deadline_s: float = 0.0):
+                 total_deadline_s: float = 0.0,
+                 spans: "Optional[_spans.SpanRecorder]" = None):
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
@@ -156,6 +159,10 @@ class Scheduler:
         self.stats = stats if stats is not None else ServingStats()
         self.ttft_deadline_s = float(ttft_deadline_s)
         self.total_deadline_s = float(total_deadline_s)
+        # lifecycle span emission (observability/spans.py): every edge the
+        # scheduler already stamps becomes a typed event. None (default) =
+        # zero extra work beyond these `is not None` checks.
+        self.spans = spans
         self.queue: deque[Request] = deque()
         self.free: list[int] = list(range(slots))
         self.running: dict[int, Request] = {}
@@ -202,7 +209,12 @@ class Scheduler:
         if not self.queue or not self.free:
             return None
         req = self.queue.popleft()
-        self.stats.on_admit(len(self.queue))
+        admit_t = self.stats.on_admit(len(self.queue), submit_t=req.submit_t)
+        req.admit_t = admit_t
+        if self.spans is not None:
+            # the queue-wait span: submitted → picked for prefill
+            self.spans.emit(_spans.QUEUED, req.submit_t, admit_t,
+                            rid=req.rid)
         return req
 
     def plan(self, req: Request) -> list:
@@ -215,6 +227,9 @@ class Scheduler:
         slot = self.free.pop(0)
         req.slot = slot
         self.running[slot] = req
+        if self.spans is not None:
+            self.spans.emit(_spans.PLACED, req.first_token_t, rid=req.rid,
+                            slot=slot)
         return slot
 
     def complete_at_prefill(self, req: Request, first_tok: int) -> Request:
@@ -224,7 +239,26 @@ class Scheduler:
         req.tokens.append(int(first_tok))
         req.finish_t = self.stats.on_retire(len(req.tokens),
                                             req.first_token_t)
+        self._span_retire(req)
         return req
+
+    def _span_retire(self, req: Request) -> None:
+        """Terminal span pair: the decode-residency span (first token →
+        retirement, when the request ever held a slot) plus the typed
+        RETIRED instant every terminal path emits."""
+        if self.spans is None:
+            return
+        if req.slot >= 0 and req.first_token_t is not None \
+                and req.finish_t is not None:
+            self.spans.emit(_spans.DECODE_RESIDENCY, req.first_token_t,
+                            req.finish_t, rid=req.rid, slot=req.slot,
+                            tokens=len(req.tokens))
+        self.spans.emit(_spans.RETIRED,
+                        req.finish_t if req.finish_t is not None
+                        else req.submit_t,
+                        rid=req.rid,
+                        slot=req.slot if req.slot >= 0 else None,
+                        status=req.status.value, tokens=len(req.tokens))
 
     # -------------------------------------------------------------- decode
     def on_step(self, toks: np.ndarray, dones: np.ndarray) -> list:
@@ -241,6 +275,7 @@ class Scheduler:
                 del self.running[slot]
                 self.free.append(slot)
                 finished.append(req)
+                self._span_retire(req)
         return finished
 
     # ------------------------------------------------------------- guards
@@ -258,6 +293,7 @@ class Scheduler:
         req.status = status
         req.error = error
         req.finish_t = self.stats.on_abort(status)
+        self._span_retire(req)
         return req
 
     def cancel(self, rid: int) -> Optional[Request]:

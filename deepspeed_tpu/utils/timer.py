@@ -22,6 +22,11 @@ class _Timer:
         self._start = 0.0
         self.elapsed_total = 0.0
         self.count = 0
+        # most recent completed interval, as absolute perf_counter instants
+        # — the observability span layer re-emits timer windows as spans
+        # without adding clock reads of its own
+        self.last_start = 0.0
+        self.last_stop = 0.0
 
     def start(self) -> None:
         self._start = time.perf_counter()
@@ -30,8 +35,11 @@ class _Timer:
     def stop(self) -> None:
         if not self.started:
             return
-        self.elapsed_total += time.perf_counter() - self._start
+        now = time.perf_counter()
+        self.elapsed_total += now - self._start
         self.count += 1
+        self.last_start = self._start
+        self.last_stop = now
         self.started = False
 
     def elapsed(self, reset: bool = True) -> float:

@@ -1,0 +1,20 @@
+"""Shared fake-clock test helper (imported by the observability and
+resilience suites; tests/unit is on sys.path under pytest's rootdir
+insertion because it is not a package)."""
+
+
+class TickClock:
+    """Deterministic clock: +dt per read, explicit advance() for
+    injected stalls (chaos hangs advance the SAME clock the watchdog
+    and the spans read — no real sleeping, no wall-clock races)."""
+
+    def __init__(self, dt=0.001):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+    def advance(self, s):
+        self.t += s

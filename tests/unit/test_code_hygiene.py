@@ -35,6 +35,7 @@ PRINT_ALLOWED = {
     "launcher/launch.py",      # process supervisor: child exit reporting
     "launcher/runner.py",      # multinode launcher CLI
     "runtime/checkpoint/to_fp32.py",   # zero_to_fp32-style CLI (stderr note)
+    "observability/doctor.py",  # ops triage CLI: the report IS its stdout
 }
 
 _BARE_PRINT = re.compile(r"^\s*print\(")
@@ -117,6 +118,64 @@ def test_no_bare_or_silent_except_in_library_code():
         "silent `except Exception: pass` beyond the justified allowlist — "
         "catch the narrowest type, or add an EXCEPT_PASS_ALLOWED entry "
         "WITH its justification:\n" + "\n".join(silent))
+
+
+# ------------------------------------------------------ clock-seam hygiene
+# Every timestamp in the serving/observability/resilience stack must be
+# fake-clock-testable: modules take an injectable ``clock`` (default-arg
+# references like ``clock=time.perf_counter`` are the seam and are fine);
+# a DIRECT ``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()``
+# call inside a function body hard-wires wall time and makes the chaos /
+# deadline / flight-record tests racy. ``time.sleep`` / ``time.strftime``
+# are not timestamps and are not linted.
+CLOCK_LINTED_DIRS = ("serving/", "observability/", "resilience/")
+
+# direct-call sites that may stay, each with its justification
+# (count per file, like EXCEPT_PASS_ALLOWED):
+CLOCK_CALL_ALLOWED: dict[str, int] = {
+    # (none today — new entries need a why, e.g. "operator-facing wall
+    # time in a filename, not a measured interval")
+}
+
+_CLOCK_CALL = re.compile(r"\btime\.(?:time|perf_counter|monotonic)\(\)")
+
+
+def _clock_calls(lines):
+    out = []
+    for lineno, line in enumerate(lines, 1):
+        code = line.split("#", 1)[0]
+        if _CLOCK_CALL.search(code):
+            out.append(lineno)
+    return out
+
+
+def test_no_bare_clock_calls_in_clock_seamed_modules():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        if not rel.startswith(CLOCK_LINTED_DIRS):
+            continue
+        hits = _clock_calls(path.read_text().splitlines())
+        if len(hits) > CLOCK_CALL_ALLOWED.get(rel, 0):
+            offenders += [f"{rel}:{n}" for n in hits]
+    assert not offenders, (
+        "direct wall-clock call in a clock-seamed module — take an "
+        "injectable `clock` (default it to time.perf_counter WITHOUT "
+        "calling it) so fake-clock tests stay deterministic, or add a "
+        "justified CLOCK_CALL_ALLOWED entry:\n" + "\n".join(offenders))
+
+
+def test_clock_call_allowlist_is_tight():
+    stale = []
+    for rel, allowed in CLOCK_CALL_ALLOWED.items():
+        p = PKG / rel
+        if not p.exists():
+            stale.append(f"{rel} (deleted)")
+            continue
+        hits = len(_clock_calls(p.read_text().splitlines()))
+        if hits < allowed:
+            stale.append(f"{rel} (allows {allowed}, found {hits})")
+    assert not stale, f"stale CLOCK_CALL_ALLOWED entries: {stale}"
 
 
 def test_except_pass_allowlist_is_tight():

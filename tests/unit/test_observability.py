@@ -22,12 +22,19 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu as ds
-from deepspeed_tpu.observability import (JsonlSink, MetricsRegistry,
+from deepspeed_tpu.observability import (CompileStormDetector, FlightRecorder,
+                                         JsonlSink, MedianMADDetector,
+                                         MetricsRegistry,
                                          PrometheusTextfileSink,
-                                         RequestTracer, Reservoir,
-                                         TraceWindow,
+                                         RequestLogSink, RequestTracer,
+                                         Reservoir, SLOConfig, SLOScorer,
+                                         SpanRecorder, TraceWindow,
+                                         newest_flight_record,
                                          parse_prometheus_textfile,
-                                         prometheus_name, sample_memory)
+                                         prometheus_name, read_flight_record,
+                                         sample_memory, to_chrome_trace,
+                                         validate_chrome_trace)
+from deepspeed_tpu.observability import spans as spans_mod
 from deepspeed_tpu.models import build_model, tiny_test
 
 
@@ -353,6 +360,435 @@ def test_quantized_engine_traces_quantized_bytes():
     assert q8.tracer.bytes_per_step < dense.tracer.bytes_per_step
 
 
+# ------------------------------------------------------- spans + export
+from _fake_clock import TickClock    # noqa: E402  (shared test helper)
+
+
+def test_span_recorder_ring_and_threading():
+    import threading
+
+    sp = SpanRecorder(capacity=100, clock=TickClock())
+    with pytest.raises(ValueError):
+        SpanRecorder(capacity=0)
+
+    def work(k):
+        for i in range(200):
+            sp.emit(spans_mod.DECODE_STEP, float(i), float(i) + 0.5,
+                    step=i, worker=k)
+
+    ts = [threading.Thread(target=work, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(sp) == 100                 # bounded
+    assert sp.emitted == 800              # nothing lost before eviction
+    ev = sp.events()[-1]
+    assert ev.duration == pytest.approx(0.5)
+    m = sp.marker("why", cause="test")
+    assert m.instant and m.meta["name"] == "why"
+
+
+def _lifecycle_ring():
+    sp = SpanRecorder(64, clock=TickClock())
+    sp.emit(spans_mod.QUEUED, 0.0, 1.0, rid=7)
+    sp.emit(spans_mod.PREFILL_CHUNK, 1.0, 1.2, rid=7, chunk=0, size=16,
+            final=True)
+    sp.emit(spans_mod.PLACED, 1.2, rid=7, slot=3)
+    sp.emit(spans_mod.DECODE_STEP, 1.2, 1.3, step=0, slots=1)
+    sp.counter(t=1.3, queue_depth=2, occupancy=1)
+    sp.emit(spans_mod.DECODE_RESIDENCY, 1.2, 2.0, rid=7, slot=3, tokens=9)
+    sp.emit(spans_mod.RETIRED, 2.0, rid=7, slot=3, status="ok", tokens=9)
+    sp.marker("slo_ttft_breach", t=2.0, burn=1.5)
+    sp.emit(spans_mod.TRAIN_STEP, 0.0, 0.5, step=1)
+    sp.emit(spans_mod.TRAIN_PHASE, 0.0, 0.2, step=1, phase="step_dispatch")
+    return sp
+
+
+def test_chrome_trace_export_schema_valid():
+    sp = _lifecycle_ring()
+    trace = to_chrome_trace(sp.events(), job_name="t")
+    assert validate_chrome_trace(trace) == []
+    evs = trace["traceEvents"]
+    names = [e["name"] for e in evs]
+    # slots are tracks: the slot-3 thread is named, request span rides it
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               and e["args"]["name"] == "slot 3" for e in evs)
+    assert any(n == "decode rid=7" for n in names)
+    # counters became counter tracks
+    assert any(e["ph"] == "C" and e["name"] == "queue_depth" for e in evs)
+    assert any(e["ph"] == "C" and e["name"] == "occupancy" for e in evs)
+    # markers are instants; train spans land under the train pid
+    assert any(e["ph"] == "i" and "slo_ttft_breach" in e["name"]
+               for e in evs)
+    from deepspeed_tpu.observability.export import PID_TRAIN
+
+    assert any(e["pid"] == PID_TRAIN and e["name"] == "step_dispatch"
+               for e in evs)
+    # ts is relative µs, sorted among non-metadata events
+    tss = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert tss == sorted(tss) and tss[0] == 0.0
+    assert json.loads(json.dumps(trace)) == trace      # JSON-serializable
+
+
+def test_chrome_trace_validator_catches_malformed():
+    assert validate_chrome_trace({}) == ["missing or non-list traceEvents"]
+    bad = {"traceEvents": [
+        {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 2.0, "dur": 1.0},
+        {"name": "b", "ph": "X", "pid": 1, "tid": 1, "ts": 1.0, "dur": 1.0},
+    ]}
+    assert any("sorted" in p for p in validate_chrome_trace(bad))
+    assert any("dur" in p for p in validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "X", "pid": 1, "tid": 1,
+                          "ts": 0.0}]}))
+    assert any("unknown phase" in p for p in validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "Z", "pid": 1, "tid": 1,
+                          "ts": 0.0}]}))
+    assert any("missing keys" in p for p in validate_chrome_trace(
+        {"traceEvents": [{"ph": "i", "ts": 0.0}]}))
+    assert any("without matching B" in p for p in validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "E", "pid": 1, "tid": 1,
+                          "ts": 0.0}]}))
+    assert any("unclosed B" in p for p in validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "B", "pid": 1, "tid": 1,
+                          "ts": 0.0}]}))
+
+
+# ------------------------------------------------------- flight recorder
+def test_flight_recorder_dump_and_readback(tmp_path):
+    clk = TickClock()
+    sp = _lifecycle_ring()
+    reg = MetricsRegistry()
+    reg.gauge("Serve/queue_depth").set(2.0)
+    fr = FlightRecorder(tmp_path, spans=sp,
+                        snapshots={"serving": reg.snapshot}, clock=clk,
+                        job_name="t")
+    fr.note("watchdog_stall", step_s=0.5, threshold_s=0.05)
+    fr.on_request({"rid": 7, "status": "ok", "tokens": 9})
+    d = fr.dump("watchdog_stall")
+    rec = read_flight_record(d)
+    assert rec["manifest"]["reason"] == "watchdog_stall"
+    assert rec["manifest"]["events"] == len(sp.events())
+    assert rec["metrics"]["serving"]["gauges"]["Serve/queue_depth"] == 2.0
+    assert rec["requests"] == [{"rid": 7, "status": "ok", "tokens": 9}]
+    # the marker went into the SPAN ring (timeline shows the why in place)
+    assert any(e["kind"] == "marker"
+               and e["meta"]["name"] == "watchdog_stall"
+               for e in rec["events"])
+    assert validate_chrome_trace(rec["trace"]) == []
+    assert newest_flight_record(tmp_path) == d
+    assert newest_flight_record(tmp_path / "nope") is None
+
+
+def test_flight_recorder_dump_cap_and_no_spans(tmp_path):
+    fr = FlightRecorder(tmp_path, spans=None, max_dumps=2,
+                        clock=TickClock())
+    fr.note("manual_marker", k=1)          # lands in the internal ring
+    assert fr.dump("a") is not None
+    assert fr.dump("b") is not None
+    assert fr.dump("c") is None            # capped
+    dirs = [p for p in tmp_path.iterdir() if p.is_dir()]
+    assert len(dirs) == 2
+    rec = read_flight_record(fr.dumps[0])
+    assert [e["meta"]["name"] for e in rec["events"]] == ["manual_marker"]
+    # a broken snapshot provider degrades to an error entry, not a lost dump
+    fr2 = FlightRecorder(tmp_path / "p2", clock=TickClock(),
+                         snapshots={"boom": lambda: 1 / 0})
+    rec2 = read_flight_record(fr2.dump("x"))
+    assert "error" in rec2["metrics"]["boom"]
+    # numpy values in a snapshot must not crash the dump (it runs on the
+    # failure path): scalars via .item(), ARRAYS via .tolist() — .item()
+    # raises on size != 1
+    fr3 = FlightRecorder(tmp_path / "p3", clock=TickClock(),
+                         snapshots={"dev": lambda: {
+                             "per_device": np.array([1.5, 2.5]),
+                             "one": np.float32(3.5)}})
+    rec3 = read_flight_record(fr3.dump("np"))
+    assert rec3["metrics"]["dev"] == {"per_device": [1.5, 2.5], "one": 3.5}
+    # an unwritable dump dir (full/read-only disk) loses the dump, NOT the
+    # failure path that asked for it: no OSError out of the watchdog /
+    # nonfinite halt / SIGTERM handler
+    blocker = tmp_path / "blocked"
+    blocker.write_text("a file where the dump dir should go")
+    fr4 = FlightRecorder(blocker / "sub", clock=TickClock())
+    assert fr4.dump("stall") is None
+    assert fr4.dumps == []                     # budget not consumed either
+
+
+# ---------------------------------------------------------- SLO / anomaly
+def test_slo_scorer_burn_rates_and_edge_trigger(tmp_path):
+    reg = MetricsRegistry()
+    fr = FlightRecorder(tmp_path, clock=TickClock())
+    cfg = SLOConfig(ttft_p99_s=0.1, tpot_p99_s=0.01, error_rate=0.05)
+    scorer = SLOScorer(cfg, reg, flight=fr)
+    empty = scorer.score()                 # empty window: NaN burns, no
+    assert set(empty) == {"ttft", "tpot", "error"}        # violations
+    assert all(math.isnan(v) for v in empty.values())
+    assert "Serve/slo_violations" not in reg.snapshot()["counters"]
+    for _ in range(20):
+        reg.histogram("Serve/ttft_s").observe(0.05)     # within budget
+        reg.histogram("Serve/tpot_s").observe(0.02)     # 2x over
+    reg.counter("Serve/retired").inc(98)
+    reg.counter("Serve/timeout").inc(1)
+    reg.counter("Serve/nonfinite").inc(1)
+    burns = scorer.score()
+    assert burns["ttft"] == pytest.approx(0.5)
+    assert burns["tpot"] == pytest.approx(2.0)
+    assert burns["error"] == pytest.approx(0.02 / 0.05)
+    snap = reg.snapshot()
+    assert snap["gauges"]["Serve/slo_tpot_burn"] == pytest.approx(2.0)
+    assert snap["counters"]["Serve/slo_violations"] == 1   # tpot only
+    scorer.score()                                         # still breached
+    assert reg.snapshot()["counters"]["Serve/slo_violations"] == 1
+    # the breach left a why-marker for the flight dump
+    rec = read_flight_record(fr.dump("t"))
+    assert any(e["meta"].get("name") == "slo_tpot_breach"
+               for e in rec["events"])
+    # error burn is windowed over recent score() passes: once the bad
+    # passes age out, healthy traffic brings the rate back to zero —
+    # lifetime counters would pin the burn above zero forever
+    for _ in range(SLOScorer.ERROR_WINDOW_SCORES):
+        reg.counter("Serve/retired").inc(10)
+        burns = scorer.score()
+    assert burns["error"] == 0.0
+    with pytest.raises(ValueError, match="unknown slo"):
+        SLOConfig.from_any({"ttft_p99": 1.0})
+    with pytest.raises(ValueError, match="error_rate"):
+        SLOConfig(error_rate=1.5)
+
+
+def test_median_mad_detector():
+    det = MedianMADDetector(k=6.0, window=32, min_samples=8)
+    assert det.enabled
+    fired = [det.observe(v) for v in [0.1] * 16]
+    assert not any(fired)                      # steady baseline
+    assert det.observe(1.0)                    # 10x: regression
+    # the outlier did NOT poison the window — the next normal step is fine
+    assert not det.observe(0.1)
+    assert det.observe(1.0)
+    assert det.fired == 2
+    med, mad = det.stats()
+    assert med == pytest.approx(0.1)
+    assert not MedianMADDetector(k=0.0).observe(100.0)     # disabled
+    # a PERSISTENT shift is adopted as the new regime instead of firing
+    # one marker per step forever
+    det = MedianMADDetector(k=6.0, window=32, min_samples=8)
+    for v in [0.1] * 16:
+        det.observe(v)
+    fired = [det.observe(1.0) for _ in range(det.REGIME_SHIFT_FIRES + 16)]
+    assert sum(fired) == det.REGIME_SHIFT_FIRES
+    assert not any(fired[det.REGIME_SHIFT_FIRES:])
+    assert not det.observe(1.0)                # new baseline adopted
+
+
+def test_compile_storm_detector():
+    det = CompileStormDetector(threshold=2, window=8, grace=10)
+    # warmup grace: early compiles never fire
+    assert det.update(0, 3) == 0 and det.update(5, 6) == 0
+    for i in range(10, 20):
+        assert det.update(i, 6) == 0           # steady: no new programs
+    assert det.update(20, 10) == 4             # 4 new inside the window
+    assert det.update(21, 10) == 0             # edge-triggered
+    assert det.fired == 1
+    assert not CompileStormDetector(threshold=0).enabled
+    # warmup compiles just BEFORE the grace boundary must not leak into
+    # the first post-grace trailing window as a false storm
+    det = CompileStormDetector(threshold=3, window=32, grace=64)
+    for i in range(0, 61, 5):
+        det.update(i, i // 5)                  # 12 legit warmup compiles
+    assert det.update(64, 13) == 0 and det.fired == 0
+    assert det.update(70, 13) == 0             # steady after grace
+    assert det.update(75, 20) == 7             # a REAL post-grace storm
+
+
+# ------------------------------------------------ sink satellites (PR 5)
+def test_jsonl_sink_rotation(tmp_path):
+    sink = JsonlSink({"output_path": str(tmp_path), "job_name": "job",
+                      "flush_every": 1, "rotate_mb": 0.0005},   # ~524 bytes
+                     clock=lambda: 1.25)
+    for step in range(40):
+        sink.write_events([("Train/loss", 1.0, step)])
+    sink.close()
+    rolled = tmp_path / "job.jsonl.1"
+    assert rolled.exists() and sink.rotations >= 2
+    # every line in both kept generations parses; no torn records (the
+    # roll happens at flush boundaries only), and the retained window is
+    # the most recent — older generations age out by design (one backup)
+    recs = [json.loads(ln) for p in (rolled, tmp_path / "job.jsonl")
+            for ln in p.read_text().splitlines()]
+    assert 0 < len(recs) < 40
+    assert all(r["name"] == "Train/loss" and r["time"] == 1.25
+               for r in recs)
+    assert [r["step"] for r in recs] == \
+        list(range(40 - len(recs), 40))        # contiguous newest window
+    assert (tmp_path / "job.jsonl").stat().st_size <= 524 + 60
+    # default: no rotation (unbounded append, the pre-satellite behavior)
+    sink2 = JsonlSink({"output_path": str(tmp_path), "job_name": "j2",
+                       "flush_every": 1})
+    for step in range(40):
+        sink2.write_events([("Train/loss", 1.0, step)])
+    sink2.close()
+    assert not (tmp_path / "j2.jsonl.1").exists()
+    # flush_every=0 ("rely on close()") must not defeat rotate_mb: the
+    # size check triggers the flush-and-roll even when nothing else
+    # flushes, so a standalone sink stays bounded
+    sink3 = JsonlSink({"output_path": str(tmp_path), "job_name": "j3",
+                       "flush_every": 0, "rotate_mb": 0.0005},
+                      clock=lambda: 1.25)
+    for step in range(40):
+        sink3.write_events([("Train/loss", 1.0, step)])
+    sink3.close()
+    assert (tmp_path / "j3.jsonl.1").exists() and sink3.rotations >= 1
+    assert (tmp_path / "j3.jsonl").stat().st_size <= 524 + 60
+
+
+def test_prometheus_sink_help_lines_and_nonfinite(tmp_path):
+    sink = PrometheusTextfileSink({"output_path": str(tmp_path),
+                                   "job_name": "job"})
+    sink.write_events([("Train/loss", float("nan"), 1),
+                       ("Serve/burn", float("inf"), 1),
+                       ("Serve/floor", float("-inf"), 1),
+                       ("Serve/ok", 0.5, 1)])
+    sink.close()
+    text = (tmp_path / "job.prom").read_text()
+    # exposition format: HELP before TYPE, non-finite spelled exactly
+    assert "# HELP dstpu_train_loss" in text
+    assert text.index("# HELP dstpu_serve_ok") \
+        < text.index("# TYPE dstpu_serve_ok")
+    assert "dstpu_train_loss NaN" in text
+    assert "dstpu_serve_burn +Inf" in text
+    assert "dstpu_serve_floor -Inf" in text
+    assert "nan" not in text.split("NaN")[0]   # no lowercase leakage
+    parsed = parse_prometheus_textfile(text)   # round-trips
+    assert math.isnan(parsed["dstpu_train_loss"])
+    assert parsed["dstpu_serve_burn"] == math.inf
+    assert parsed["dstpu_serve_floor"] == -math.inf
+    assert parsed["dstpu_serve_ok"] == 0.5
+
+
+def test_serving_stats_queue_wait_histogram():
+    from deepspeed_tpu.observability import ServingStats
+
+    clk = TickClock(dt=1.0)
+    stats = ServingStats(clock=clk)
+    t_submit = stats.on_submit(queue_depth=1)      # t=1
+    stats.on_admit(queue_depth=0, submit_t=t_submit)   # t=2: wait 1s
+    snap = stats.snapshot()
+    assert snap["queue_wait_s"]["count"] == 1
+    assert snap["queue_wait_s"]["p50"] == pytest.approx(1.0)
+    # admit without submit_t (legacy callers) records no wait sample
+    stats.on_admit(queue_depth=0)
+    assert stats.snapshot()["queue_wait_s"]["count"] == 1
+
+
+def test_request_log_sink(tmp_path):
+    sink = RequestLogSink({"output_path": str(tmp_path), "job_name": "s",
+                           "flush_every": 1})
+    sink.write_events([("Serve/x", 1.0, 1)])       # scalar events: dropped
+    sink.log_request({"rid": 3, "status": "ok", "tokens": 5})
+    sink.close()
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "s.requests.jsonl").read_text().splitlines()]
+    assert rows == [{"rid": 3, "status": "ok", "tokens": 5}]
+    # it IS a JsonlSink: rotate_mb bounds the per-request log the same
+    # way it bounds the event log ("same config shape" means it)
+    sink = RequestLogSink({"output_path": str(tmp_path), "job_name": "r",
+                           "flush_every": 1, "rotate_mb": 0.0005})
+    for rid in range(40):
+        sink.log_request({"rid": rid, "status": "ok", "tokens": 5})
+    sink.close()
+    assert (tmp_path / "r.requests.jsonl.1").exists()
+    assert sink.rotations >= 1
+    kept = [json.loads(ln)["rid"]
+            for p in (tmp_path / "r.requests.jsonl.1",
+                      tmp_path / "r.requests.jsonl")
+            for ln in p.read_text().splitlines()]
+    assert kept == list(range(40 - len(kept), 40))   # newest window, no tears
+
+
+# -------------------------------------------- serving spans: cost parity
+def test_serving_spans_add_no_programs_and_keep_outputs():
+    """Spans enabled = the same compiled-program set and bit-identical
+    tokens as spans disabled (the ring is host-side bookkeeping only);
+    the ring carries the full lifecycle for the requests served."""
+    import jax.numpy as jnp
+
+    cfg = tiny_test(max_seq=64, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ds.init_inference(model, params, {"dtype": "float32"})
+    scfg = {"slots": 2, "max_len": 48, "prefill_chunk": 16,
+            "temperature": 0.8, "top_k": 20}
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 256, (9,)).astype(np.int32)
+               for _ in range(4)]
+    plain = ds.ServingEngine(eng, scfg)
+    base = plain.serve_batch(prompts, 6, seeds=list(range(4)))
+    spanned = ds.ServingEngine(eng, {**scfg, "spans": True})
+    got = spanned.serve_batch(prompts, 6, seeds=list(range(4)))
+    assert spanned.compiles == plain.compiles      # zero new programs
+    for w, g in zip(base, got):
+        np.testing.assert_array_equal(w, g)        # bit-identical tokens
+    kinds = {e.kind for e in spanned.spans.events()}
+    assert {"queued", "prefill_chunk", "placed", "decode", "retired",
+            "decode_step", "occupancy"} <= kinds
+    rids = {e.rid for e in spanned.spans.events() if e.rid is not None}
+    assert rids == {0, 1, 2, 3}
+    trace = to_chrome_trace(spanned.spans.events())
+    assert validate_chrome_trace(trace) == []
+
+
+# ----------------------------------------------------------- doctor CLI
+def test_doctor_cli_reports_from_files(tmp_path, capsys):
+    """The triage CLI reads files alone: latest .prom, request log, and
+    newest flight record — no engine, no device."""
+    from deepspeed_tpu.observability import doctor
+
+    sink = PrometheusTextfileSink({"output_path": str(tmp_path),
+                                   "job_name": "job"})
+    sink.write_events([("Serve/goodput_tps", 123.0, 9),
+                       ("Serve/slo_ttft_burn", float("inf"), 9)])
+    sink.close()
+    rlog = RequestLogSink({"output_path": str(tmp_path), "job_name": "job",
+                           "flush_every": 1})
+    rlog.log_request({"rid": 1, "status": "ok", "tokens": 5,
+                      "ttft_s": 0.01, "queue_wait_s": 0.002})
+    rlog.log_request({"rid": 2, "status": "timeout", "tokens": 1,
+                      "ttft_s": None, "queue_wait_s": None,
+                      "error": "ttft deadline expired in queue"})
+    rlog.close()
+    fr = FlightRecorder(tmp_path, spans=_lifecycle_ring(),
+                        clock=TickClock())
+    fr.note("watchdog_stall", step_s=0.7)
+    fr.dump("watchdog_stall")
+    assert doctor.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "dstpu_serve_goodput_tps" in out and "123" in out
+    assert "+Inf" in out
+    assert "ok=1" in out and "timeout=1" in out
+    assert "rid=2" in out and "ttft deadline expired" in out
+    assert "reason=watchdog_stall" in out
+    assert "marker" in out and "slowest spans" in out
+    assert "perfetto" in out
+    # empty directory: reports absence, still exits 0
+    assert doctor.main(["--dir", str(tmp_path / "empty")]) == 0
+    out = capsys.readouterr().out
+    assert "no *.prom" in out and "no flight_*" in out
+    # torn artifacts — the state an UNCLEAN death leaves (os._exit mid
+    # write, SIGKILL before flush) — must degrade, not crash the triage:
+    # a half-written trailing request record and a torn flight events line
+    with open(tmp_path / "job.requests.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"rid": 3, "status": "o')            # no newline: torn
+    fdir = newest_flight_record(tmp_path)
+    with open(fdir / "events.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"kind": "marker", "t0"')
+    assert doctor.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "1 torn line(s) skipped" in out
+    assert "ok=1" in out                               # intact rows kept
+    assert read_flight_record(fdir)["torn_lines"] == 1
+
+
 # --------------------------------------------------- tier-1 subsystem smoke
 def test_train_and_generate_all_sinks_smoke(tmp_path):
     """One train step + one generate() with every machine-readable sink
@@ -361,8 +797,9 @@ def test_train_and_generate_all_sinks_smoke(tmp_path):
     engine = ds.initialize({
         "train_batch_size": 8,
         "steps_per_print": 1,
+        "wall_clock_breakdown": True,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-        "observability": {"hbm_watermark": True},
+        "observability": {"hbm_watermark": True, "spans": True},
         "monitor": {
             "csv_monitor": {"enabled": True,
                             "output_path": str(tmp_path / "csv")},
@@ -381,6 +818,15 @@ def test_train_and_generate_all_sinks_smoke(tmp_path):
     assert "Train/samples_per_sec" in snap["gauges"]
     assert "Memory/bytes_in_use" in snap["gauges"]
     assert snap["histograms"]["Train/step_time_s"]["count"] == 1
+
+    # training spans: one train_step span + the wall-clock-breakdown
+    # timer windows re-emitted as phase spans, export schema-valid
+    evs = engine.spans.events()
+    assert [e.step for e in evs if e.kind == "train_step"] == [1]
+    phases = {e.meta["phase"] for e in evs if e.kind == "train_phase"}
+    assert {"batch_prep", "step_dispatch", "step_sync"} <= phases
+    assert all(e.duration >= 0 for e in evs)
+    assert validate_chrome_trace(to_chrome_trace(evs)) == []
 
     recs = [json.loads(ln) for ln in
             (tmp_path / "DeepSpeedTpuJob.jsonl").read_text().splitlines()]

@@ -323,6 +323,7 @@ engine = ds.initialize({
     "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
     "checkpoint": {"verify": "checksum", "async_save": phase == "preempt"},
     "resilience": {"resume": "auto", "resume_dir": ckpt},
+    "observability": {"flight_dir": os.path.join(ckpt, "flight")},
     "seed": 3,
 }, build_model(tiny_test()))
 print(f"PHASE={phase} resumed_step={engine.global_steps}", flush=True)
@@ -390,10 +391,201 @@ def test_crash_mid_commit_then_preempt_then_resume(tmp_path):
     assert "UNREACHABLE" not in p.stdout
     assert (ckpt / "latest").read_text().strip() == "global_step5"
     assert verify_tag(ckpt / "global_step5", "checksum")[0] == "verified"
+    # the PreemptionGuard left the black box next to the checkpoint
+    from deepspeed_tpu.observability import (newest_flight_record,
+                                             read_flight_record)
+
+    fdir = newest_flight_record(ckpt / "flight")
+    assert fdir is not None and fdir.name.endswith("preemption")
+    frec = read_flight_record(fdir)
+    assert frec["manifest"]["reason"] == "preemption"
+    assert any(e["meta"].get("name") == "preemption_sigterm"
+               for e in frec["events"])
 
     p = run("verify")
     assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
     assert "VERIFY_OK" in p.stdout
+
+
+# --------------------------------------- flight recorder (PR 5 tentpole)
+from _fake_clock import TickClock    # noqa: E402  (shared test helper)
+
+
+def test_chaos_hung_step_produces_flight_record(tmp_path):
+    """The acceptance chain, fully fake-clocked: submit → chaos-hung step
+    → watchdog → flight dump → the exported Perfetto timeline is
+    schema-valid and SHOWS the stall gap (a decode_step span as long as
+    the injected hang, plus the watchdog why-marker)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import build_model, tiny_test
+    from deepspeed_tpu.observability import (newest_flight_record,
+                                             read_flight_record,
+                                             validate_chrome_trace)
+
+    cfg = tiny_test(max_seq=64, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ds.init_inference(model, params,
+                            {"dtype": "float32", "eos_token_id": 7})
+    clk = TickClock()
+    hang_s = 0.5
+    srv = ds.ServingEngine(eng, {
+        "slots": 2, "max_len": 48, "prefill_chunk": 16,
+        "temperature": 0.8, "top_k": 20,
+        "spans": True, "flight_dir": str(tmp_path / "flight"),
+        "watchdog_s": 0.05,
+        "chaos": {"enabled": True, "seed": 1, "hang_iteration": 3,
+                  "hang_seconds": hang_s},
+    }, clock=clk)
+    # fake time end-to-end: the chaos hang advances the SAME clock the
+    # watchdog and the spans read — no real sleeping, no wall-clock race
+    srv.chaos.sleep = clk.advance
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        srv.submit(rng.integers(0, 256, (9,)).astype(np.int32), 6,
+                   seed=100 + i)
+    srv.drain()
+    assert [i for i in srv.chaos.injected if i["point"] == "hang"]
+    snap = srv.metrics_snapshot()
+    assert snap["watchdog_stalls"] >= 1 and snap["retired"] == 4
+
+    d = newest_flight_record(tmp_path / "flight")
+    assert d is not None, "watchdog stall did not dump a flight record"
+    rec = read_flight_record(d)
+    assert rec["manifest"]["reason"] == "watchdog_stall"
+    # the why-marker carries the measured stall
+    stall_markers = [e for e in rec["events"] if e["kind"] == "marker"
+                     and e["meta"].get("name") == "watchdog_stall"]
+    assert stall_markers and \
+        stall_markers[0]["meta"]["step_s"] >= hang_s
+    # the export is schema-valid Perfetto input…
+    assert validate_chrome_trace(rec["trace"]) == []
+    # …and the timeline shows the stall gap: one decode_step span at
+    # least as long as the injected hang (µs in the trace)
+    step_spans = [e for e in rec["trace"]["traceEvents"]
+                  if e.get("name") == "decode_step"]
+    assert step_spans, "no decode_step spans in the exported timeline"
+    assert max(e["dur"] for e in step_spans) >= hang_s * 1e6
+    # the engine ring kept serving after the dump: full lifecycle present
+    kinds = {e.kind for e in srv.spans.events()}
+    assert {"queued", "prefill_chunk", "placed", "decode", "retired",
+            "decode_step", "occupancy", "marker"} <= kinds
+
+
+def test_watchdog_stall_storm_dumps_once_per_episode(tmp_path):
+    """A stall STORM (threshold set below every step's duration) takes ONE
+    flight dump for the whole episode — per-iteration dumps would burn the
+    max_dumps budget the terminal post-mortem (SIGTERM, nonfinite halt)
+    needs, and pay dump I/O inside an already-stalling loop. Every stall
+    still writes its why-marker and bumps the stall counter."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    cfg = tiny_test(max_seq=64, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ds.init_inference(model, params,
+                            {"dtype": "float32", "eos_token_id": 7})
+    clk = TickClock()
+    srv = ds.ServingEngine(eng, {
+        "slots": 2, "max_len": 48, "prefill_chunk": 16,
+        "spans": True, "flight_dir": str(tmp_path / "flight"),
+        # below one TickClock dt: EVERY decode step "stalls"
+        "watchdog_s": 1e-5,
+    }, clock=clk)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        srv.submit(rng.integers(0, 256, (9,)).astype(np.int32), 6,
+                   seed=100 + i)
+    srv.drain()
+    snap = srv.metrics_snapshot()
+    assert snap["watchdog_stalls"] > 1          # a real storm…
+    assert len(srv.flight.dumps) == 1           # …one dump (rising edge)
+
+
+def test_nonfinite_halt_dumps_flight_record(tmp_path, train_engine):
+    """The training sentinel's halt freezes the black box before raising
+    (wired in _note_bad_steps) — the dump names the collapse."""
+    from deepspeed_tpu.observability import (FlightRecorder,
+                                             read_flight_record)
+
+    eng = train_engine
+    prev = eng._max_bad_steps, eng._bad_step_streak, eng.flight
+    try:
+        eng._max_bad_steps, eng._bad_step_streak = 2, 0
+        eng.flight = FlightRecorder(tmp_path, spans=eng.spans,
+                                    snapshots={"train": eng.metrics_snapshot})
+        with pytest.raises(NonFiniteLossError):
+            eng._note_bad_steps(True, 2, float("nan"))
+        assert len(eng.flight.dumps) == 1
+        rec = read_flight_record(eng.flight.dumps[0])
+        assert rec["manifest"]["reason"] == "nonfinite_halt"
+        halt = [e for e in rec["events"]
+                if e["meta"].get("name") == "nonfinite_halt"]
+        assert halt and halt[0]["meta"]["streak"] == 2
+        assert "train" in rec["metrics"]
+    finally:
+        eng._max_bad_steps, eng._bad_step_streak, eng.flight = prev
+
+
+def test_serving_request_log_and_flight_requests(tmp_path):
+    """attach_monitor wires the MonitorMaster request-log sink: every
+    retired request lands as one JSON record (status + timing attribution
+    included), and the flight recorder keeps the recent ones."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.config import Config
+    from deepspeed_tpu.models import build_model, tiny_test
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    cfg = tiny_test(max_seq=64, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ds.init_inference(model, params,
+                            {"dtype": "float32", "eos_token_id": 7})
+    mon = MonitorMaster(Config(**{"monitor": {
+        "request_log": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "serve", "flush_every": 1},
+        "prometheus": {"enabled": True, "output_path": str(tmp_path),
+                       "job_name": "serve"},
+    }}).monitor)
+    srv = ds.ServingEngine(eng, {
+        "slots": 2, "max_len": 48, "prefill_chunk": 16,
+        "temperature": 0.8, "top_k": 20,
+        "flight_dir": str(tmp_path / "flight"),
+        "slo": {"ttft_p99_s": 1e-9},       # impossibly tight: must burn
+    }, clock=TickClock())
+    srv.attach_monitor(mon)
+    rng = np.random.default_rng(1)
+    rids = [srv.submit(rng.integers(0, 256, (9,)).astype(np.int32), 5,
+                       seed=i) for i in range(3)]
+    srv.drain()
+    srv.publish_metrics(mon)               # scores SLO + flushes sinks
+    mon.close()
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "serve.requests.jsonl").read_text().splitlines()]
+    assert sorted(r["rid"] for r in rows) == sorted(rids)
+    for r in rows:
+        assert r["status"] == "ok" and r["tokens"] == 5
+        assert r["ttft_s"] > 0 and r["queue_wait_s"] is not None
+    # SLO burn gauges rode the same flush into the textfile
+    from deepspeed_tpu.observability import parse_prometheus_textfile
+
+    prom = parse_prometheus_textfile(
+        (tmp_path / "serve.prom").read_text())
+    assert prom["dstpu_serve_slo_ttft_burn"] > 1.0
+    assert prom["dstpu_serve_slo_violations"] == 1.0
+    assert prom["dstpu_serve_queue_wait_s_p50"] > 0
+    # the flight black box kept the same records
+    d = srv.dump_flight("unit")
+    from deepspeed_tpu.observability import read_flight_record
+
+    assert len(read_flight_record(d)["requests"]) == 3
 
 
 # ------------------------------------------------------------- chaos smoke
