@@ -78,3 +78,15 @@ def collective_summary(compiled_or_text: Any) -> dict[str, dict[str, float]]:
 
 def total_collective_mbytes(compiled_or_text: Any) -> float:
     return sum(d["mbytes"] for d in collective_summary(compiled_or_text).values())
+
+
+def collective_totals(compiled_or_text: Any) -> dict[str, float]:
+    """One-row reduction of :func:`collective_summary` — ``{count,
+    mbytes}`` over every collective kind. The per-program row shape the
+    capacity census (``observability/capacity.py``) registers for each
+    compiled program, so per-program wire bytes can be ranked against
+    per-program HBM bytes."""
+    per_kind = collective_summary(compiled_or_text)
+    return {"count": sum(d["count"] for d in per_kind.values()),
+            "mbytes": sum(d["mbytes"] for d in per_kind.values()),
+            "by_kind": per_kind}

@@ -77,6 +77,13 @@ class ServingConfig:
     # decode-step regression detector, and a compile-storm detector.
     # None = no scoring machinery built.
     slo: "object | None" = None
+    # Traffic analytics on the admission path
+    # (observability.workload.WorkloadConfig | dict): prefix-overlap /
+    # self-speculation estimators + shape histograms into
+    # Serve/workload_*, feeding the capacity advisor
+    # (observability/capacity.py). Host-side only — zero new compiled
+    # programs, zero device syncs. None = no analyzer built.
+    workload: "object | None" = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -103,6 +110,10 @@ class ServingConfig:
             from ..observability.slo import SLOConfig
 
             self.slo = SLOConfig.from_any(self.slo)
+        if self.workload is not None:
+            from ..observability.workload import WorkloadConfig
+
+            self.workload = WorkloadConfig.from_any(self.workload)
 
     @classmethod
     def from_any(cls, cfg: "ServingConfig | dict | None") -> "ServingConfig":

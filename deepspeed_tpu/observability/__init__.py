@@ -1,11 +1,16 @@
 """Serving & training observability: metrics core, request tracing,
 lifecycle spans, flight recorder, Perfetto export, SLO/anomaly
-detection, machine-readable sinks, and XLA profiler integration.
+detection, workload/capacity attribution (traffic analytics, HBM
+ledger, per-program cost census, capacity advisor), machine-readable
+sinks, and XLA profiler integration.
 
 See ``docs/OBSERVABILITY.md`` for the metric namespace and runbook, and
 ``python -m deepspeed_tpu.observability.doctor`` for file-based triage.
 """
 
+from .capacity import (ProgramCensus, capacity_report, hbm_ledger,
+                       kv_cache_bytes, validate_capacity_report,
+                       write_capacity_report)
 from .export import (RequestLogSink, request_record, to_chrome_trace,
                      validate_chrome_trace, write_chrome_trace)
 from .flight import (FlightRecorder, newest_flight_record,
@@ -19,6 +24,7 @@ from .slo import (CompileStormDetector, MedianMADDetector, SLOConfig,
                   SLOScorer)
 from .spans import SpanEvent, SpanRecorder
 from .tracing import RequestRecord, RequestTracer, ServingStats
+from .workload import WorkloadAnalyzer, WorkloadConfig
 from .xla import TraceWindow, sample_memory
 
 __all__ = [
@@ -32,5 +38,8 @@ __all__ = [
     "RequestLogSink", "request_record", "to_chrome_trace",
     "validate_chrome_trace", "write_chrome_trace",
     "SLOConfig", "SLOScorer", "MedianMADDetector", "CompileStormDetector",
+    "WorkloadAnalyzer", "WorkloadConfig",
+    "ProgramCensus", "hbm_ledger", "kv_cache_bytes", "capacity_report",
+    "validate_capacity_report", "write_capacity_report",
     "TraceWindow", "sample_memory",
 ]

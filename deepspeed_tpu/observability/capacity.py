@@ -1,0 +1,502 @@
+"""Capacity attribution: HBM ledger, per-program cost census, advisor.
+
+ZeRO-Infinity's memory-wall analysis starts from an explicit
+per-component byte ledger, and EQuARX motivates quantized collectives
+from measured per-program collective-byte attribution; this module is
+that measurement substrate for the serving/training stack, composed from
+three pieces:
+
+- :func:`hbm_ledger` — the live HBM budget decomposed into weights
+  (WOQ/dtype-aware), KV cache (from the cache layout the slot engine
+  actually allocates), and per-program temp/peak (from the compiler's own
+  ``memory_analysis``), with projected headroom (max slots / max context
+  at the current config) as ``Memory/ledger_*`` gauges.
+- :class:`ProgramCensus` — a registry over the engines' bounded compiled
+  program set: static FLOPs / HBM bytes (``compiled_cost_analysis``) and
+  collective bytes (``comm.hlo_analysis``) per program, joined against
+  achieved per-program wall time from the PR-5 span ring to produce
+  achieved-vs-roofline MBU/MFU attribution per program.
+- :func:`capacity_report` — the advisor: composes workload analytics
+  (``workload.py``), the ledger, and the census into what-if estimates on
+  the *observed* traffic (prefill tokens prefix sharing would have saved,
+  the decode-step speedup bound from int8 KV bytes, the collective-byte
+  share of the step) and ranks the roadmap levers by measured payoff.
+  Emitted as ``CAPACITY_REPORT.json`` and a ``doctor`` section.
+
+Degradation contract (pinned by tier-1 tests): every compiler analysis
+(``cost_analysis`` / ``memory_analysis``) is best-effort per backend —
+on a backend that doesn't implement one, the census and ledger keep
+every field PRESENT with ``None`` values and warn once; they never
+raise. A capacity report from a CPU smoke run is partial, not absent.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+from ..utils.logging import warning_once
+from .metrics import MetricsRegistry, Reservoir
+
+CAPACITY_SCHEMA = "dstpu-capacity-report/v1"
+
+# Advisor lever names, in the order the smoke bench asserts on.
+LEVER_PREFIX = "prefix_sharing"
+LEVER_KV_QUANT = "kv_quantization"
+LEVER_COLLECTIVES = "quantized_collectives"
+LEVER_SPECULATION = "speculative_decoding"
+
+
+def roofline_peaks(device=None) -> tuple:
+    """``(peak_flops, peak_hbm_bw)`` for ``device`` (default: device 0),
+    ``None`` where the chip is unknown to the peak tables — census rows
+    then degrade their MFU/MBU fields to null. The one shared probe both
+    engines' census entry points use."""
+    import jax
+
+    from ..utils.timer import peak_flops_for, peak_hbm_bw_for
+
+    if device is None:
+        device = jax.devices()[0]
+    out = []
+    for fn in (peak_flops_for, peak_hbm_bw_for):
+        try:
+            out.append(fn(device))
+        except ValueError:
+            out.append(None)
+    return tuple(out)
+
+
+# ------------------------------------------------------------------ ledger
+def kv_cache_bytes(model_cfg, slots: int, max_len: int, dtype) -> dict:
+    """KV-cache byte breakdown for the slot engine's ONE persistent cache,
+    from the same :func:`~..inference.decode.cache_layout` the allocator
+    uses (k + v buffers)."""
+    import jax.numpy as jnp
+
+    from ..inference.decode import cache_layout
+
+    shape, dt = cache_layout(model_cfg, slots, max_len, dtype)
+    itemsize = jnp.dtype(dt).itemsize
+    total = 2 * int(math.prod(shape)) * itemsize
+    per_slot = total // slots
+    return {"total_bytes": total, "per_slot_bytes": per_slot,
+            "per_token_bytes": per_slot // max_len,
+            "itemsize": itemsize, "slots": slots, "max_len": max_len,
+            "shape": list(shape), "dtype": str(jnp.dtype(dt))}
+
+
+def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
+               cache_dtype, temp_bytes: Optional[int] = None,
+               limit_bytes: Optional[int] = None,
+               registry: Optional[MetricsRegistry] = None) -> dict:
+    """Decompose the HBM budget of a serving config into its components.
+
+    ``params`` is the engine's (possibly WOQ-quantized) tree — weights
+    count their *resident* bytes (int8/int4 + scales for quantized
+    leaves) plus the per-decode-step streamed-bytes model the MBU gauges
+    already use. ``temp_bytes`` is the largest per-program temp
+    allocation the census measured (None = unknown on this backend).
+    ``limit_bytes`` defaults to the accelerator's reported HBM limit
+    (None when the platform doesn't report one, e.g. CPU). Every field is
+    always present; unknown values are None."""
+    from ..inference.quantization import decode_weight_bytes, quantized_bytes
+
+    weights = int(quantized_bytes(params))
+    stream = int(decode_weight_bytes(params))
+    kv = kv_cache_bytes(model_cfg, slots, max_len, cache_dtype)
+    if limit_bytes is None:
+        from ..platform.accelerator import get_accelerator
+
+        limit_bytes = int(get_accelerator().memory_stats().bytes_limit) \
+            or None
+    known = weights + kv["total_bytes"] + (temp_bytes or 0)
+    out = {
+        "weights_bytes": weights,
+        "weights_stream_bytes_per_step": stream,
+        "kv_bytes": kv["total_bytes"],
+        "kv_per_slot_bytes": kv["per_slot_bytes"],
+        "kv_per_token_bytes": kv["per_token_bytes"],
+        "cache_itemsize": kv["itemsize"],
+        "cache_dtype": kv["dtype"],
+        "slots": slots,
+        "max_len": max_len,
+        "temp_bytes": temp_bytes,
+        "total_bytes": known,
+        "limit_bytes": limit_bytes,
+        "headroom_bytes": None,
+        "projected_max_slots": None,
+        "projected_max_context": None,
+    }
+    if limit_bytes:
+        free_for_kv = limit_bytes - weights - (temp_bytes or 0)
+        out["headroom_bytes"] = limit_bytes - known
+        if kv["per_slot_bytes"] > 0:
+            out["projected_max_slots"] = max(
+                0, free_for_kv // kv["per_slot_bytes"])
+        if kv["per_token_bytes"] > 0 and slots > 0:
+            out["projected_max_context"] = max(
+                0, free_for_kv // (kv["per_token_bytes"] * slots))
+    if registry is not None:
+        for k, v in out.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                registry.gauge(f"Memory/ledger_{k}").set(float(v))
+    return out
+
+
+# ------------------------------------------------------------------ census
+_CENSUS_STATIC_FIELDS = ("flops", "bytes_accessed", "collective_mbytes",
+                         "collective_count", "collectives", "temp_bytes",
+                         "peak_bytes")
+
+
+class ProgramCensus:
+    """Static cost + achieved wall time per compiled program.
+
+    ``measure(name, jitted, *args)`` AOT-lowers/compiles the program
+    (ShapeDtypeStruct args make this device-memory-free) and records the
+    compiler's FLOPs / bytes-accessed, the HLO collective census, and the
+    buffer-assignment temp/peak. ``observe_wall`` / ``attach_spans`` feed
+    achieved per-call wall times (the serving span ring's ``decode_step``
+    and ``prefill_chunk`` spans, the training ``train_step`` spans), and
+    ``report()`` joins the two into per-program achieved-vs-roofline
+    MBU/MFU. Analyses that a backend doesn't support leave their fields
+    None (one warning, never a raise)."""
+
+    def __init__(self, peak_flops: Optional[float] = None,
+                 peak_bw: Optional[float] = None):
+        self.peak_flops = peak_flops
+        self.peak_bw = peak_bw
+        self._static: dict[str, dict] = {}
+        self._wall: dict[str, Reservoir] = {}
+        self._calls: dict[str, int] = {}
+
+    # ----------------------------------------------------------- static side
+    def measure(self, name: str, jitted, *args, mesh=None, **kwargs) -> dict:
+        """Record the static costs of one program; returns the row."""
+        from ..comm.hlo_analysis import collective_totals
+        from ..profiling.flops_profiler import (compiled_cost_analysis,
+                                                compiled_memory_analysis)
+
+        row: dict[str, Any] = {k: None for k in _CENSUS_STATIC_FIELDS}
+        compiled = None
+        try:
+            lowered = jitted
+            if hasattr(lowered, "lower"):
+                if mesh is not None:
+                    with mesh:
+                        lowered = lowered.lower(*args, **kwargs)
+                else:
+                    lowered = lowered.lower(*args, **kwargs)
+            compiled = lowered.compile() if hasattr(lowered, "compile") \
+                else lowered
+        except Exception as e:
+            warning_once(f"capacity census: lowering {name!r} for analysis "
+                         f"failed on this backend ({e!r}) — census row "
+                         "kept with null values")
+        if compiled is not None:
+            try:
+                cost = compiled_cost_analysis(compiled)
+                row["flops"] = _maybe_num(cost.get("flops"))
+                row["bytes_accessed"] = _maybe_num(cost.get("bytes accessed"))
+            except Exception as e:
+                warning_once("capacity census: cost_analysis unavailable on "
+                             f"this backend ({e!r}) — FLOPs/bytes fields "
+                             "stay null")
+            try:
+                mem = compiled_memory_analysis(compiled)
+                row["temp_bytes"] = mem.get("temp_size_in_bytes")
+                row["peak_bytes"] = mem.get(
+                    "peak_memory_in_bytes",
+                    _sum_or_none(mem, ("argument_size_in_bytes",
+                                       "output_size_in_bytes",
+                                       "temp_size_in_bytes")))
+            except Exception as e:
+                warning_once("capacity census: memory_analysis unavailable "
+                             f"on this backend ({e!r}) — temp/peak fields "
+                             "stay null")
+            try:
+                coll = collective_totals(compiled)
+                row["collective_mbytes"] = coll["mbytes"]
+                row["collective_count"] = int(coll["count"])
+                row["collectives"] = coll["by_kind"]
+            except Exception as e:
+                warning_once("capacity census: HLO text unavailable on this "
+                             f"backend ({e!r}) — collective fields stay "
+                             "null")
+        self._static[name] = row
+        return row
+
+    # --------------------------------------------------------- achieved side
+    def observe_wall(self, name: str, seconds: float) -> None:
+        r = self._wall.get(name)
+        if r is None:
+            r = self._wall[name] = Reservoir(1024)
+        r.add(float(seconds))
+        self._calls[name] = self._calls.get(name, 0) + 1
+
+    def attach_spans(self, events) -> int:
+        """Fold a span ring into per-program wall samples: ``decode_step``
+        spans belong to the slot decode program, ``prefill_chunk`` spans
+        to their ``chunk_<size>``/``final_<size>`` bucket program,
+        ``train_step`` spans to the train step. Returns samples taken."""
+        from . import spans as S
+
+        n = 0
+        for ev in events:
+            if ev.t1 is None:
+                continue
+            if ev.kind == S.DECODE_STEP:
+                name = "step"
+            elif ev.kind == S.PREFILL_CHUNK:
+                stem = "final" if ev.meta.get("final") else "chunk"
+                name = f"{stem}_{ev.meta.get('size')}"
+            elif ev.kind == S.TRAIN_STEP:
+                name = "train_step"
+            else:
+                continue
+            self.observe_wall(name, ev.duration)
+            n += 1
+        return n
+
+    # --------------------------------------------------------------- readout
+    def report(self) -> dict:
+        """Per-program rows, static + achieved joined. Programs with no
+        wall samples report static columns only (and vice versa)."""
+        rows: dict[str, dict] = {}
+        for name in sorted(set(self._static) | set(self._wall)):
+            row = dict(self._static.get(
+                name, {k: None for k in _CENSUS_STATIC_FIELDS}))
+            res = self._wall.get(name)
+            calls = self._calls.get(name, 0)
+            wall = res.percentile(50) if res is not None and len(res) \
+                else None
+            row.update({"calls": calls, "wall_s_p50": wall,
+                        "achieved_tflops": None, "mfu": None,
+                        "achieved_gbps": None, "mbu": None})
+            if wall:
+                if row["flops"]:
+                    ach = row["flops"] / wall
+                    row["achieved_tflops"] = ach / 1e12
+                    if self.peak_flops:
+                        row["mfu"] = ach / self.peak_flops
+                if row["bytes_accessed"]:
+                    gbs = row["bytes_accessed"] / wall
+                    row["achieved_gbps"] = gbs / 1e9
+                    if self.peak_bw:
+                        row["mbu"] = gbs / self.peak_bw
+            rows[name] = row
+        return {"programs": rows, "peak_flops": self.peak_flops,
+                "peak_hbm_bw": self.peak_bw}
+
+
+def _maybe_num(v):
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+def _sum_or_none(d: dict, keys) -> Optional[int]:
+    vals = [d.get(k) for k in keys]
+    if any(v is None for v in vals):
+        return None
+    return int(sum(vals))
+
+
+# ----------------------------------------------------------------- advisor
+def capacity_report(*, ledger: dict, census: Optional[dict] = None,
+                    workload: Optional[dict] = None,
+                    occupancy_avg: Optional[float] = None,
+                    meta: Optional[dict] = None) -> dict:
+    """Compose ledger + census + workload into the ranked what-if advisor.
+
+    Every lever's score is the estimated fraction of its bounding
+    resource it would save ON THE OBSERVED TRAFFIC — comparable across
+    levers, honest about what was actually measured (unmeasured inputs
+    degrade the lever to score 0 with a stated reason, they never
+    invent a payoff)."""
+    levers = []
+
+    # Prefix sharing: the measured shared-prefix fraction IS the fraction
+    # of prefill compute (and prefill KV writes) a radix prefix cache
+    # would have skipped on this traffic.
+    overlap = (workload or {}).get("prefix_overlap")
+    dedup = (workload or {}).get("dedupable_prefill_tokens")
+    levers.append({
+        "name": LEVER_PREFIX,
+        "score": float(overlap) if overlap is not None else 0.0,
+        "estimate": {"prefill_tokens_saved": dedup,
+                     "shared_prefix_fraction": overlap},
+        "why": ("measured shared-prefix token fraction of admitted "
+                "prompts — the prefill work a prefix cache skips"
+                if overlap is not None else
+                "no workload analytics measured (serving.workload off)"),
+    })
+
+    # int8 KV: decode is bandwidth-bound; the step's byte budget is the
+    # streamed weights + the live KV it reads. Quantizing KV to int8
+    # shrinks only the KV term — the bound is the byte ratio.
+    kv_score = 0.0
+    kv_est: dict[str, Any] = {"decode_step_speedup_bound": None,
+                              "kv_read_bytes_per_step": None}
+    itemsize = ledger.get("cache_itemsize")
+    stream = ledger.get("weights_stream_bytes_per_step")
+    per_tok = ledger.get("kv_per_token_bytes")
+    slots = ledger.get("slots") or 0
+    why_kv = "cache itemsize/weight-stream bytes unavailable"
+    if itemsize and stream and per_tok:
+        mean_ctx = _mean_context(workload, ledger)
+        occ = occupancy_avg if occupancy_avg is not None else 1.0
+        kv_read = per_tok * mean_ctx * occ * slots
+        # int8 keeps 1 byte/elem + per-head scales (small); bound by the
+        # pure byte ratio of the step's HBM traffic
+        quant_kv = kv_read / itemsize
+        bound = (stream + kv_read) / max(1.0, stream + quant_kv)
+        kv_score = 1.0 - 1.0 / bound
+        kv_est = {"decode_step_speedup_bound": bound,
+                  "kv_read_bytes_per_step": int(kv_read),
+                  "mean_context_tokens": mean_ctx,
+                  "occupancy_avg": occ}
+        why_kv = ("byte-ratio bound on the decode step: streamed weights "
+                  "+ live KV read at measured occupancy/context, KV "
+                  f"shrunk {itemsize}x to int8")
+    levers.append({"name": LEVER_KV_QUANT, "score": float(kv_score),
+                   "estimate": kv_est, "why": why_kv})
+
+    # Quantized collectives: the step's wire bytes as a share of its HBM
+    # bytes bounds what halving them can buy (EQuARX-style int8 wires).
+    coll_score = 0.0
+    coll_est: dict[str, Any] = {"collective_byte_share": None}
+    step_row = ((census or {}).get("programs") or {}).get("step") or {}
+    cb, ba = step_row.get("collective_mbytes"), step_row.get("bytes_accessed")
+    why_coll = "no census row for the decode step on this backend"
+    if cb is not None and ba:
+        share = (cb * 1e6) / ba
+        coll_score = 0.5 * share          # int8 wires halve 16-bit bytes
+        coll_est = {"collective_byte_share": share,
+                    "collective_mbytes_per_step": cb}
+        why_coll = ("measured collective bytes as a share of the decode "
+                    "step's HBM bytes, halved by int8 wire quantization")
+    levers.append({"name": LEVER_COLLECTIVES, "score": float(coll_score),
+                   "estimate": coll_est, "why": why_coll})
+
+    # Self-speculation: the prompt-lookup acceptance estimate bounds the
+    # extra tokens per verify pass draft-free speculation gets for free.
+    accept = ((workload or {}).get("selfspec_accept") or {}).get("mean")
+    accept = None if (isinstance(accept, float) and math.isnan(accept)) \
+        else accept
+    levers.append({
+        "name": LEVER_SPECULATION,
+        "score": float(accept) if accept is not None else 0.0,
+        "estimate": {"selfspec_acceptance": accept},
+        "why": ("measured n-gram prompt-lookup acceptance potential on "
+                "admitted prompts" if accept is not None else
+                "no workload analytics measured (serving.workload off)"),
+    })
+
+    levers.sort(key=lambda d: d["score"], reverse=True)
+    return {
+        "schema": CAPACITY_SCHEMA,
+        "meta": dict(meta or {}),
+        "workload": workload,
+        "ledger": ledger,
+        "census": census,
+        "advisor": {"levers": levers,
+                    "ranked": [d["name"] for d in levers]},
+    }
+
+
+def _mean_context(workload: Optional[dict], ledger: dict) -> float:
+    """Time-averaged live context (prompt + generated-so-far) per
+    occupied slot, from the workload histograms when measured, else half
+    the slot capacity. The decode-side mean is halved: ``decode_len``
+    records the FINAL generated count at retirement, but context grows
+    linearly over a slot's residency, so its time average is ~half."""
+    if workload:
+        p = (workload.get("prompt_len") or {}).get("mean")
+        d = (workload.get("decode_len") or {}).get("mean")
+        ok = [isinstance(v, (int, float)) and not math.isnan(v)
+              for v in (p, d)]
+        if any(ok):
+            return float((p if ok[0] else 0.0) + (d / 2.0 if ok[1] else 0.0))
+    return float(ledger.get("max_len") or 0) / 2.0
+
+
+_REQUIRED_LEDGER_KEYS = (
+    "weights_bytes", "weights_stream_bytes_per_step", "kv_bytes",
+    "kv_per_slot_bytes", "kv_per_token_bytes", "cache_itemsize",
+    "temp_bytes", "total_bytes", "limit_bytes", "headroom_bytes",
+    "projected_max_slots", "projected_max_context")
+
+
+def validate_capacity_report(report: dict) -> list:
+    """Schema gate for ``CAPACITY_REPORT.json`` (same contract as
+    ``validate_chrome_trace``): returns a list of problems, empty when
+    the report is well-formed. Null values are legal everywhere — the
+    degradation contract — but every field must be PRESENT."""
+    errs = []
+    if not isinstance(report, dict):
+        return [f"report is {type(report).__name__}, not dict"]
+    if report.get("schema") != CAPACITY_SCHEMA:
+        errs.append(f"schema is {report.get('schema')!r}, "
+                    f"want {CAPACITY_SCHEMA!r}")
+    ledger = report.get("ledger")
+    if not isinstance(ledger, dict):
+        errs.append("missing ledger section")
+    else:
+        for k in _REQUIRED_LEDGER_KEYS:
+            if k not in ledger:
+                errs.append(f"ledger missing key {k!r}")
+    adv = report.get("advisor")
+    if not isinstance(adv, dict) or not isinstance(adv.get("levers"), list):
+        errs.append("missing advisor.levers list")
+    else:
+        for i, lv in enumerate(adv["levers"]):
+            if not isinstance(lv, dict):
+                errs.append(f"advisor.levers[{i}] is "
+                            f"{type(lv).__name__}, not dict")
+                continue
+            for k in ("name", "score", "estimate", "why"):
+                if k not in lv:
+                    errs.append(f"advisor.levers[{i}] missing {k!r}")
+        ranked = adv.get("ranked")
+        if ranked != [lv.get("name") for lv in adv["levers"]
+                      if isinstance(lv, dict)]:
+            errs.append("advisor.ranked does not match lever order")
+    census = report.get("census")
+    if census is not None and not isinstance(census, dict):
+        errs.append(f"census is {type(census).__name__}, not dict")
+    elif census is not None and not isinstance(
+            census.get("programs", {}), dict):
+        errs.append("census.programs is not a dict")
+    for k in ("workload", "census"):
+        if k not in report:
+            errs.append(f"missing {k!r} section (null is fine)")
+    return errs
+
+
+def write_capacity_report(report: dict, path) -> Path:
+    """Atomically write the report (tmp + rename, like the Prometheus
+    sink: a concurrent reader never sees a torn file)."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_name(p.name + ".tmp")
+    tmp.write_text(json.dumps(report, indent=2, default=_json_default),
+                   encoding="utf-8")
+    os.replace(tmp, p)
+    return p
+
+
+def _json_default(o):
+    f = getattr(o, "item", None)
+    if callable(f) and getattr(o, "size", 1) == 1:
+        return f()
+    f = getattr(o, "tolist", None)
+    if callable(f):
+        return f()
+    return str(o)

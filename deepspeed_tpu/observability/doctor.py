@@ -1,18 +1,27 @@
 """Ops triage CLI: ``python -m deepspeed_tpu.observability.doctor``.
 
-Pretty-prints the three artifacts the runbooks point at, from files
-alone (no running engine, no device):
+Pretty-prints the artifacts the runbooks point at, from files alone (no
+running engine, no device):
 
 - the newest Prometheus textfile (``*.prom``) — current gauges;
 - the newest per-request log (``*.requests.jsonl``) — last requests,
   grouped by terminal status;
 - the newest flight record (``flight_*/``) — reason, markers, the
-  slowest spans, and where the trace.json lives for Perfetto.
+  slowest spans, and where the trace.json lives for Perfetto;
+- the newest capacity report (``CAPACITY_REPORT*.json``) — HBM ledger
+  totals and the advisor's ranked levers (docs/OPERATIONS.md
+  capacity-planning runbook).
+
+Exit code is the CI/cron gate: **nonzero** when the newest flight record
+contains a why-marker (watchdog stall, SLO breach, anomaly, compile
+storm — something fired since the record was cut) or when any
+``dstpu_*_burn`` SLO gauge in the latest .prom is above zero; 0 on a
+clean replica. ``--no-gate`` restores the always-0 report-only behavior.
 
 Usage::
 
     python -m deepspeed_tpu.observability.doctor [--dir ./monitor]
-        [--flight-dir <dir>] [--requests N]
+        [--flight-dir <dir>] [--requests N] [--no-gate]
 
 Stdout is this module's interface (it is a CLI report tool, exempt from
 the bare-print lint like ``env_report.py``).
@@ -44,13 +53,15 @@ def _fmt(v: float) -> str:
     return f"{v:g}" if isinstance(v, float) else str(v)
 
 
-def report_prometheus(d: Path) -> None:
+def report_prometheus(d: Path) -> list:
+    """Print the latest .prom; returns gate findings — every SLO burn
+    gauge (``dstpu_*_burn``) currently above zero."""
     from .sinks import parse_prometheus_textfile
 
     prom = _newest(d, "*.prom")
     if prom is None:
         print(f"[prom] no *.prom under {d}")
-        return
+        return []
     vals = parse_prometheus_textfile(prom.read_text())
     print(f"[prom] {prom} ({len(vals)} metrics)")
     # every metric, serving first, then training, then the rest — a
@@ -61,6 +72,10 @@ def report_prometheus(d: Path) -> None:
             if k.startswith(prefix) and k not in shown:
                 shown.add(k)
                 print(f"  {k:<44s} {_fmt(v)}")
+    return [f"SLO burn gauge {k} = {_fmt(v)} in {prom.name}"
+            for k, v in sorted(vals.items())
+            if k.endswith("_burn") and "_slo_" in k
+            and isinstance(v, float) and v > 0]
 
 
 def report_requests(d: Path, limit: int) -> None:
@@ -85,13 +100,16 @@ def report_requests(d: Path, limit: int) -> None:
               + (f" error={r['error']}" if r.get("error") else ""))
 
 
-def report_flight(d: Path, slow: int = 5) -> None:
+def report_flight(d: Path, slow: int = 5) -> list:
+    """Print the newest flight record; returns gate findings — the
+    why-markers it contains (a record with markers means something
+    fired: watchdog stall, SLO breach, anomaly, compile storm)."""
     from .flight import newest_flight_record, read_flight_record
 
     rec_dir = newest_flight_record(d)
     if rec_dir is None:
         print(f"[flight] no flight_* record under {d}")
-        return
+        return []
     rec = read_flight_record(rec_dir)
     mf = rec["manifest"]
     print(f"[flight] {rec_dir}")
@@ -115,24 +133,88 @@ def report_flight(d: Path, slow: int = 5) -> None:
     if rec.get("trace") is not None:
         print(f"  perfetto: load {rec_dir}/trace.json at "
               "https://ui.perfetto.dev")
+    names = sorted({str(dict(m.get("meta", {})).get("name", "?"))
+                    for m in markers})
+    if names:
+        return [f"flight record {rec_dir.name} contains why-marker(s): "
+                + ", ".join(names)]
+    return []
+
+
+def report_capacity(d: Path, levers: int = 4) -> None:
+    """Print the newest capacity report's ledger totals + ranked advisor
+    levers (informational — the advisor ranks levers, it doesn't gate)."""
+    import json
+
+    from .capacity import validate_capacity_report
+
+    rep_path = _newest(d, "CAPACITY_REPORT*.json")
+    if rep_path is None:
+        print(f"[capacity] no CAPACITY_REPORT*.json under {d}")
+        return
+    try:
+        rep = json.loads(rep_path.read_text(errors="replace"))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[capacity] {rep_path} unreadable ({e!r})")
+        return
+    errs = validate_capacity_report(rep)
+    valid = "" if not errs else f" INVALID ({len(errs)} schema problems)"
+    print(f"[capacity] {rep_path}{valid}")
+    if not isinstance(rep, dict):
+        return
+    led = rep.get("ledger")
+    led = led if isinstance(led, dict) else {}
+    gib = 1 << 30
+    for k in ("weights_bytes", "kv_bytes", "temp_bytes", "total_bytes",
+              "limit_bytes", "headroom_bytes"):
+        v = led.get(k)
+        print(f"  {k:<28s} "
+              + (f"{v / gib:.3f} GiB" if isinstance(v, (int, float))
+                 else "unknown"))
+    for k in ("projected_max_slots", "projected_max_context"):
+        print(f"  {k:<28s} {led.get(k)}")
+    adv = rep.get("advisor")
+    lvs = adv.get("levers") if isinstance(adv, dict) else None
+    for i, lv in enumerate((lvs if isinstance(lvs, list) else [])[:levers]):
+        # an INVALID report's levers still print, field by field — the
+        # triage contract is degrade, never crash on a torn artifact
+        lv = lv if isinstance(lv, dict) else {}
+        score = lv.get("score")
+        if isinstance(score, (int, float)):
+            score = _fmt(float(score))
+        print(f"  #{i + 1} {str(lv.get('name')):<22s} "
+              f"score={score}  {lv.get('why') or ''}")
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability.doctor",
-        description="Pretty-print the latest .prom, request log, and "
-                    "flight record for ops triage.")
+        description="Pretty-print the latest .prom, request log, flight "
+                    "record, and capacity report for ops triage; exit "
+                    "nonzero when something fired (see --no-gate).")
     ap.add_argument("--dir", default="./monitor",
                     help="monitor output directory (default ./monitor)")
     ap.add_argument("--flight-dir", default=None,
                     help="flight-record directory (default: --dir)")
     ap.add_argument("--requests", type=int, default=8,
                     help="recent request rows to show (default 8)")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="always exit 0 (report-only; the default exits "
+                         "1 on why-markers / burning SLOs so CI and cron "
+                         "can gate on this command)")
     args = ap.parse_args(argv)
     d = Path(args.dir)
-    report_prometheus(d)
+    findings = report_prometheus(d)
     report_requests(d, args.requests)
-    report_flight(Path(args.flight_dir) if args.flight_dir else d)
+    findings += report_flight(Path(args.flight_dir) if args.flight_dir
+                              else d)
+    report_capacity(d)
+    if findings:
+        print(f"[gate] {len(findings)} finding(s):")
+        for f in findings:
+            print(f"  - {f}")
+        return 0 if args.no_gate else 1
+    print("[gate] clean")
     return 0
 
 

@@ -57,13 +57,18 @@ class FlightRecorder:
     dict; providers are called at dump time only. ``clock`` stamps
     marker events (injectable, like every other observability clock);
     directory names use wall time via ``time.strftime`` because they
-    are operator-facing filenames, not measured intervals."""
+    are operator-facing filenames, not measured intervals. ``registry``
+    (the owner's MetricsRegistry) makes silent dump degradation visible:
+    every failed artifact write counts in ``Flight/write_errors``
+    (``dstpu_flight_write_errors`` in the .prom) instead of only
+    warning."""
 
     def __init__(self, dump_dir, spans: Optional[S.SpanRecorder] = None,
                  snapshots: Optional[dict[str, Callable[[], dict]]] = None,
                  recent_requests: int = 64, max_dumps: int = 8,
                  clock: Optional[Callable[[], float]] = None,
-                 job_name: str = "deepspeed_tpu"):
+                 job_name: str = "deepspeed_tpu", registry=None):
+        self.registry = registry
         self.dump_dir = Path(dump_dir)
         self.spans = spans
         self.snapshots: dict[str, Callable[[], dict]] = dict(snapshots or {})
@@ -78,6 +83,13 @@ class FlightRecorder:
         # signal handlers (PreemptionGuard) on the main thread, which may
         # have been interrupted while holding this lock in on_request()
         self._lock = threading.RLock()
+
+    def _count_write_error(self) -> None:
+        """A dump artifact failed to land on disk — count it so the .prom
+        shows the degradation (``dstpu_flight_write_errors``); the
+        warning alone disappears with the process."""
+        if self.registry is not None:
+            self.registry.counter("Flight/write_errors").inc()
 
     # ------------------------------------------------------------ recording
     def add_snapshot_provider(self, name: str,
@@ -127,6 +139,7 @@ class FlightRecorder:
                 # raising OSError out of the watchdog, the nonfinite
                 # halt, or the SIGTERM handler — replacing the error the
                 # resilience layer is watching for — is not
+                self._count_write_error()
                 log_dist(f"flight recorder: dump to {self.dump_dir} "
                          f"failed ({e!r})", ranks=[0], level="WARNING")
                 return None
@@ -146,6 +159,7 @@ class FlightRecorder:
             try:
                 write()
             except Exception as e:
+                self._count_write_error()
                 try:
                     (d / (name + ".error")).write_text(repr(e),
                                                        encoding="utf-8")

@@ -169,6 +169,13 @@ class ServingStats:
         self.clock = clock
         self._t0: Optional[float] = None     # first admission: goodput window
         self.completed_tokens = 0
+        # time-weighted occupancy: (last sample time, fraction held since)
+        # — Serve/slot_occupancy is point-in-time; the AVG is what
+        # capacity math needs (a slot 90% full between samples and 10%
+        # full at them must not read as 10%)
+        self._occ_prev: Optional[tuple] = None
+        self._occ_time = 0.0
+        self._occ_weighted = 0.0
 
     def reset(self) -> None:
         """Clear every Serve/* series and restart the goodput window —
@@ -177,6 +184,9 @@ class ServingStats:
         self.registry.reset()
         self._t0 = None
         self.completed_tokens = 0
+        self._occ_prev = None
+        self._occ_time = 0.0
+        self._occ_weighted = 0.0
 
     # ---------------------------------------------------- request lifecycle
     def on_submit(self, queue_depth: int) -> float:
@@ -262,7 +272,22 @@ class ServingStats:
             # bench compares to static batching's dead tail
             r.counter("Serve/decode_steps").inc()
         r.gauge("Serve/queue_depth").set(queue_depth)
-        r.gauge("Serve/slot_occupancy").set(occupied / max(1, slots))
+        frac = occupied / max(1, slots)
+        r.gauge("Serve/slot_occupancy").set(frac)
+        # time-weighted average on the injectable clock: the PREVIOUS
+        # sample's fraction held over the interval that just elapsed
+        # (left-continuous integral); published via publish_metrics with
+        # everything else
+        t = self.clock()
+        if self._occ_prev is not None:
+            t0, f0 = self._occ_prev
+            dt = t - t0
+            if dt > 0:
+                self._occ_time += dt
+                self._occ_weighted += f0 * dt
+                r.gauge("Serve/slot_occupancy_avg").set(
+                    self._occ_weighted / self._occ_time)
+        self._occ_prev = (t, frac)
 
     def snapshot(self) -> dict:
         snap = self.registry.snapshot()
@@ -286,6 +311,7 @@ class ServingStats:
             "results_evicted": int(c.get("Serve/results_evicted", 0)),
             "queue_depth": g.get("Serve/queue_depth"),
             "slot_occupancy": g.get("Serve/slot_occupancy"),
+            "slot_occupancy_avg": g.get("Serve/slot_occupancy_avg"),
             "goodput_tps": g.get("Serve/goodput_tps"),
             "ttft_s": h.get("Serve/ttft_s", {}),
             "tpot_s": h.get("Serve/tpot_s", {}),

@@ -1,5 +1,6 @@
 from .flops_profiler import (FlopsProfiler, compiled_cost_analysis,
-                             model_flops_tree, profile_model)
+                             compiled_memory_analysis, model_flops_tree,
+                             profile_model)
 
-__all__ = ["FlopsProfiler", "compiled_cost_analysis", "model_flops_tree",
-           "profile_model"]
+__all__ = ["FlopsProfiler", "compiled_cost_analysis",
+           "compiled_memory_analysis", "model_flops_tree", "profile_model"]

@@ -28,20 +28,46 @@ from ..utils.timer import peak_flops_for
 
 
 # ------------------------------------------------------------ measured side
-def compiled_cost_analysis(jitted, *args, **kwargs) -> dict:
-    """FLOPs/bytes the compiler scheduled for one call of ``jitted(*args)``.
-
-    Works on a ``jax.jit`` wrapper (traces + hits the compile cache) or an
-    already-lowered/compiled object."""
+def _compiled(jitted, *args, **kwargs):
     compiled = jitted
     if hasattr(compiled, "lower"):
         compiled = compiled.lower(*args, **kwargs)
     if hasattr(compiled, "compile"):
         compiled = compiled.compile()
-    cost = compiled.cost_analysis()
+    return compiled
+
+
+def compiled_cost_analysis(jitted, *args, **kwargs) -> dict:
+    """FLOPs/bytes the compiler scheduled for one call of ``jitted(*args)``.
+
+    Works on a ``jax.jit`` wrapper (traces + hits the compile cache) or an
+    already-lowered/compiled object."""
+    cost = _compiled(jitted, *args, **kwargs).cost_analysis()
     if isinstance(cost, (list, tuple)):   # older jax: one dict per device
         cost = cost[0] if cost else {}
     return dict(cost or {})
+
+
+def compiled_memory_analysis(jitted, *args, **kwargs) -> dict:
+    """Buffer-assignment byte summary (``*_in_bytes`` fields) of one
+    compiled call — the compiler's own temp/argument/output/generated
+    sizes. Same calling convention as :func:`compiled_cost_analysis`;
+    the field set varies across jax versions and backends, so every
+    available numeric field is returned and absent ones are simply
+    missing (callers treat missing as unknown). Raises when the backend
+    has no ``memory_analysis`` at all — capacity census wraps this in
+    its degradation guard."""
+    ma = _compiled(jitted, *args, **kwargs).memory_analysis()
+    out = {}
+    if ma is None:
+        return out
+    for k in dir(ma):
+        if k.endswith("_in_bytes"):
+            try:
+                out[k] = int(getattr(ma, k))
+            except Exception:
+                pass   # field probe: names vary across jax versions
+    return out
 
 
 # ------------------------------------------------------------ analytic side
@@ -108,11 +134,16 @@ def _fmt(n: float) -> str:
 
 # ------------------------------------------------------------------ the hook
 class FlopsProfiler:
-    """Engine-attached profiler; fires once at ``profile_step``."""
+    """Engine-attached profiler; fires once at ``profile_step``.
 
-    def __init__(self, config, engine):
+    ``clock`` is the injectable timestamp seam (same discipline as the
+    observability stack: default to ``time.perf_counter`` WITHOUT calling
+    it, so fake-clock tests can drive the timed step deterministically)."""
+
+    def __init__(self, config, engine, clock=time.perf_counter):
         self.cfg = config
         self.engine = engine
+        self.clock = clock
         self.done = False
 
     def should_fire(self) -> bool:
@@ -143,12 +174,12 @@ class FlopsProfiler:
         # host optimizer update in offload mode — timing only _grad_step
         # would overstate MFU — and commits state/global_steps normally;
         # self.done is already True so this cannot recurse).
-        t0 = time.perf_counter()
+        t0 = self.clock()
         eng.train_batch(batch)
         jax.block_until_ready(
             jax.tree.leaves(eng.compute_params if eng.offload
                             else eng.state.master_params)[0])
-        dt = time.perf_counter() - t0
+        dt = self.clock() - t0
 
         lines = [f"-------- deepspeed_tpu flops profiler "
                  f"(step {eng.global_steps}) --------",

@@ -530,7 +530,8 @@ class Engine:
             self.flight = FlightRecorder(
                 obs.flight_dir, spans=self.spans,
                 snapshots={"train": self.metrics_snapshot},
-                max_dumps=obs.flight_max_dumps, job_name="train")
+                max_dumps=obs.flight_max_dumps, job_name="train",
+                registry=self.metrics)
         self._step_anomaly = None
         if obs.slo:
             from ..observability.slo import MedianMADDetector, SLOConfig
@@ -1279,45 +1280,57 @@ class Engine:
                                     params, iters=4)
         return float(eig)
 
-    def compile_train_step(self, batch: dict) -> dict:
-        """AOT-compile the train step for this batch's shapes WITHOUT
-        executing it, and return the compiler's buffer-assignment summary
-        (``*_size_in_bytes``). This is how memory levers are *measured*
-        (bench_act_offload.py, autotuner feasibility): the numbers are the
-        compiler's own, and nothing touches device memory — safe to probe
-        configs that would OOM if run."""
+    def _compiled_step(self, batch: dict):
+        """AOT-lower/compile the step program that ``train_batch`` would
+        run for this batch's shapes, WITHOUT executing it — nothing
+        touches device memory, so configs that would OOM can be probed."""
         if not isinstance(next(iter(batch.values())), jax.Array):
             batch = self._make_global(batch)
         if self.offload:
             # offload engines: the device program is the grad step (the
             # update runs on the host) — its footprint IS the HBM question
             with self.mesh:
-                compiled = self._grad_step.lower(
+                return self._grad_step.lower(
                     self.compute_params, batch,
                     jax.ShapeDtypeStruct((), jnp.float32)).compile()
-        else:
-            comp_active = tuple(sorted(
-                n for n, off in self._comp if self.global_steps >= off))
-            if self._moq is not None and "weight_quantization" in comp_active:
-                # mirror train_batch: compile the program that will actually
-                # run (current scheduled bit-width), so the memory numbers
-                # describe it and the cached executable is reusable
-                comp_active = self._moq.annotate(comp_active)
-            warm = (in_warmup(self.onebit, self.global_steps)
-                    if self.onebit is not None else False)
-            with self.mesh:
-                compiled = self._train_step.lower(
-                    self.state, batch, max(0, self._ltd_tokens), comp_active,
-                    warm).compile()
-        ma = compiled.memory_analysis()
-        out = {}
-        for k in dir(ma):
-            if k.endswith("_in_bytes"):
-                try:
-                    out[k] = int(getattr(ma, k))
-                except Exception:
-                    pass
-        return out
+        comp_active = tuple(sorted(
+            n for n, off in self._comp if self.global_steps >= off))
+        if self._moq is not None and "weight_quantization" in comp_active:
+            # mirror train_batch: compile the program that will actually
+            # run (current scheduled bit-width), so the memory numbers
+            # describe it and the cached executable is reusable
+            comp_active = self._moq.annotate(comp_active)
+        warm = (in_warmup(self.onebit, self.global_steps)
+                if self.onebit is not None else False)
+        with self.mesh:
+            return self._train_step.lower(
+                self.state, batch, max(0, self._ltd_tokens), comp_active,
+                warm).compile()
+
+    def compile_train_step(self, batch: dict) -> dict:
+        """AOT-compile the train step and return the compiler's
+        buffer-assignment summary (``*_size_in_bytes``). This is how
+        memory levers are *measured* (bench_act_offload.py, autotuner
+        feasibility): the numbers are the compiler's own."""
+        from ..profiling.flops_profiler import compiled_memory_analysis
+
+        return compiled_memory_analysis(self._compiled_step(batch))
+
+    def cost_census(self, batch: dict) -> dict:
+        """Per-program capacity census of the train step: static FLOPs /
+        HBM bytes / collective bytes (compiler + HLO truth), joined with
+        achieved ``train_step`` wall times from the span ring when spans
+        are enabled — the training row of the capacity report
+        (docs/OPERATIONS.md capacity-planning runbook). Backends without
+        cost/memory analysis degrade to null-valued fields, never raise."""
+        from ..observability.capacity import ProgramCensus, roofline_peaks
+
+        pf, bw = roofline_peaks()
+        census = ProgramCensus(peak_flops=pf, peak_bw=bw)
+        census.measure("train_step", self._compiled_step(batch))
+        if self.spans is not None:
+            census.attach_spans(self.spans.events())
+        return census.report()
 
     # ----------------------------------------------------------- resilience
     def _note_bad_steps(self, bad: bool, window: int, last_loss: float) -> None:

@@ -113,7 +113,20 @@ class ServingEngine:
                 snapshots={"serving": self.metrics_snapshot,
                            "health": self.health},
                 max_dumps=self.cfg.flight_max_dumps,
-                clock=self.stats.clock, job_name="serving")
+                clock=self.stats.clock, job_name="serving",
+                registry=self.stats.registry)
+        # traffic analytics (observability/workload.py): prefix-overlap /
+        # self-speculation estimators + shape histograms on the admission
+        # path. None (default) = one `is not None` per admission, nothing
+        # else — no programs, no syncs (the compile-freeze gate stays the
+        # acceptance test).
+        self.workload = None
+        if self.cfg.workload is not None and self.cfg.workload.enabled:
+            from ..observability.workload import WorkloadAnalyzer
+
+            self.workload = WorkloadAnalyzer(
+                self.cfg.workload, registry=self.stats.registry,
+                clock=self.stats.clock)
         self.slo = None
         self._step_anomaly = None
         self._compile_storm = None
@@ -288,6 +301,10 @@ class ServingEngine:
             if self._prefill is None:
                 req = self.sched.pop_next()
                 if req is not None:
+                    if self.workload is not None:
+                        # admission hook: score the prompt's prefix overlap
+                        # / self-speculation potential (host-side only)
+                        self.workload.on_admit(req.prompt)
                     cache = self._prog("init_cache", lambda: jax.jit(
                         lambda: init_cache(self.model.cfg, 1,
                                            self.cfg.max_len,
@@ -395,6 +412,8 @@ class ServingEngine:
         return finished
 
     def _store_result(self, req: Request) -> None:
+        if self.workload is not None:
+            self.workload.on_retire(req)
         if self._request_logs or self.flight is not None:
             rec = request_record(req)
             for sink in self._request_logs:
@@ -584,7 +603,105 @@ class ServingEngine:
         return out
 
     def metrics_snapshot(self) -> dict:
-        return {"compiles": self.compiles, **self.stats.snapshot()}
+        out = {"compiles": self.compiles, **self.stats.snapshot()}
+        if self.workload is not None:
+            out["workload"] = self.workload.snapshot()
+        return out
+
+    # ----------------------------------------------------------- capacity
+    def capacity_census(self) -> dict:
+        """Per-program cost census over the engine's bounded program set:
+        static FLOPs / HBM bytes / collective bytes (compiler + HLO truth,
+        AOT-lowered — nothing executes) joined with achieved wall times
+        from the span ring (``decode_step`` / ``prefill_chunk`` spans)
+        into achieved-vs-roofline MBU/MFU per program. Census rows cover
+        the programs traffic has actually built: the slot decode step and
+        every prefill bucket compiled so far. Backends without
+        cost/memory analysis degrade rows to null fields, never raise."""
+        from ..observability.capacity import ProgramCensus, roofline_peaks
+
+        pf, bw = roofline_peaks()
+        census = ProgramCensus(peak_flops=pf, peak_bw=bw)
+        mesh = self.engine.mesh
+        params = self.engine.params
+        # only programs traffic actually built — building (and compile-
+        # counting) the step here would put a phantom compile in the
+        # freeze gates and feed the compile-storm detector
+        if "step" in self._programs:
+            census.measure("step", self._programs["step"],
+                           params, self._state, mesh=mesh)
+        elif "step_chaos" in self._programs:
+            census.measure("step", self._programs["step_chaos"],
+                           params, self._state, jnp.int32(-1), mesh=mesh)
+        # prefill buckets: census exactly the chunk programs traffic
+        # built (avals only — a batch-1 cache never materializes)
+        cache_aval = jax.eval_shape(
+            lambda: init_cache(self.model.cfg, 1, self.cfg.max_len,
+                               self.engine.compute_dtype))
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        rng_aval = jax.eval_shape(lambda: per_request_keys([0]))
+        for key in [k for k in self._programs
+                    if isinstance(k, tuple) and k[0] in ("chunk", "final")]:
+            stem, size = key
+            ids = jax.ShapeDtypeStruct((1, size), jnp.int32)
+            if stem == "chunk":
+                census.measure(f"chunk_{size}", self._programs[key],
+                               params, cache_aval, ids, i32, mesh=mesh)
+            else:
+                census.measure(f"final_{size}", self._programs[key],
+                               params, cache_aval, ids, i32, i32, i32,
+                               rng_aval, mesh=mesh)
+        if self.spans is not None:
+            census.attach_spans(self.spans.events())
+        return census.report()
+
+    def hbm_ledger(self, temp_bytes: Optional[int] = None) -> dict:
+        """The live HBM budget decomposed (weights / KV / temp) with
+        projected headroom, as ``Memory/ledger_*`` gauges in the serving
+        registry — see :func:`~..observability.capacity.hbm_ledger`."""
+        from ..observability.capacity import hbm_ledger
+
+        return hbm_ledger(
+            params=self.engine.params, model_cfg=self.model.cfg,
+            slots=self.cfg.slots, max_len=self.cfg.max_len,
+            cache_dtype=self.engine.compute_dtype, temp_bytes=temp_bytes,
+            registry=self.stats.registry)
+
+    def capacity_report(self, path=None, census: bool = True) -> dict:
+        """The capacity advisor: workload analytics + HBM ledger + program
+        census composed into ranked what-if estimates on the observed
+        traffic (``CAPACITY_REPORT.json`` when ``path`` is given; see
+        docs/OPERATIONS.md capacity-planning runbook). ``census=False``
+        skips the AOT lowering pass (cheaper; advisor loses the
+        collective-byte lever's input)."""
+        import math as _math
+
+        from ..observability.capacity import (capacity_report,
+                                              write_capacity_report)
+
+        cen = self.capacity_census() if census else None
+        temp = None
+        if cen:
+            temps = [r.get("temp_bytes") for r in cen["programs"].values()]
+            temps = [t for t in temps if t is not None]
+            temp = max(temps) if temps else None
+        ledger = self.hbm_ledger(temp_bytes=temp)
+        gauges = self.stats.registry.snapshot()["gauges"]
+        occ = gauges.get("Serve/slot_occupancy_avg",
+                         gauges.get("Serve/slot_occupancy"))
+        if isinstance(occ, float) and _math.isnan(occ):
+            occ = None
+        wl = self.workload.snapshot() if self.workload is not None else None
+        rep = capacity_report(
+            ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
+            meta={"job": "serving", "slots": self.cfg.slots,
+                  "max_len": self.cfg.max_len,
+                  "prefill_chunk": self.cfg.prefill_chunk,
+                  "iterations": self._iterations,
+                  "compiles": self.compiles})
+        if path is not None:
+            write_capacity_report(rep, path)
+        return rep
 
     def score_slo(self) -> dict:
         """One SLO scoring pass (``Serve/slo_*_burn`` gauges + flight

@@ -761,7 +761,10 @@ def test_doctor_cli_reports_from_files(tmp_path, capsys):
                         clock=TickClock())
     fr.note("watchdog_stall", step_s=0.7)
     fr.dump("watchdog_stall")
-    assert doctor.main(["--dir", str(tmp_path)]) == 0
+    # a burning SLO gauge + a why-marker in the record: the gate trips
+    # (nonzero exit, so CI/cron can alert on this command), --no-gate
+    # restores report-only
+    assert doctor.main(["--dir", str(tmp_path)]) == 1
     out = capsys.readouterr().out
     assert "dstpu_serve_goodput_tps" in out and "123" in out
     assert "+Inf" in out
@@ -770,10 +773,15 @@ def test_doctor_cli_reports_from_files(tmp_path, capsys):
     assert "reason=watchdog_stall" in out
     assert "marker" in out and "slowest spans" in out
     assert "perfetto" in out
-    # empty directory: reports absence, still exits 0
+    assert "[gate]" in out and "slo_ttft_burn" in out
+    assert "why-marker" in out and "watchdog_stall" in out
+    assert doctor.main(["--dir", str(tmp_path), "--no-gate"]) == 0
+    capsys.readouterr()
+    # empty directory: nothing fired, exits 0
     assert doctor.main(["--dir", str(tmp_path / "empty")]) == 0
     out = capsys.readouterr().out
     assert "no *.prom" in out and "no flight_*" in out
+    assert "[gate] clean" in out
     # torn artifacts — the state an UNCLEAN death leaves (os._exit mid
     # write, SIGKILL before flush) — must degrade, not crash the triage:
     # a half-written trailing request record and a torn flight events line
@@ -782,7 +790,7 @@ def test_doctor_cli_reports_from_files(tmp_path, capsys):
     fdir = newest_flight_record(tmp_path)
     with open(fdir / "events.jsonl", "a", encoding="utf-8") as f:
         f.write('{"kind": "marker", "t0"')
-    assert doctor.main(["--dir", str(tmp_path)]) == 0
+    assert doctor.main(["--dir", str(tmp_path)]) == 1   # markers still gate
     out = capsys.readouterr().out
     assert "1 torn line(s) skipped" in out
     assert "ok=1" in out                               # intact rows kept
