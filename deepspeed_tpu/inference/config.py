@@ -36,6 +36,24 @@ class ServingConfig:
                                     # one chunk per scheduler iteration,
                                     # interleaved with the slot decode step
     max_queue: int = 0              # submit() backpressure; 0 = unbounded
+    # ---- paged KV cache (serving/pages.py, docs/SERVING.md) ----
+    # page_size > 0 replaces the contiguous per-slot cache with a pooled
+    # (L, pages, KV, page_size, hd) page cache: per-slot integer page
+    # tables indexed inside the attention read, a host-side radix prefix
+    # tree sharing identical prompt prefixes copy-free across slots
+    # (refcounted pages, copy-on-write at the first divergent page), and
+    # typed PagePoolExhausted admission control instead of mid-decode
+    # OOM. 0 (default) keeps the contiguous cache — bit-for-bit the
+    # pre-paging engine, same program set.
+    page_size: int = 0              # tokens per KV page; must divide max_len
+    pool_pages: int = 0             # pool size incl. the reserved scratch
+                                    # page; 0 = auto (1 + slots * pages/slot)
+    prefix_sharing: bool = True     # radix-tree prefix reuse (paged only)
+    # int8 quantized KV: pool stored int8 with per-token per-head scales,
+    # quantized on append, dequantized at the attention read (the WOQ
+    # point-of-use discipline applied to the cache). 0 = fp pool at the
+    # engine compute dtype (the bit-parity path).
+    kv_quant_bits: int = 0
     # engine-wide sampling policy (per-request RNG still makes every
     # request's draws independent of batch composition)
     temperature: float = 1.0
@@ -95,6 +113,29 @@ class ServingConfig:
                 f"bucket set), got {c}")
         if self.max_len < c:
             raise ValueError(f"max_len={self.max_len} < prefill_chunk={c}")
+        if self.page_size:
+            if self.page_size < 8 or self.max_len % self.page_size != 0:
+                raise ValueError(
+                    f"page_size must be >= 8 and divide max_len="
+                    f"{self.max_len}, got {self.page_size}")
+            per_slot = self.max_len // self.page_size
+            if self.pool_pages == 0:
+                # auto: every slot coverable with zero sharing, + scratch
+                self.pool_pages = 1 + self.slots * per_slot
+            elif self.pool_pages < 2:
+                # smaller-than-worst-case pools are LEGAL (overcommit:
+                # admission defers on transient pressure and sheds typed
+                # PagePoolExhausted for requests that can never fit) —
+                # but there must be at least one usable page + scratch
+                raise ValueError(
+                    f"pool_pages={self.pool_pages} < 2 (one usable page "
+                    "+ the reserved scratch page)")
+        if self.kv_quant_bits not in (0, 8):
+            raise ValueError(f"kv_quant_bits must be 0 (off) or 8, "
+                             f"got {self.kv_quant_bits}")
+        if self.kv_quant_bits and not self.page_size:
+            raise ValueError("kv_quant_bits requires the paged KV cache "
+                             "(set serving.page_size)")
         for knob in ("ttft_deadline_s", "total_deadline_s", "watchdog_s"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"{knob} must be >= 0, "

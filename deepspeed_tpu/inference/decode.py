@@ -42,12 +42,56 @@ class KVCache(NamedTuple):
                              # together) or (B,) per-slot (serving/slots.py)
 
 
+class PagedKVCache(NamedTuple):
+    """Page-pool KV state for the serving slot batch (serving/pages.py).
+
+    The contiguous per-slot cache above owns ``max_len`` positions per
+    slot whether or not they are ever written; the paged layout instead
+    pools fixed-size pages shared by all slots, and each slot maps its
+    logical positions onto pool pages through an integer ``page_table``
+    row. Identical prompt prefixes can then point at the SAME physical
+    pages (host-side radix tree, refcounted) — prefilled once, shared
+    copy-free. Pool page 0 is a reserved scratch page: idle slots' table
+    rows (and the shared-page entries of an insert) are redirected there,
+    so a retired or not-yet-placed row's appends can never touch live
+    data.
+
+    ``k``/``v`` are the pools in the compute dtype, or int8 when the KV
+    cache itself is quantized (``kv_quant_bits=8``); then ``k_scale`` /
+    ``v_scale`` hold symmetric per-token per-head scales alongside the
+    pages (``None`` in fp mode), quantized on append and dequantized at
+    the attention read — the same point-of-use dispatch discipline as the
+    WOQ weight path (never a hoisted dequantized copy of the pool)."""
+
+    k: jnp.ndarray            # (L, pages, KV, page_size, hd) fp or int8
+    v: jnp.ndarray            # (L, pages, KV, page_size, hd) fp or int8
+    k_scale: "jnp.ndarray | None"   # (L, pages, KV, page_size) f32 | None
+    v_scale: "jnp.ndarray | None"   # (L, pages, KV, page_size) f32 | None
+    page_table: jnp.ndarray   # (slots, pages_per_slot) i32 pool page ids
+    length: jnp.ndarray       # (slots,) i32 tokens cached per slot
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
 def cache_layout(cfg: TransformerConfig, batch: int, max_len: int,
-                 dtype=None) -> tuple:
+                 dtype=None, *, page_size: int = 0, pages: int = 0) -> tuple:
     """(shape, dtype) of one K or V cache buffer — the single source of
-    truth shared by :func:`init_cache` and the serving slot allocator
-    (``serving/slots.py``), so a prefilled request's cache can be written
-    into its slot with one ``dynamic_update_slice`` and no relayout."""
+    truth shared by :func:`init_cache`, the serving slot allocator
+    (``serving/slots.py``), and the paged pool allocator
+    (``serving/pages.py``), so a prefilled request's cache can be written
+    into its slot (or scattered into its pages) with no relayout.
+
+    ``page_size=0`` (default) is the contiguous per-slot layout
+    ``(L, batch, KV, max_len, hd)``; ``page_size > 0`` is the pooled page
+    layout ``(L, pages, KV, page_size, hd)`` — same trailing
+    (sublane, lane) = (positions, hd) shape per page, so one page is a
+    position-contiguous tile of the contiguous layout and the gather over
+    a slot's page-table row reassembles exactly the contiguous view."""
+    if page_size > 0:
+        return ((cfg.n_layer, pages, cfg.kv_heads, page_size, cfg.head_dim),
+                dtype or cfg.dtype)
     return ((cfg.n_layer, batch, cfg.kv_heads, max_len, cfg.head_dim),
             dtype or cfg.dtype)
 
@@ -140,6 +184,70 @@ def _cache_attend(q, ck, cv, length, flash_decode: bool = False, bias=None,
     return jnp.einsum("bhts,bhsd->bthd", probs, cv)
 
 
+def quantize_kv(x, axis: int = -1):
+    """Symmetric int8 quantization of appended KV values: per-head scales
+    (one fp32 scale per token per head over the ``hd`` axis), the KV-cache
+    analog of the WOQ weight path's per-channel groups. ``quantize →
+    dequantize → quantize`` is idempotent at these scales (the max
+    element round-trips to exactly ±127), which is what lets a hydrated
+    shared prefix re-insert without drift."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _paged_append(ck, cv, ks, vs, k, v, page_table, new_len):
+    """Append one decode token's K/V per slot into the page pool.
+
+    ``ck``/``cv`` are one layer's pools ``(pages, KV, page_size, hd)``;
+    ``k``/``v`` the new projections ``(B, 1, KV, hd)``; ``new_len`` the
+    (B,) post-append lengths. Each row's write position ``new_len - 1``
+    maps through its ``page_table`` row to (pool page, in-page offset) —
+    one scatter per pool. Rows whose table entries are scratch (idle or
+    freshly retired slots) write harmlessly into page 0; a live row past
+    its last page clips onto scratch-redirected entries the host cleared
+    at retirement, so stale rows can never touch another slot's pages."""
+    B = k.shape[0]
+    ps, n = ck.shape[2], page_table.shape[1]
+    pos = new_len - 1
+    pidx = jnp.clip(pos // ps, 0, n - 1)
+    pid = jnp.take_along_axis(page_table, pidx[:, None], axis=1)[:, 0]
+    off = pos % ps
+    kb, vb = k[:, 0], v[:, 0]                      # (B, KV, hd)
+    if ks is not None:
+        qk, sk = quantize_kv(kb)
+        qv, sv = quantize_kv(vb)
+        ck = ck.at[pid, :, off, :].set(qk)
+        cv = cv.at[pid, :, off, :].set(qv)
+        ks = ks.at[pid, :, off].set(sk)
+        vs = vs.at[pid, :, off].set(sv)
+    else:
+        ck = ck.at[pid, :, off, :].set(kb.astype(ck.dtype))
+        cv = cv.at[pid, :, off, :].set(vb.astype(cv.dtype))
+    return ck, cv, ks, vs
+
+
+def _paged_view(cp, sp, page_table, dtype):
+    """Gather one layer's pool pages into the slot batch's contiguous
+    attention view ``(B, KV, max_len, hd)`` — the page-table indirection
+    the tentpole puts INSIDE the attention read. Page ids are data, not
+    shapes: traffic churn changes table contents, never the program. An
+    int8 pool dequantizes here, at the point of use (scales broadcast
+    over ``hd``), so the fp path's gathered bytes are bit-identical to
+    the contiguous cache and the int8 path never materializes a
+    dequantized pool."""
+    g = cp[page_table]                             # (B, n, KV, ps, hd)
+    B, n, KV, ps, hd = g.shape
+    g = g.transpose(0, 2, 1, 3, 4).reshape(B, KV, n * ps, hd)
+    if sp is not None:
+        s = sp[page_table].transpose(0, 2, 1, 3).reshape(B, KV, n * ps)
+        g = (g.astype(jnp.float32) * s[..., None]).astype(dtype)
+    return g
+
+
 def _qkv_proj(model, y, p):
     """The attention projections as ONE GEMM when the engine pre-fused
     them (``wqkv`` = [wq | wk | wv] along the output dim, ``bqkv``
@@ -167,15 +275,22 @@ def _qkv_proj(model, y, p):
 
 @jax.named_scope("decode_layer")
 def _layer_step(model, x, p, cache_k, cache_v, length, positions,
-                flash_decode: bool = False):
+                flash_decode: bool = False, paged=None):
     """One transformer layer over x: (B, T, d), reading/writing the cache.
 
-    Returns (x_out, new_cache_k, new_cache_v). Mirrors
-    ``TransformerLM._attention_block`` / ``_mlp_block`` with cache attention
-    substituted for the full causal attention. Weights may arrive dense OR
-    quantized (int8/int4 ``QuantizedTensor`` leaves): every projection goes
-    through the point-of-use dispatch, so quantized decode re-reads int8
-    bytes from HBM each step — never a hoisted bf16 copy.
+    Returns (x_out, new_cache_k, new_cache_v) — plus the new scale pools
+    when ``paged`` is set. Mirrors ``TransformerLM._attention_block`` /
+    ``_mlp_block`` with cache attention substituted for the full causal
+    attention. Weights may arrive dense OR quantized (int8/int4
+    ``QuantizedTensor`` leaves): every projection goes through the
+    point-of-use dispatch, so quantized decode re-reads int8 bytes from
+    HBM each step — never a hoisted bf16 copy.
+
+    ``paged`` is ``(page_table, k_scale, v_scale)`` for the pooled page
+    layout (T == 1 serving decode only): the append scatters through the
+    page table and the attention read gathers the slot's pages back into
+    the contiguous view — same values, same mask math, so the fp paged
+    step is bit-identical to the contiguous one by construction.
     """
     cfg = model.cfg
     B, T, d = x.shape
@@ -186,20 +301,33 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
     if cfg.pos_embedding == "rope":
         q, k = _rope(q, k, positions, cfg.rope_theta, cfg.rotary_dim)
 
-    start = length - T  # cache slots [start, start+T) receive the new k/v
-    if getattr(length, "ndim", 0) == 1:
-        # per-slot write positions: one dynamic_update_slice per row via
-        # vmap (lowers to a scatter) — each serving slot appends at its
-        # own length while the batch stays one static-shape program
-        upd = jax.vmap(lambda c, u, s: lax.dynamic_update_slice(
-            c, u, (0, s, 0)))
-        cache_k = upd(cache_k, k.swapaxes(1, 2).astype(cache_k.dtype), start)
-        cache_v = upd(cache_v, v.swapaxes(1, 2).astype(cache_v.dtype), start)
+    scale_k = scale_v = None
+    if paged is not None:
+        page_table, scale_k, scale_v = paged
+        cache_k, cache_v, scale_k, scale_v = _paged_append(
+            cache_k, cache_v, scale_k, scale_v, k, v, page_table, length)
+        attend_k = _paged_view(cache_k, scale_k, page_table, cfg.dtype)
+        attend_v = _paged_view(cache_v, scale_v, page_table, cfg.dtype)
     else:
-        cache_k = lax.dynamic_update_slice(
-            cache_k, k.swapaxes(1, 2).astype(cache_k.dtype), (0, 0, start, 0))
-        cache_v = lax.dynamic_update_slice(
-            cache_v, v.swapaxes(1, 2).astype(cache_v.dtype), (0, 0, start, 0))
+        start = length - T  # cache slots [start, start+T) get the new k/v
+        if getattr(length, "ndim", 0) == 1:
+            # per-slot write positions: one dynamic_update_slice per row
+            # via vmap (lowers to a scatter) — each serving slot appends
+            # at its own length while the batch stays one static program
+            upd = jax.vmap(lambda c, u, s: lax.dynamic_update_slice(
+                c, u, (0, s, 0)))
+            cache_k = upd(cache_k, k.swapaxes(1, 2).astype(cache_k.dtype),
+                          start)
+            cache_v = upd(cache_v, v.swapaxes(1, 2).astype(cache_v.dtype),
+                          start)
+        else:
+            cache_k = lax.dynamic_update_slice(
+                cache_k, k.swapaxes(1, 2).astype(cache_k.dtype),
+                (0, 0, start, 0))
+            cache_v = lax.dynamic_update_slice(
+                cache_v, v.swapaxes(1, 2).astype(cache_v.dtype),
+                (0, 0, start, 0))
+        attend_k, attend_v = cache_k, cache_v
     alibi = None
     if cfg.pos_embedding == "alibi":
         # ALiBi positional signal (mirrors _attention_block's training
@@ -208,7 +336,7 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
         from ..models.transformer import alibi_slopes
 
         alibi = alibi_slopes(h)
-    o = _cache_attend(q, cache_k, cache_v, length, flash_decode=flash_decode,
+    o = _cache_attend(q, attend_k, attend_v, length, flash_decode=flash_decode,
                       alibi=alibi)
     o = model._maybe_bias(
         matmul_any(o.reshape(B, T, h * hd), p["wo"],
@@ -223,11 +351,16 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
         y2 = y if cfg.parallel_shared_ln else _norm(
             x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
         out, _aux = mlp(y2, p)
-        return x + o + out, cache_k, cache_v
-    x = x + o
-    y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-    out, _aux = mlp(y2, p)
-    return x + out, cache_k, cache_v
+        x = x + o + out
+    else:
+        x = x + o
+        y2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm,
+                   cfg.norm_eps)
+        out, _aux = mlp(y2, p)
+        x = x + out
+    if paged is not None:
+        return x, cache_k, cache_v, scale_k, scale_v
+    return x, cache_k, cache_v
 
 
 def _embed_rows(table, ids, dtype):
@@ -290,6 +423,10 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     empty) and decode (T = 1). Returns (fp32 logits (B, T, V), new cache).
     ``cache.length`` may be a scalar (every row at the same position) or a
     (B,) per-slot vector (serving: each slot appends at its own length).
+    ``cache`` may also be a :class:`PagedKVCache` (T == 1 only): appends
+    scatter through the slot page tables and the attention read gathers
+    each slot's pages — page-table CONTENTS are data, so traffic churn
+    never changes the program.
     ``last_token_head=True`` computes the unembedding only for the final
     position (the generation loop's prefill: the other T-1 logit rows are
     discarded anyway, and at GPT-2 vocab sizes they're the biggest tensor
@@ -299,6 +436,12 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     """
     cfg = model.cfg
     B, T = input_ids.shape
+    paged = isinstance(cache, PagedKVCache)
+    if paged and T != 1:
+        raise ValueError(
+            "the paged KV cache serves the T == 1 slot decode step only; "
+            "prefill runs through a contiguous per-request cache and is "
+            "scattered into pages at insert (serving/pages.py)")
     new_len = cache.length + T
     per_slot = getattr(cache.length, "ndim", 0) == 1
     if positions is None:
@@ -316,19 +459,37 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
         x = _norm(x, params["embed_ln_scale"], params.get("embed_ln_bias"),
                   cfg.norm, cfg.norm_eps)
 
-    def scan_fn(carry, layer_in):
-        x = carry
-        lp, ck, cv = layer_in
-        x, ck, cv = _layer_step(model, x, lp, ck, cv, new_len, positions,
-                                flash_decode=flash_decode)
-        return x, (ck, cv)
+    if paged:
+        def paged_scan(carry, layer_in):
+            x = carry
+            lp, ck, cv, ks, vs = layer_in
+            x, ck, cv, ks, vs = _layer_step(
+                model, x, lp, ck, cv, new_len, positions,
+                flash_decode=flash_decode,
+                paged=(cache.page_table, ks, vs))
+            return x, (ck, cv, ks, vs)
 
-    x, (ck, cv) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
+        x, (ck, cv, ks, vs) = lax.scan(
+            paged_scan, x, (params["layers"], cache.k, cache.v,
+                            cache.k_scale, cache.v_scale))
+        new_cache = PagedKVCache(k=ck, v=cv, k_scale=ks, v_scale=vs,
+                                 page_table=cache.page_table, length=new_len)
+    else:
+        def scan_fn(carry, layer_in):
+            x = carry
+            lp, ck, cv = layer_in
+            x, ck, cv = _layer_step(model, x, lp, ck, cv, new_len, positions,
+                                    flash_decode=flash_decode)
+            return x, (ck, cv)
+
+        x, (ck, cv) = lax.scan(scan_fn,
+                               x, (params["layers"], cache.k, cache.v))
+        new_cache = KVCache(k=ck, v=cv, length=new_len)
     if last_token_head:
         x = x[:, -1:] if last_index is None else \
             lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
     logits = _decode_head(model, params, x)
-    return logits, KVCache(k=ck, v=cv, length=new_len)
+    return logits, new_cache
 
 
 class GenCarry(NamedTuple):

@@ -71,14 +71,44 @@ def roofline_peaks(device=None) -> tuple:
 
 
 # ------------------------------------------------------------------ ledger
-def kv_cache_bytes(model_cfg, slots: int, max_len: int, dtype) -> dict:
+def kv_cache_bytes(model_cfg, slots: int, max_len: int, dtype, *,
+                   page_size: int = 0, pool_pages: int = 0,
+                   kv_quant_bits: int = 0) -> dict:
     """KV-cache byte breakdown for the slot engine's ONE persistent cache,
     from the same :func:`~..inference.decode.cache_layout` the allocator
-    uses (k + v buffers)."""
+    uses (k + v buffers).
+
+    ``page_size > 0`` accounts the pooled page layout instead: the
+    resident total is the pool (+ the fp32 scale planes when the pool is
+    int8), ``per_token_bytes`` is what one cached token actually costs —
+    the figure the int8-KV lever halves — and ``page_bytes`` is the unit
+    the operator sizes the pool in (docs/OPERATIONS.md)."""
     import jax.numpy as jnp
 
     from ..inference.decode import cache_layout
 
+    if page_size > 0:
+        shape, dt = cache_layout(model_cfg, slots, max_len, dtype,
+                                 page_size=page_size, pages=pool_pages)
+        if kv_quant_bits == 8:
+            itemsize = 1
+            scale_bytes = 2 * int(math.prod(shape[:-1])) * 4   # f32 scales
+        else:
+            itemsize = jnp.dtype(dt).itemsize
+            scale_bytes = 0
+        pool_bytes = 2 * int(math.prod(shape)) * itemsize
+        total = pool_bytes + scale_bytes
+        page_bytes = total // max(1, pool_pages)
+        per_slot = page_bytes * (max_len // page_size)
+        return {"total_bytes": total, "per_slot_bytes": per_slot,
+                "per_token_bytes": page_bytes // page_size,
+                "itemsize": itemsize, "slots": slots, "max_len": max_len,
+                "shape": list(shape),
+                "dtype": "int8" if kv_quant_bits == 8 else
+                str(jnp.dtype(dt)),
+                "page_size": page_size, "pool_pages": pool_pages,
+                "page_bytes": page_bytes, "scale_bytes": scale_bytes,
+                "kv_quant_bits": kv_quant_bits}
     shape, dt = cache_layout(model_cfg, slots, max_len, dtype)
     itemsize = jnp.dtype(dt).itemsize
     total = 2 * int(math.prod(shape)) * itemsize
@@ -86,13 +116,19 @@ def kv_cache_bytes(model_cfg, slots: int, max_len: int, dtype) -> dict:
     return {"total_bytes": total, "per_slot_bytes": per_slot,
             "per_token_bytes": per_slot // max_len,
             "itemsize": itemsize, "slots": slots, "max_len": max_len,
-            "shape": list(shape), "dtype": str(jnp.dtype(dt))}
+            "shape": list(shape), "dtype": str(jnp.dtype(dt)),
+            "page_size": 0, "pool_pages": 0, "page_bytes": 0,
+            "scale_bytes": 0, "kv_quant_bits": 0}
 
 
 def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
                cache_dtype, temp_bytes: Optional[int] = None,
                limit_bytes: Optional[int] = None,
-               registry: Optional[MetricsRegistry] = None) -> dict:
+               registry: Optional[MetricsRegistry] = None,
+               page_size: int = 0, pool_pages: int = 0,
+               kv_quant_bits: int = 0,
+               pages_used: Optional[int] = None,
+               pages_free: Optional[int] = None) -> dict:
     """Decompose the HBM budget of a serving config into its components.
 
     ``params`` is the engine's (possibly WOQ-quantized) tree — weights
@@ -107,7 +143,9 @@ def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
 
     weights = int(quantized_bytes(params))
     stream = int(decode_weight_bytes(params))
-    kv = kv_cache_bytes(model_cfg, slots, max_len, cache_dtype)
+    kv = kv_cache_bytes(model_cfg, slots, max_len, cache_dtype,
+                        page_size=page_size, pool_pages=pool_pages,
+                        kv_quant_bits=kv_quant_bits)
     if limit_bytes is None:
         from ..platform.accelerator import get_accelerator
 
@@ -130,11 +168,29 @@ def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
         "headroom_bytes": None,
         "projected_max_slots": None,
         "projected_max_context": None,
+        # paged decomposition: pool pages used/free at their byte cost —
+        # the live occupancy truth replacing the contiguous estimate
+        # (all zero/None on the contiguous path)
+        "kv_page_size": kv["page_size"],
+        "kv_pool_pages": kv["pool_pages"],
+        "kv_page_bytes": kv["page_bytes"],
+        "kv_scale_bytes": kv["scale_bytes"],
+        "kv_quant_bits": kv["kv_quant_bits"],
+        "kv_pool_used_pages": pages_used,
+        "kv_pool_free_pages": pages_free,
+        "kv_pool_used_bytes": (pages_used * kv["page_bytes"]
+                               if pages_used is not None else None),
+        "kv_pool_free_bytes": (pages_free * kv["page_bytes"]
+                               if pages_free is not None else None),
     }
     if limit_bytes:
         free_for_kv = limit_bytes - weights - (temp_bytes or 0)
         out["headroom_bytes"] = limit_bytes - known
-        if kv["per_slot_bytes"] > 0:
+        if page_size > 0 and kv["page_bytes"] > 0:
+            per_slot_pages = max_len // page_size
+            out["projected_max_slots"] = max(
+                0, free_for_kv // (kv["page_bytes"] * per_slot_pages))
+        elif kv["per_slot_bytes"] > 0:
             out["projected_max_slots"] = max(
                 0, free_for_kv // kv["per_slot_bytes"])
         if kv["per_token_bytes"] > 0 and slots > 0:
@@ -312,14 +368,18 @@ def _sum_or_none(d: dict, keys) -> Optional[int]:
 def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     workload: Optional[dict] = None,
                     occupancy_avg: Optional[float] = None,
-                    meta: Optional[dict] = None) -> dict:
+                    meta: Optional[dict] = None,
+                    pages: Optional[dict] = None) -> dict:
     """Compose ledger + census + workload into the ranked what-if advisor.
 
     Every lever's score is the estimated fraction of its bounding
     resource it would save ON THE OBSERVED TRAFFIC — comparable across
     levers, honest about what was actually measured (unmeasured inputs
     degrade the lever to score 0 with a stated reason, they never
-    invent a payoff)."""
+    invent a payoff). ``pages`` (the paged engine's
+    ``PagePool.snapshot()``) closes the loop: levers the paged cache has
+    ALREADY pulled report achieved savings next to the projection, so
+    the report distinguishes "would save" from "is saving"."""
     levers = []
 
     # Prefix sharing: the measured shared-prefix fraction IS the fraction
@@ -327,15 +387,27 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
     # would have skipped on this traffic.
     overlap = (workload or {}).get("prefix_overlap")
     dedup = (workload or {}).get("dedupable_prefill_tokens")
+    prefix_est = {"prefill_tokens_saved": dedup,
+                  "shared_prefix_fraction": overlap}
+    why_prefix = ("measured shared-prefix token fraction of admitted "
+                  "prompts — the prefill work a prefix cache skips"
+                  if overlap is not None else
+                  "no workload analytics measured (serving.workload off)")
+    if pages is not None and pages.get("prefix_sharing"):
+        prefix_est["achieved"] = {
+            "prefill_tokens_saved": pages.get("prefill_tokens_saved"),
+            "tokens_saved_fraction": pages.get("tokens_saved_fraction"),
+            "shared_page_acquires": pages.get("shared_page_acquires"),
+            "prefix_hit_rate": pages.get("prefix_hit_rate"),
+            "cow_copies": pages.get("cow_copies"),
+        }
+        why_prefix += ("; paged cache ACTIVE — achieved savings reported "
+                       "alongside the estimator's projection")
     levers.append({
         "name": LEVER_PREFIX,
         "score": float(overlap) if overlap is not None else 0.0,
-        "estimate": {"prefill_tokens_saved": dedup,
-                     "shared_prefix_fraction": overlap},
-        "why": ("measured shared-prefix token fraction of admitted "
-                "prompts — the prefill work a prefix cache skips"
-                if overlap is not None else
-                "no workload analytics measured (serving.workload off)"),
+        "estimate": prefix_est,
+        "why": why_prefix,
     })
 
     # int8 KV: decode is bandwidth-bound; the step's byte budget is the
@@ -365,6 +437,19 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         why_kv = ("byte-ratio bound on the decode step: streamed weights "
                   "+ live KV read at measured occupancy/context, KV "
                   f"shrunk {itemsize}x to int8")
+    if ledger.get("kv_quant_bits") == 8:
+        # int8 KV is ON: the per-token bytes in the ledger ARE the
+        # achieved figure; report them next to the fp equivalent so the
+        # report shows the realized shrink, and zero the projection (the
+        # lever is already pulled)
+        kv_est["achieved"] = {
+            "kv_bytes_per_token": per_tok,
+            "kv_scale_bytes": ledger.get("kv_scale_bytes"),
+            "kv_quant_bits": 8,
+        }
+        kv_score = 0.0
+        why_kv = ("int8 KV ACTIVE — ledger per-token KV bytes are the "
+                  "achieved (quantized) cost; nothing further to project")
     levers.append({"name": LEVER_KV_QUANT, "score": float(kv_score),
                    "estimate": kv_est, "why": why_kv})
 
@@ -406,6 +491,7 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         "workload": workload,
         "ledger": ledger,
         "census": census,
+        "pages": pages,
         "advisor": {"levers": levers,
                     "ranked": [d["name"] for d in levers]},
     }
@@ -431,7 +517,10 @@ _REQUIRED_LEDGER_KEYS = (
     "weights_bytes", "weights_stream_bytes_per_step", "kv_bytes",
     "kv_per_slot_bytes", "kv_per_token_bytes", "cache_itemsize",
     "temp_bytes", "total_bytes", "limit_bytes", "headroom_bytes",
-    "projected_max_slots", "projected_max_context")
+    "projected_max_slots", "projected_max_context",
+    # paged decomposition (zero/None on the contiguous path)
+    "kv_page_size", "kv_pool_pages", "kv_page_bytes", "kv_quant_bits",
+    "kv_pool_used_pages", "kv_pool_free_pages")
 
 
 def validate_capacity_report(report: dict) -> list:
@@ -474,7 +563,7 @@ def validate_capacity_report(report: dict) -> list:
     elif census is not None and not isinstance(
             census.get("programs", {}), dict):
         errs.append("census.programs is not a dict")
-    for k in ("workload", "census"):
+    for k in ("workload", "census", "pages"):
         if k not in report:
             errs.append(f"missing {k!r} section (null is fine)")
     return errs

@@ -47,6 +47,24 @@ class QueueFullError(RuntimeError):
         self.max_queue = max_queue
 
 
+class PagePoolExhausted(QueueFullError):
+    """``submit()`` rejected a request the paged KV pool can never hold:
+    its worst-case page count (``ceil((prompt + max_new - 1) /
+    page_size)``, assuming zero prefix sharing — shared pages can be
+    evicted out from under a queued request, so admission must not bet
+    on them) exceeds the pool's usable pages. Status ``SHED``, like every
+    admission refusal; a TRANSIENTLY full pool never raises — the
+    request waits at the queue head and admits after a retirement frees
+    pages. Subclasses :class:`QueueFullError` so existing backpressure
+    handlers shed it the same way."""
+
+    def __init__(self, message: str, pages_needed: int | None = None,
+                 pages_usable: int | None = None):
+        super().__init__(message)
+        self.pages_needed = pages_needed
+        self.pages_usable = pages_usable
+
+
 class NonFiniteLossError(RuntimeError):
     """The training-side sentinel: raised after K consecutive bad optimizer
     steps (fp16 overflow skips, or non-finite loss at a report boundary)

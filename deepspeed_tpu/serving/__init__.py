@@ -9,11 +9,14 @@ bit-identical to single-request ``generate()`` with the same request seed
 — see docs/SERVING.md.
 """
 
-from ..resilience.guards import QueueFullError, RequestStatus
+from ..resilience.guards import PagePoolExhausted, QueueFullError, \
+    RequestStatus
 from .engine import ServingEngine
+from .pages import PagePool, RadixPrefixTree, init_paged_slots
 from .scheduler import ChunkPlan, Request, Scheduler, plan_chunks
 from .slots import init_slots, insert_request
 
 __all__ = ["ServingEngine", "Scheduler", "Request", "ChunkPlan",
            "plan_chunks", "init_slots", "insert_request",
-           "RequestStatus", "QueueFullError"]
+           "PagePool", "RadixPrefixTree", "init_paged_slots",
+           "RequestStatus", "QueueFullError", "PagePoolExhausted"]

@@ -44,6 +44,7 @@ from ..observability.tracing import ServingStats
 from ..resilience.chaos import ChaosMonkey
 from ..resilience.guards import QueueFullError, RequestStatus
 from ..utils.logging import warning_once
+from .pages import PagePool, hydrate_cache, init_paged_slots, insert_paged
 from .scheduler import Request, Scheduler
 from .slots import init_slots, insert_request
 
@@ -146,13 +147,35 @@ class ServingEngine:
                     slo.compile_storm_threshold, slo.compile_storm_window,
                     slo.compile_storm_grace)
         self._request_logs: list = []
+        # ---- paged KV cache (serving/pages.py, docs/SERVING.md): page
+        # pool + radix prefix tree + host page-table mirror. Disabled
+        # (page_size=0, the default) builds none of it — the engine is
+        # bit-for-bit the contiguous-slot engine, same program set.
+        self._paged = self.cfg.page_size > 0
+        self.pool: Optional[PagePool] = None
+        self._table = None
+        self._table_dirty = False
+        if self._paged:
+            self.pool = PagePool(self.cfg.pool_pages, self.cfg.page_size,
+                                 self.cfg.max_len,
+                                 registry=self.stats.registry,
+                                 prefix_sharing=self.cfg.prefix_sharing)
+            # host-authoritative page tables, mirrored into the carry on
+            # change (insert seats a row, retirement clears one): steady
+            # full-slot decode uploads nothing
+            self._table = np.zeros(
+                (self.cfg.slots, self.pool.pages_per_slot), np.int32)
+            if self.flight is not None:
+                # stall dumps show the pool at the moment of the stall
+                self.flight.add_snapshot_provider("pages",
+                                                  self.pool.snapshot)
         self.sched = Scheduler(self.cfg.slots, self.cfg.max_len,
                                self.cfg.prefill_chunk,
                                max_queue=self.cfg.max_queue,
                                eos_token_id=self._eos, stats=self.stats,
                                ttft_deadline_s=self.cfg.ttft_deadline_s,
                                total_deadline_s=self.cfg.total_deadline_s,
-                               spans=self.spans)
+                               spans=self.spans, pages=self.pool)
         self._programs: OrderedDict = OrderedDict()
         self.compiles = 0        # program builds — bounded in steady state
         # finished requests awaiting pickup, BOUNDED (oldest evicted): a
@@ -174,9 +197,28 @@ class ServingEngine:
         self._last_stall_iter: Optional[int] = None
         self._iterations = 0
         with self.engine.mesh:
-            self._state = self._prog("init_slots", lambda: jax.jit(
-                lambda: init_slots(mcfg, self.cfg.slots, self.cfg.max_len,
-                                   engine.compute_dtype)))()
+            if self._paged:
+                self._state = self._prog("init_slots", lambda: jax.jit(
+                    lambda: init_paged_slots(
+                        mcfg, self.cfg.slots, self.cfg.max_len,
+                        self.cfg.page_size, self.cfg.pool_pages,
+                        engine.compute_dtype, self.cfg.kv_quant_bits)))()
+            else:
+                self._state = self._prog("init_slots", lambda: jax.jit(
+                    lambda: init_slots(mcfg, self.cfg.slots,
+                                       self.cfg.max_len,
+                                       engine.compute_dtype)))()
+
+    def _flush_table(self) -> None:
+        """Mirror the host page tables into the decode carry when they
+        changed (a row seated at insert, or cleared at retirement before
+        its pages can be reused). A handful of int32s per event — steady
+        full-slot decode uploads nothing."""
+        if self._table_dirty:
+            c = self._state.cache
+            self._state = self._state._replace(
+                cache=c._replace(page_table=jnp.asarray(self._table)))
+            self._table_dirty = False
 
     # ----------------------------------------------------------- programs
     def _prog(self, key, build):
@@ -297,6 +339,11 @@ class ServingEngine:
         if self._any_deadlines:
             finished += self._expire_deadlines()
         with self.engine.mesh:
+            if self._paged:
+                # retired rows cleared last iteration must reach the
+                # device BEFORE their pages can be reused by this
+                # iteration's admission or written by this decode step
+                self._flush_table()
             # admission: start the head-of-queue request's prefill
             if self._prefill is None:
                 req = self.sched.pop_next()
@@ -309,6 +356,16 @@ class ServingEngine:
                         lambda: init_cache(self.model.cfg, 1,
                                            self.cfg.max_len,
                                            self.engine.compute_dtype)))()
+                    alloc = req.page_alloc
+                    if alloc is not None and alloc.hydrate_pages > 0:
+                        # prefix sharing: gather the shared pages into
+                        # the prefill cache ONCE; the chunk plan then
+                        # recomputes only the unshared suffix
+                        hyd = self._prog("hydrate", lambda: jax.jit(
+                            hydrate_cache, donate_argnums=(1,)))
+                        cache = hyd(self._state, cache,
+                                    jnp.asarray(alloc.hydrate_row),
+                                    jnp.int32(alloc.hydrate_pages))
                     self._prefill = (req, self.sched.plan(req), 0, cache,
                                      per_request_keys([req.seed]))
             # prefill lane: one bucket-shaped chunk per iteration
@@ -412,6 +469,16 @@ class ServingEngine:
         return finished
 
     def _store_result(self, req: Request) -> None:
+        if self._paged and req.slot >= 0 \
+                and self.sched.running.get(req.slot) is None:
+            # neutralize the retired slot's page-table row (scratch) so
+            # its freed pages can be handed to the next admission; the
+            # flush lands before any device work next iteration. Guard on
+            # the slot being EMPTY, not merely not-ours: a successor
+            # placed into this slot within the same step already seated
+            # its own row, which must not be zeroed under it
+            self._table[req.slot] = 0
+            self._table_dirty = True
         if self.workload is not None:
             self.workload.on_retire(req)
         if self._request_logs or self.flight is not None:
@@ -488,9 +555,23 @@ class ServingEngine:
         slot = self.sched.place(req, first_tok)
         # donate only the slot state: the batch-1 prefill buffers have
         # different shapes and could never alias the slot cache anyway
-        ins = self._prog("insert", lambda: jax.jit(
-            insert_request, donate_argnums=(0,)))
-        self._state = ins(self._state, jnp.int32(slot), pf)
+        if self._paged:
+            alloc = req.page_alloc
+            self._table[slot] = alloc.row
+            self._table_dirty = True
+            self._flush_table()
+            ins = self._prog("insert", lambda: jax.jit(
+                insert_paged, donate_argnums=(0,)))
+            self._state = ins(self._state, jnp.int32(slot), pf,
+                              jnp.asarray(alloc.row),
+                              jnp.int32(alloc.shared))
+            # the prompt's blocks are in the pool now: index them for
+            # future sharing and release the copy-on-write source pin
+            self.pool.on_inserted(req.rid, req.prompt)
+        else:
+            ins = self._prog("insert", lambda: jax.jit(
+                insert_request, donate_argnums=(0,)))
+            self._state = ins(self._state, jnp.int32(slot), pf)
         return []
 
     def begin_drain(self) -> None:
@@ -606,6 +687,8 @@ class ServingEngine:
         out = {"compiles": self.compiles, **self.stats.snapshot()}
         if self.workload is not None:
             out["workload"] = self.workload.snapshot()
+        if self._paged:
+            out["pages"] = self.pool.snapshot()
         return out
 
     # ----------------------------------------------------------- capacity
@@ -658,14 +741,26 @@ class ServingEngine:
     def hbm_ledger(self, temp_bytes: Optional[int] = None) -> dict:
         """The live HBM budget decomposed (weights / KV / temp) with
         projected headroom, as ``Memory/ledger_*`` gauges in the serving
-        registry — see :func:`~..observability.capacity.hbm_ledger`."""
+        registry — see :func:`~..observability.capacity.hbm_ledger`.
+        On the paged engine the KV term is the page pool (int8 + scale
+        planes when KV quantization is on) and the ledger carries the
+        live used/free page decomposition instead of the contiguous
+        estimate."""
         from ..observability.capacity import hbm_ledger
 
+        paged_kw = {}
+        if self._paged:
+            snap = self.pool.snapshot()
+            paged_kw = {"page_size": self.cfg.page_size,
+                        "pool_pages": self.cfg.pool_pages,
+                        "kv_quant_bits": self.cfg.kv_quant_bits,
+                        "pages_used": snap["used_pages"],
+                        "pages_free": snap["free_pages"]}
         return hbm_ledger(
             params=self.engine.params, model_cfg=self.model.cfg,
             slots=self.cfg.slots, max_len=self.cfg.max_len,
             cache_dtype=self.engine.compute_dtype, temp_bytes=temp_bytes,
-            registry=self.stats.registry)
+            registry=self.stats.registry, **paged_kw)
 
     def capacity_report(self, path=None, census: bool = True) -> dict:
         """The capacity advisor: workload analytics + HBM ledger + program
@@ -694,9 +789,12 @@ class ServingEngine:
         wl = self.workload.snapshot() if self.workload is not None else None
         rep = capacity_report(
             ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
+            pages=self.pool.snapshot() if self._paged else None,
             meta={"job": "serving", "slots": self.cfg.slots,
                   "max_len": self.cfg.max_len,
                   "prefill_chunk": self.cfg.prefill_chunk,
+                  "page_size": self.cfg.page_size,
+                  "kv_quant_bits": self.cfg.kv_quant_bits,
                   "iterations": self._iterations,
                   "compiles": self.compiles})
         if path is not None:

@@ -72,19 +72,30 @@ def _pow2_ceil(n: int) -> int:
     return p
 
 
-def plan_chunks(prompt: np.ndarray, chunk: int) -> list:
+def plan_chunks(prompt: np.ndarray, chunk: int, skip: int = 0) -> list:
     """Split one prompt into bucket-shaped prefill chunks.
 
     Full ``chunk``-size chunks cover the head of the prompt; the residual
     runs as the smallest power-of-two bucket >= max(residual, 8), via
-    overlap when the prompt affords it, else right-padding."""
+    overlap when the prompt affords it, else right-padding.
+
+    ``skip`` (prefix sharing, serving/pages.py) drops the first ``skip``
+    tokens from the plan: their KV is hydrated from shared pool pages, so
+    only the suffix is recomputed. Chunk shapes stay in the same bucket
+    set regardless of ``skip`` — sharing never compiles a new program —
+    and the final overlap bucket may rewind INTO the hydrated region,
+    rewriting bit-identical KV (the chunked==whole prefill oracle)."""
     prompt = np.asarray(prompt, np.int32).reshape(-1)
     P = len(prompt)
     if P < 1:
         raise ValueError("empty prompt")
-    k = (P - 1) // chunk                 # full chunks before the residual
-    r = P - k * chunk                    # residual, in (0, chunk]
-    plans = [ChunkPlan(start=i * chunk, ids=prompt[i * chunk:(i + 1) * chunk])
+    if not 0 <= skip < P:
+        raise ValueError(f"skip={skip} outside [0, {P})")
+    Q = P - skip                         # tokens actually recomputed
+    k = (Q - 1) // chunk                 # full chunks before the residual
+    r = Q - k * chunk                    # residual, in (0, chunk]
+    plans = [ChunkPlan(start=skip + i * chunk,
+                       ids=prompt[skip + i * chunk:skip + (i + 1) * chunk])
              for i in range(k)]
     b = max(_MIN_BUCKET, _pow2_ceil(r))
     if P >= b:        # overlap: recompute the last b prompt tokens
@@ -120,6 +131,10 @@ class Request:
     error: str = ""
     deadline_ttft: Optional[float] = None
     deadline_total: Optional[float] = None
+    # paged-KV admission plan (serving/pages.py PageAllocation): the
+    # slot's page-table row, shared-prefix skip, and hydrate plan. None
+    # on the contiguous path.
+    page_alloc: Optional[object] = None
 
     @property
     def prompt_len(self) -> int:
@@ -150,12 +165,18 @@ class Scheduler:
                  stats: Optional[ServingStats] = None,
                  ttft_deadline_s: float = 0.0,
                  total_deadline_s: float = 0.0,
-                 spans: "Optional[_spans.SpanRecorder]" = None):
+                 spans: "Optional[_spans.SpanRecorder]" = None,
+                 pages=None):
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
         self.max_queue = max_queue
         self.eos_token_id = eos_token_id
+        # paged-KV pool (serving/pages.py PagePool): admission consults
+        # the prefix tree and takes page refs; every terminal path
+        # releases them. None = contiguous slot cache, nothing paged.
+        self.pages = pages
+        self._defer_key = None   # (rid, pool generation) of a failed admit
         self.stats = stats if stats is not None else ServingStats()
         self.ttft_deadline_s = float(ttft_deadline_s)
         self.total_deadline_s = float(total_deadline_s)
@@ -187,6 +208,15 @@ class Scheduler:
             raise QueueFullError(
                 f"serving queue full ({self.max_queue}); apply backpressure",
                 queue_depth=len(self.queue), max_queue=self.max_queue)
+        if self.pages is not None:
+            # typed PagePoolExhausted (status SHED) when the pool could
+            # NEVER cover this request's worst-case pages — a transient
+            # shortage instead defers at the queue head (pop_next)
+            try:
+                self.pages.check_submit(len(prompt), int(max_new))
+            except QueueFullError:
+                self.stats.on_shed(len(self.queue))
+                raise
         req = Request(rid=self._next_rid, prompt=prompt, max_new=int(max_new),
                       seed=int(seed))
         self._next_rid += 1
@@ -204,10 +234,29 @@ class Scheduler:
 
     # ----------------------------------------------------------- admission
     def pop_next(self) -> Optional[Request]:
-        """Head-of-queue request to start prefilling, if a slot is free.
-        The engine guarantees at most one prefill in flight."""
+        """Head-of-queue request to start prefilling, if a slot is free —
+        and, on the paged path, if the page pool can cover its worst-case
+        pages right now (admission consults the prefix tree; a transient
+        shortage leaves the head queued until a retirement frees pages,
+        so a mid-decode pool OOM is impossible by construction). The
+        engine guarantees at most one prefill in flight."""
         if not self.queue or not self.free:
             return None
+        if self.pages is not None:
+            head = self.queue[0]
+            # retry gate: a failed try_admit re-runs the full tree match
+            # + eviction walk, so only retry once admission prospects
+            # changed (a release freed pages / new prefixes registered)
+            key = (head.rid, self.pages.generation)
+            if key == self._defer_key:
+                return None
+            alloc = self.pages.try_admit(head.prompt, head.max_new,
+                                         head.rid)
+            if alloc is None:
+                self._defer_key = key
+                return None          # pool transiently full: FIFO holds
+            self._defer_key = None
+            head.page_alloc = alloc
         req = self.queue.popleft()
         admit_t = self.stats.on_admit(len(self.queue), submit_t=req.submit_t)
         req.admit_t = admit_t
@@ -218,7 +267,15 @@ class Scheduler:
         return req
 
     def plan(self, req: Request) -> list:
-        return plan_chunks(req.prompt, self.prefill_chunk)
+        skip = req.page_alloc.skip if req.page_alloc is not None else 0
+        return plan_chunks(req.prompt, self.prefill_chunk, skip=skip)
+
+    def _release_pages(self, req: Request) -> None:
+        """Every terminal path funnels here: drop the request's page
+        refcounts (shared pages survive for future sharing via their
+        tree reference; private pages free immediately)."""
+        if self.pages is not None and req.page_alloc is not None:
+            self.pages.release(req.rid)
 
     def place(self, req: Request, first_tok: int) -> int:
         """Prefill finished: record the first token, occupy a slot."""
@@ -239,6 +296,7 @@ class Scheduler:
         req.tokens.append(int(first_tok))
         req.finish_t = self.stats.on_retire(len(req.tokens),
                                             req.first_token_t)
+        self._release_pages(req)
         self._span_retire(req)
         return req
 
@@ -274,6 +332,7 @@ class Scheduler:
                                                     req.first_token_t)
                 del self.running[slot]
                 self.free.append(slot)
+                self._release_pages(req)
                 finished.append(req)
                 self._span_retire(req)
         return finished
@@ -293,6 +352,7 @@ class Scheduler:
         req.status = status
         req.error = error
         req.finish_t = self.stats.on_abort(status)
+        self._release_pages(req)
         self._span_retire(req)
         return req
 
