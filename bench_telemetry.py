@@ -1,0 +1,167 @@
+"""Telemetry-plane bench + tier-1 gate (observability/server.py).
+
+``--smoke`` is the CPU tier-1 gate (wired via
+tests/unit/test_telemetry.py, same pattern as bench_serving.py):
+
+1. **zero-cost when off / zero-programs when on** — the same workload
+   runs on an engine with telemetry+goodput off and one with them on;
+   the compiled-program counts must be IDENTICAL (the telemetry plane
+   adds threads and clock reads, never programs — the serving
+   compile-freeze discipline extended to the ops surface);
+2. **scrapeable** — ``GET /metrics`` over the ephemeral-port server
+   parses with the existing exposition reader and carries the
+   ``Serve/*`` + goodput gauges;
+3. **byte-compatible** — the ``/metrics`` body equals the textfile the
+   Prometheus sink writes for the same registry events (shared
+   ``expfmt`` renderer, pinned end to end);
+4. **goodput sums** — productive + badput buckets == wall time within
+   1% on the real-clock run, with the compile window attributed via the
+   engine's compile counter (badput_compile > 0 on a cold engine).
+
+Prints one JSON line ending in "smoke-pass"; exits nonzero on failure.
+Without ``--smoke``: measures scrape latency under live traffic and
+writes TELEMETRY_BENCH.json.
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+from bench_serving import build, make_workload, run_continuous
+
+
+def _get(port, path, timeout=5.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+# ------------------------------------------------------------------ smoke
+def smoke():
+    from deepspeed_tpu.observability.expfmt import parse_prometheus_textfile
+    from deepspeed_tpu.observability.sinks import PrometheusTextfileSink
+
+    slots, max_len, chunk = 4, 64, 16
+    reqs = make_workload(24, seed=3)
+
+    # (1a) baseline: telemetry and goodput OFF — count compiled programs
+    _, _, _, srv_off = build(slots, max_len, chunk)
+    run_continuous(srv_off, reqs)
+    compiles_off = srv_off.compiles
+
+    # (1b) same workload, telemetry + goodput ON
+    _, _, _, srv = build(slots, max_len, chunk, goodput=True,
+                         telemetry={"enabled": True, "port": 0})
+    port = srv.telemetry.port
+    assert port > 0, "ephemeral bind failed"
+    run_continuous(srv, reqs)
+    assert srv.compiles == compiles_off, (
+        f"telemetry/goodput changed the program set: {srv.compiles} "
+        f"programs vs {compiles_off} with them off")
+
+    # (2) live scrape parses and carries the expected series
+    status, body = _get(port, "/metrics")
+    assert status == 200, f"/metrics -> {status}"
+    vals = parse_prometheus_textfile(body)
+    assert vals, "scrape parsed to nothing"
+    for need in ("dstpu_serve_retired", "dstpu_serve_goodput_frac",
+                 "dstpu_serve_ready"):
+        assert need in vals, f"{need} missing from /metrics ({len(vals)})"
+    assert vals["dstpu_serve_retired"] == len(reqs)
+
+    # (3) byte-compat: the sink's textfile for the same registry events
+    # must equal the /metrics body (shared expfmt renderer)
+    import tempfile
+    from pathlib import Path
+
+    status, body2 = _get(port, "/metrics")
+    reg = srv.stats.registry
+    step = int(reg.counter("Serve/iterations").value)
+    with tempfile.TemporaryDirectory() as td:
+        sink = PrometheusTextfileSink({"output_path": td,
+                                       "job_name": "smoke"})
+        sink.write_events(reg.to_events(step))
+        sink.flush()
+        file_text = (Path(td) / "smoke.prom").read_text()
+    assert file_text == body2, (
+        "textfile sink and /metrics drifted for the same registry "
+        "snapshot")
+
+    # (4) goodput decomposition sums to wall within 1%; the cold
+    # engine's compile window landed in badput_compile
+    status, gtext = _get(port, "/goodput")
+    assert status == 200, f"/goodput -> {status}"
+    g = json.loads(gtext)
+    total = g["productive_s"] + g["badput_total_s"]
+    assert abs(total - g["wall_s"]) <= 0.01 * max(g["wall_s"], 1e-9), (
+        f"goodput buckets sum to {total}, wall is {g['wall_s']}")
+    assert g["badput_s"]["compile"] > 0, (
+        "cold engine shows no compile badput — compile-counter "
+        "attribution broke")
+    assert g["productive_s"] > 0
+
+    # probes answer with the k8s contract
+    assert _get(port, "/healthz")[0] == 200
+    assert _get(port, "/readyz")[0] == 200
+
+    srv.close()
+    print(json.dumps({
+        "smoke": True, "requests": len(reqs),
+        "compiled_programs": compiles_off,
+        "goodput_frac": round(g["goodput_frac"], 4),
+        "badput_compile_s": round(g["badput_s"]["compile"], 4),
+        "metrics_series": len(vals),
+        "byte_compatible": True,
+        "verdict": "smoke-pass",
+    }))
+
+
+# ------------------------------------------------------------------- bench
+def bench(n=32, scrapes=50):
+    """Scrape latency + overhead picture under live traffic."""
+    slots, max_len, chunk = 4, 64, 16
+    reqs = make_workload(n, seed=5)
+    _, _, _, srv = build(slots, max_len, chunk, goodput=True,
+                         telemetry={"enabled": True, "port": 0})
+    port = srv.telemetry.port
+    run_continuous(srv, reqs)          # warm: compiles out of the way
+    lat = []
+    for _ in range(scrapes):
+        t0 = time.perf_counter()
+        status, body = _get(port, "/metrics")
+        lat.append(time.perf_counter() - t0)
+        assert status == 200
+    _, gtext = _get(port, "/goodput")
+    g = json.loads(gtext)
+    srv.close()
+    lat.sort()
+    return {
+        "scrapes": scrapes,
+        "scrape_p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+        "scrape_p99_ms": round(1e3 * lat[int(len(lat) * 0.99) - 1], 3),
+        "metrics_bytes": len(body),
+        "goodput": {k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in g.items() if not isinstance(v, dict)},
+        "badput_s": {k: round(v, 6) for k, v in g["badput_s"].items()},
+    }
+
+
+def main():
+    res = bench()
+    import os
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "TELEMETRY_BENCH.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        main()

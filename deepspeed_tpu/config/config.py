@@ -200,6 +200,17 @@ class ObservabilityConfig(ConfigModel):
     # subset): step_time_mad_k > 0 flags Train/step_time_s samples past
     # median + k*MAD into Train/step_time_regressions + flight markers.
     slo: dict[str, Any] = Field(default_factory=dict)
+    # Goodput/badput wall-time attribution (observability/goodput.py):
+    # Train/goodput_* gauges decomposing wall time into productive step
+    # dispatch vs badput (compile, inter-step idle, checkpoint commit,
+    # preemption). Two host clock reads per train_batch when on; False
+    # (default) builds no ledger.
+    goodput: bool = False
+    # Live telemetry server (observability.server.TelemetryConfig dict):
+    # {"enabled": true, "port": 0, "host": "127.0.0.1", "token": ...}.
+    # Off/absent = zero threads. Engines can also start it explicitly
+    # via engine.serve_telemetry(port=0).
+    telemetry: dict[str, Any] = Field(default_factory=dict)
 
 
 class CommsLoggerConfig(ConfigModel):

@@ -102,6 +102,22 @@ class ServingConfig:
     # (observability/capacity.py). Host-side only — zero new compiled
     # programs, zero device syncs. None = no analyzer built.
     workload: "object | None" = None
+    # Goodput/badput wall-time attribution (observability/goodput.py):
+    # decomposes elapsed wall time into productive decode/prefill vs
+    # badput buckets (compile, queue-empty idle, watchdog stall, drain,
+    # ...) as Serve/goodput_* gauges + the /goodput endpoint. Costs two
+    # host clock reads per iteration when on; False (default) builds no
+    # ledger — zero clock reads, zero programs.
+    goodput: bool = False
+    # Live telemetry & control plane
+    # (observability.server.TelemetryConfig | dict): an HTTP ops surface
+    # (/metrics /healthz /readyz /requests /capacity /goodput /flight +
+    # token-gated POST /drain /flight/dump /slo/reload) on a daemon
+    # thread, loopback-bound by default. None / enabled=False (default)
+    # builds nothing — zero threads, zero programs, zero syncs; the
+    # bench_serving --smoke compile freeze is the oracle. Engines can
+    # also start it explicitly via engine.serve_telemetry(port=0).
+    telemetry: "object | None" = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -155,6 +171,10 @@ class ServingConfig:
             from ..observability.workload import WorkloadConfig
 
             self.workload = WorkloadConfig.from_any(self.workload)
+        if self.telemetry is not None:
+            from ..observability.server import TelemetryConfig
+
+            self.telemetry = TelemetryConfig.from_any(self.telemetry)
 
     @classmethod
     def from_any(cls, cfg: "ServingConfig | dict | None") -> "ServingConfig":

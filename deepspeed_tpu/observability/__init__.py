@@ -2,24 +2,32 @@
 lifecycle spans, flight recorder, Perfetto export, SLO/anomaly
 detection, workload/capacity attribution (traffic analytics, HBM
 ledger, per-program cost census, capacity advisor), machine-readable
-sinks, and XLA profiler integration.
+sinks, XLA profiler integration, and the live telemetry plane
+(per-engine HTTP ops surface, goodput/badput wall-time ledger, fleet
+scrape aggregator).
 
 See ``docs/OBSERVABILITY.md`` for the metric namespace and runbook, and
-``python -m deepspeed_tpu.observability.doctor`` for file-based triage.
+``python -m deepspeed_tpu.observability.doctor`` for triage — file-based
+(``--dir``) or against a live engine (``--url``).
 """
 
 from .capacity import (ProgramCensus, capacity_report, hbm_ledger,
                        kv_cache_bytes, validate_capacity_report,
                        write_capacity_report)
+from .expfmt import exposition_from_events, render_exposition
 from .export import (RequestLogSink, request_record, to_chrome_trace,
                      validate_chrome_trace, write_chrome_trace)
+from .fleet_scrape import FleetScraper
 from .flight import (FlightRecorder, newest_flight_record,
                      read_flight_record)
+from .goodput import BADPUT_BUCKETS, GoodputLedger
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
                       get_registry)
 from .sinks import (JsonlSink, PrometheusTextfileSink,
                     format_prometheus_value, parse_prometheus_textfile,
                     prometheus_name)
+from .server import (TelemetryConfig, TelemetryHooks, TelemetryServer,
+                     flight_summary)
 from .slo import (CompileStormDetector, MedianMADDetector, SLOConfig,
                   SLOScorer)
 from .spans import SpanEvent, SpanRecorder
@@ -32,6 +40,10 @@ __all__ = [
     "get_registry",
     "JsonlSink", "PrometheusTextfileSink", "parse_prometheus_textfile",
     "prometheus_name", "format_prometheus_value",
+    "render_exposition", "exposition_from_events",
+    "GoodputLedger", "BADPUT_BUCKETS",
+    "TelemetryConfig", "TelemetryHooks", "TelemetryServer",
+    "flight_summary", "FleetScraper",
     "RequestRecord", "RequestTracer", "ServingStats",
     "SpanEvent", "SpanRecorder",
     "FlightRecorder", "newest_flight_record", "read_flight_record",

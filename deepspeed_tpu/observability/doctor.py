@@ -18,10 +18,21 @@ storm — something fired since the record was cut) or when any
 ``dstpu_*_burn`` SLO gauge in the latest .prom is above zero; 0 on a
 clean replica. ``--no-gate`` restores the always-0 report-only behavior.
 
+``--url http://host:port`` switches to **live mode**: instead of files,
+the doctor scrapes a running engine's telemetry plane
+(``observability/server.py``) — ``/metrics``, ``/healthz``, ``/readyz``,
+``/goodput``, the newest flight manifest via ``/flight`` — with the
+same gate semantics (burning SLO gauges or why-markers in the newest
+flight record exit nonzero). Endpoints the engine doesn't expose (no
+goodput ledger, no flight recorder, a training engine's missing
+``/requests``) degrade to a note, never an error; an entirely
+unreachable target is itself a gate finding.
+
 Usage::
 
     python -m deepspeed_tpu.observability.doctor [--dir ./monitor]
         [--flight-dir <dir>] [--requests N] [--no-gate]
+        [--url http://host:port] [--timeout S]
 
 Stdout is this module's interface (it is a CLI report tool, exempt from
 the bare-print lint like ``env_report.py``).
@@ -30,9 +41,11 @@ the bare-print lint like ``env_report.py``).
 from __future__ import annotations
 
 import argparse
+import json
 import math
 from collections import Counter as _Counter
 from pathlib import Path
+from typing import Optional
 
 
 def _newest(dirpath: Path, pattern: str):
@@ -53,6 +66,24 @@ def _fmt(v: float) -> str:
     return f"{v:g}" if isinstance(v, float) else str(v)
 
 
+def _print_metrics(vals: dict, where: str) -> list:
+    """Shared by file and live modes: print every metric (serving
+    first, then training, then the rest — a process that both trains
+    and serves shows both halves) and return the gate findings: every
+    SLO burn gauge currently above zero. One implementation so the two
+    modes cannot drift on what gates."""
+    shown: set[str] = set()
+    for prefix in ("dstpu_serve_", "dstpu_train_", ""):
+        for k, v in sorted(vals.items()):
+            if k.startswith(prefix) and k not in shown:
+                shown.add(k)
+                print(f"  {k:<44s} {_fmt(v)}")
+    return [f"SLO burn gauge {k} = {_fmt(v)} {where}"
+            for k, v in sorted(vals.items())
+            if k.endswith("_burn") and "_slo_" in k
+            and isinstance(v, float) and v > 0]
+
+
 def report_prometheus(d: Path) -> list:
     """Print the latest .prom; returns gate findings — every SLO burn
     gauge (``dstpu_*_burn``) currently above zero."""
@@ -64,18 +95,7 @@ def report_prometheus(d: Path) -> list:
         return []
     vals = parse_prometheus_textfile(prom.read_text())
     print(f"[prom] {prom} ({len(vals)} metrics)")
-    # every metric, serving first, then training, then the rest — a
-    # process that both trains and serves shows both halves
-    shown: set[str] = set()
-    for prefix in ("dstpu_serve_", "dstpu_train_", ""):
-        for k, v in sorted(vals.items()):
-            if k.startswith(prefix) and k not in shown:
-                shown.add(k)
-                print(f"  {k:<44s} {_fmt(v)}")
-    return [f"SLO burn gauge {k} = {_fmt(v)} in {prom.name}"
-            for k, v in sorted(vals.items())
-            if k.endswith("_burn") and "_slo_" in k
-            and isinstance(v, float) and v > 0]
+    return _print_metrics(vals, f"in {prom.name}")
 
 
 def report_requests(d: Path, limit: int) -> None:
@@ -186,6 +206,105 @@ def report_capacity(d: Path, levers: int = 4) -> None:
               f"score={score}  {lv.get('why') or ''}")
 
 
+# ----------------------------------------------------------- live (--url)
+def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
+    """(status, body) for a GET; (None, error-repr) when the target is
+    unreachable. 4xx/5xx return their status — live-mode triage treats
+    a 404 as "endpoint absent", not a failure."""
+    from urllib.error import HTTPError, URLError
+    from urllib.request import urlopen
+
+    try:
+        with urlopen(url, timeout=timeout) as r:
+            return int(r.status), r.read().decode("utf-8",
+                                                  errors="replace")
+    except HTTPError as e:
+        try:
+            return int(e.code), e.read().decode("utf-8", errors="replace")
+        except OSError:
+            return int(e.code), ""
+    except (URLError, OSError) as e:
+        return None, repr(e)
+
+
+def report_live(url: str, timeout: float = 3.0) -> list:
+    """Triage one LIVE engine over its telemetry endpoints; returns gate
+    findings with the same semantics as the file mode (burning SLO
+    gauges, why-markers in the newest flight record, plus: target
+    unreachable)."""
+    from .expfmt import parse_prometheus_textfile
+
+    url = url.rstrip("/")
+    findings: list = []
+    # ---- /metrics: the live analog of the newest .prom
+    code, body = _http_get(url + "/metrics", timeout)
+    if code is None:
+        print(f"[live] {url} unreachable ({body})")
+        return [f"telemetry target {url} unreachable"]
+    if code != 200:
+        print(f"[live] {url}/metrics -> {code}")
+    else:
+        vals = parse_prometheus_textfile(body)
+        print(f"[live] {url}/metrics ({len(vals)} metrics)")
+        findings += _print_metrics(vals, f"at {url}")
+    # ---- probes
+    for ep in ("/healthz", "/readyz"):
+        code, body = _http_get(url + ep, timeout)
+        if code is None:
+            print(f"[live] {ep} unreachable")
+            continue
+        try:
+            h = json.loads(body)
+        except json.JSONDecodeError:
+            h = {}
+        keys = ("state", "ready", "degraded", "queue_depth", "occupancy",
+                "pool_pressure", "global_steps")
+        brief = " ".join(f"{k}={h[k]}" for k in keys if k in h)
+        print(f"[live] {ep} -> {code} {brief}".rstrip())
+    # ---- /goodput: the wall-time decomposition
+    code, body = _http_get(url + "/goodput", timeout)
+    if code == 200:
+        try:
+            g = json.loads(body)
+        except json.JSONDecodeError:
+            g = {}
+        wall = g.get("wall_s")
+        frac = g.get("goodput_frac")
+        print(f"[goodput] wall={_fmt(wall) if wall is not None else '?'}s "
+              f"productive={_fmt(g.get('productive_s', 0.0))}s "
+              f"frac={_fmt(frac) if frac is not None else '?'}")
+        for b, v in sorted((g.get("badput_s") or {}).items()):
+            if v:
+                print(f"  badput_{b:<12s} {_fmt(v)}s")
+    elif code is not None:
+        print(f"[goodput] endpoint absent ({code}) — goodput ledger "
+              "disabled on this engine")
+    # ---- /flight: newest manifest + why-markers (the live flight gate)
+    code, body = _http_get(url + "/flight", timeout)
+    if code == 200:
+        try:
+            fl = json.loads(body)
+        except json.JSONDecodeError:
+            fl = {}
+        newest = fl.get("newest")
+        if newest:
+            mf = newest.get("manifest") or {}
+            print(f"[flight] newest {newest.get('path')} "
+                  f"reason={mf.get('reason')} events={mf.get('events')}")
+            names = [str(n) for n in newest.get("markers", [])]
+            if names:
+                findings.append(
+                    f"flight record at {url} contains why-marker(s): "
+                    + ", ".join(sorted(names)))
+        else:
+            print(f"[flight] recorder configured, no dumps yet "
+                  f"({len(fl.get('dumps', []))} taken)")
+    elif code is not None:
+        print(f"[flight] endpoint absent ({code}) — no flight recorder "
+              "on this engine")
+    return findings
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability.doctor",
@@ -202,13 +321,22 @@ def main(argv=None) -> int:
                     help="always exit 0 (report-only; the default exits "
                          "1 on why-markers / burning SLOs so CI and cron "
                          "can gate on this command)")
+    ap.add_argument("--url", default=None,
+                    help="triage a LIVE engine at this base URL "
+                         "(http://host:port) via its telemetry "
+                         "endpoints instead of reading files")
+    ap.add_argument("--timeout", type=float, default=3.0,
+                    help="per-endpoint timeout in live mode (default 3s)")
     args = ap.parse_args(argv)
-    d = Path(args.dir)
-    findings = report_prometheus(d)
-    report_requests(d, args.requests)
-    findings += report_flight(Path(args.flight_dir) if args.flight_dir
-                              else d)
-    report_capacity(d)
+    if args.url:
+        findings = report_live(args.url, timeout=args.timeout)
+    else:
+        d = Path(args.dir)
+        findings = report_prometheus(d)
+        report_requests(d, args.requests)
+        findings += report_flight(Path(args.flight_dir) if args.flight_dir
+                                  else d)
+        report_capacity(d)
     if findings:
         print(f"[gate] {len(findings)} finding(s):")
         for f in findings:

@@ -1,0 +1,117 @@
+"""Shared Prometheus exposition formatting: ONE renderer, two transports.
+
+The textfile sink (``sinks.PrometheusTextfileSink``) and the live
+``GET /metrics`` endpoint (``server.TelemetryServer``) must emit the
+*same bytes* for the same registry state — an operator who graduates
+from the node-exporter textfile handoff to a real scrape must not see
+metric names shift, HELP lines change, or non-finite spellings drift.
+Both paths therefore call :func:`render_exposition` on an identical
+``{sanitized_name: value}`` map; neither carries its own formatter, so
+they *cannot* drift (the round-trip is regression-pinned in
+``tests/unit/test_telemetry.py``).
+
+Contents:
+
+- :func:`prometheus_name` — metric name → legal Prometheus identifier;
+- :func:`format_prometheus_value` — exposition scalar spelling
+  (``+Inf`` / ``-Inf`` / ``NaN`` for non-finite values);
+- :func:`render_exposition` — the full textfile/scrape body (step gauge
+  first, then sorted metrics, each with ``# HELP`` / ``# TYPE`` lines);
+- :func:`exposition_from_events` — ``(name, value, step)`` event tuples
+  (``MetricsRegistry.to_events``) → exposition text, the one-call path
+  the HTTP endpoint uses;
+- :func:`parse_prometheus_textfile` — the tiny reader (tests + the
+  doctor CLI), label-tolerant so it also reads the fleet aggregator's
+  relabeled output.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional, Sequence
+
+_PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str, prefix: str = "dstpu") -> str:
+    """Metric name → legal Prometheus identifier (``Serve/ttft_s/p99`` →
+    ``dstpu_serve_ttft_s_p99``)."""
+    n = _PROM_BAD_CHARS.sub("_", name.strip()).strip("_").lower()
+    full = f"{prefix}_{n}" if prefix else n
+    if not _PROM_NAME_OK.match(full):
+        full = "_" + full
+    return full
+
+
+def format_prometheus_value(v: float) -> str:
+    """Exposition-format scalar: non-finite values spell ``+Inf`` /
+    ``-Inf`` / ``NaN`` (a bare ``nan``/``inf`` from ``%g`` is rejected by
+    strict scrapers)."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return f"{v:.10g}"
+
+
+def render_exposition(values: dict[str, float],
+                      source: Optional[dict[str, str]] = None,
+                      step: int = 0, prefix: str = "dstpu") -> str:
+    """The canonical exposition body: a ``<prefix>_step`` gauge first
+    (the step is its own gauge, NOT a label — a step label would mint a
+    new Prometheus series per metric per step and blow up TSDB head
+    cardinality), then every metric in sorted order with ``# HELP`` /
+    ``# TYPE`` lines. ``values`` keys are already-sanitized names;
+    ``source`` maps them back to the registry's original names for the
+    HELP text."""
+    source = source or {}
+    step_name = prometheus_name("step", prefix)
+    lines = [f"# HELP {step_name} deepspeed_tpu metric 'step'",
+             f"# TYPE {step_name} gauge",
+             f"{step_name} {int(step)}"]
+    for name in sorted(values):
+        lines.append(f"# HELP {name} deepspeed_tpu metric "
+                     f"{source.get(name, name)!r}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {format_prometheus_value(values[name])}")
+    return "\n".join(lines) + "\n"
+
+
+def exposition_from_events(events: Sequence[tuple],
+                           prefix: str = "dstpu") -> str:
+    """``(name, value, step)`` tuples → exposition text, via the exact
+    accumulation rule the textfile sink applies (last write per sanitized
+    name wins; the step gauge is the max step seen) — so a ``/metrics``
+    body rendered from ``registry.to_events(step)`` is byte-identical to
+    the textfile the sink would write from the same events."""
+    values: dict[str, float] = {}
+    source: dict[str, str] = {}
+    step = 0
+    for name, value, s in events:
+        pn = prometheus_name(name, prefix)
+        values[pn] = float(value)
+        source[pn] = name
+        step = max(step, int(s))
+    return render_exposition(values, source, step, prefix)
+
+
+_SAMPLE_LINE = re.compile(
+    r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)")
+
+
+def parse_prometheus_textfile(text: str) -> dict[str, float]:
+    """Tiny exposition-format reader (tests + doctors): name -> value.
+    Labeled samples (the fleet aggregator's output) key as
+    ``name{labels}`` so per-engine series stay distinct."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_LINE.match(line)
+        if m:
+            key = m.group(1) + (m.group(2) or "")
+            out[key] = float(m.group(3))
+    return out

@@ -1,0 +1,262 @@
+"""Fleet scrape aggregator: N live engines → one labeled exposition.
+
+The future SLO-aware router (ROADMAP "multi-replica serving fleet")
+needs exactly one signal surface: per-engine readiness, goodput, and
+SLO burn, merged and labeled so a dead replica is a *data point*
+(``dstpu_scrape_up{engine="..."} 0``), never an exception. This module
+is that surface, built on the per-engine telemetry servers
+(``server.py``):
+
+- :class:`FleetScraper` polls each target's ``/metrics`` (and
+  ``/healthz`` for the ready bit), relabels every sample with an
+  ``engine`` label, and rolls up fleet aggregates:
+
+  - ``dstpu_scrape_up{engine=...}``     1/0 per target;
+  - ``dstpu_scrape_latency_s{engine=}`` scrape round-trip;
+  - ``dstpu_fleet_engines`` / ``dstpu_fleet_up`` / ``dstpu_fleet_ready``;
+  - ``dstpu_fleet_goodput_frac`` — wall-weighted mean of per-engine
+    goodput fractions (an engine that has lived 10× longer carries 10×
+    the weight — a freshly restarted replica must not mask fleet-wide
+    badput);
+  - ``dstpu_fleet_slo_burn_max`` — the worst burning SLO anywhere (the
+    router's shed signal).
+
+- ``python -m deepspeed_tpu.observability.fleet_scrape --targets ...``
+  renders the merged exposition to stdout or ``--out <file>.prom``
+  (atomic rename — a concurrent textfile-collector scrape never reads a
+  torn file).
+
+Degradation contract: a dead/slow/garbled target contributes
+``scrape_up 0`` and drops out of the rollups; the aggregator itself
+never raises on target failure. ``fetch`` is injectable (tests fake the
+fleet without sockets), as is ``clock``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import time
+from pathlib import Path
+from typing import Callable, Optional
+from urllib.request import urlopen
+
+from .expfmt import format_prometheus_value, parse_prometheus_textfile
+
+_SLO_BURN = re.compile(r"_slo_.*_burn$")
+_LABEL_SAFE = re.compile(r"[^a-zA-Z0-9_.-]")
+
+
+def _default_fetch(url: str, timeout: float) -> str:
+    with urlopen(url, timeout=timeout) as r:   # nosec: operator-supplied
+        return r.read().decode("utf-8", errors="replace")
+
+
+def engine_label(target: str) -> str:
+    """Default ``engine`` label for a target URL: ``host:port`` with
+    exposition-hostile characters squashed."""
+    t = target.rstrip("/")
+    for prefix in ("http://", "https://"):
+        if t.startswith(prefix):
+            t = t[len(prefix):]
+    return _LABEL_SAFE.sub("_", t) or "engine"
+
+
+class FleetScraper:
+    """Poll N engine telemetry endpoints; merge + relabel + roll up.
+
+    ``targets`` are base URLs (``http://host:port``); ``labels`` (same
+    length, optional) overrides the derived ``engine`` label per
+    target. One :meth:`scrape` is one fleet pass — the result dict
+    feeds :meth:`render` (exposition text) and the router-to-be."""
+
+    def __init__(self, targets: list[str],
+                 labels: Optional[list[str]] = None,
+                 fetch: Optional[Callable[[str, float], str]] = None,
+                 timeout: float = 2.0,
+                 clock: Callable[[], float] = time.perf_counter):
+        if not targets:
+            raise ValueError("FleetScraper needs at least one target")
+        if labels is not None and len(labels) != len(targets):
+            raise ValueError(f"{len(labels)} labels for "
+                             f"{len(targets)} targets")
+        self.targets = [t.rstrip("/") for t in targets]
+        # explicit labels go through the same sanitizer as derived ones:
+        # a quote or backslash inside {engine="..."} would invalidate
+        # the whole merged exposition (one bad label must not blackhole
+        # the fleet's metrics); empty entries fall back like empty URLs
+        self.labels = ([_LABEL_SAFE.sub("_", str(lb)) or "engine"
+                        for lb in labels] if labels is not None
+                       else [engine_label(t) for t in self.targets])
+        if len(set(self.labels)) != len(self.labels):
+            raise ValueError(f"duplicate engine labels: {self.labels} — "
+                             "pass explicit distinct labels")
+        self.fetch = fetch if fetch is not None else _default_fetch
+        self.timeout = float(timeout)
+        self.clock = clock
+        self.scrapes = 0
+
+    # ------------------------------------------------------------ one pass
+    def scrape_target(self, target: str, label: str) -> dict:
+        """One target: ``/metrics`` + the ``/healthz`` ready bit. Any
+        failure — refused connection, timeout, garbage body — degrades
+        to ``up: False``; the exception never propagates."""
+        t0 = self.clock()
+        out: dict = {"target": target, "engine": label, "up": False,
+                     "latency_s": 0.0, "metrics": {}, "ready": None,
+                     "error": None}
+        try:
+            text = self.fetch(target + "/metrics", self.timeout)
+            out["metrics"] = parse_prometheus_textfile(text)
+            out["up"] = True
+        except Exception as e:   # degrade-per-target is the contract:
+            out["error"] = repr(e)   # a dead engine is a data point
+        out["latency_s"] = self.clock() - t0
+        if out["up"]:
+            try:
+                import json as _json
+
+                health = _json.loads(
+                    self.fetch(target + "/healthz", self.timeout))
+                out["ready"] = bool(health.get("ready", False))
+            except Exception:
+                # metrics answered but healthz didn't: fall back to the
+                # mirrored gauge (health() exports Serve/ready)
+                ready = out["metrics"].get("dstpu_serve_ready")
+                out["ready"] = bool(ready) if ready is not None else None
+        return out
+
+    def scrape(self) -> dict:
+        """One fleet pass over every target + the rollups. Targets are
+        polled CONCURRENTLY (one thread each, results in target order):
+        k dead pods timing out must cost one timeout, not k — a
+        sequential pass goes stale exactly when replicas are dying,
+        which is when the router needs the signal most."""
+        if len(self.targets) == 1:
+            engines = [self.scrape_target(self.targets[0], self.labels[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(self.targets)),
+                    thread_name_prefix="dstpu-fleet") as pool:
+                engines = list(pool.map(self.scrape_target, self.targets,
+                                        self.labels))
+        self.scrapes += 1
+        up = [e for e in engines if e["up"]]
+        # wall-weighted goodput: weight each engine's fraction by its
+        # ledger wall time (any *_goodput_wall_s / *_goodput_frac pair,
+        # serving or training)
+        wsum = fsum = 0.0
+        burn_max = None
+        for e in up:
+            frac = wall = None
+            for k, v in e["metrics"].items():
+                if k.endswith("_goodput_frac"):
+                    frac = v
+                elif k.endswith("_goodput_wall_s"):
+                    wall = v
+                if _SLO_BURN.search(k):
+                    burn_max = v if burn_max is None else max(burn_max, v)
+            if frac is not None and not math.isnan(frac):
+                w = wall if wall and wall > 0 else 1.0
+                wsum += w
+                fsum += frac * w
+        return {
+            "engines": engines,
+            "fleet": {
+                "engines": len(engines),
+                "up": len(up),
+                "ready": sum(1 for e in up if e["ready"]),
+                "goodput_frac": (fsum / wsum) if wsum > 0 else None,
+                "slo_burn_max": burn_max,
+            },
+        }
+
+    # -------------------------------------------------------------- render
+    def render(self, snap: Optional[dict] = None) -> str:
+        """Merged exposition: per-engine samples relabeled with
+        ``engine``, then the fleet rollups — the file/endpoint a single
+        Prometheus job scrapes instead of N."""
+        snap = snap if snap is not None else self.scrape()
+        lines = ["# deepspeed_tpu fleet scrape "
+                 f"({snap['fleet']['up']}/{snap['fleet']['engines']} up)"]
+        for e in snap["engines"]:
+            lab = f'{{engine="{e["engine"]}"}}'
+            lines.append(f"dstpu_scrape_up{lab} {1 if e['up'] else 0}")
+            lines.append(f"dstpu_scrape_latency_s{lab} "
+                         f"{format_prometheus_value(e['latency_s'])}")
+            for name, value in sorted(e["metrics"].items()):
+                if "{" in name:     # already-labeled sample (an engine
+                    continue        # proxying a fleet file): skip, never
+                    # nest label sets
+                lines.append(f"{name}{lab} "
+                             f"{format_prometheus_value(value)}")
+        fl = snap["fleet"]
+        lines.append(f"dstpu_fleet_engines {fl['engines']}")
+        lines.append(f"dstpu_fleet_up {fl['up']}")
+        lines.append(f"dstpu_fleet_ready {fl['ready']}")
+        if fl["goodput_frac"] is not None:
+            lines.append("dstpu_fleet_goodput_frac "
+                         f"{format_prometheus_value(fl['goodput_frac'])}")
+        if fl["slo_burn_max"] is not None:
+            lines.append("dstpu_fleet_slo_burn_max "
+                         f"{format_prometheus_value(fl['slo_burn_max'])}")
+        return "\n".join(lines) + "\n"
+
+    def write(self, path, snap: Optional[dict] = None) -> Path:
+        """Render to ``path`` atomically (tmp + rename, the textfile
+        sink's torn-scrape discipline)."""
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_name(p.name + ".tmp")
+        tmp.write_text(self.render(snap), encoding="utf-8")
+        os.replace(tmp, p)
+        return p
+
+
+def main(argv=None) -> int:
+    """CLI: one scrape pass (or a loop) over ``--targets``. Stdout is
+    this module's interface when ``--out`` is absent (exempt from the
+    bare-print lint like the doctor)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.observability.fleet_scrape",
+        description="Scrape N engine telemetry endpoints, merge their "
+                    "expositions with an engine label, roll up fleet "
+                    "goodput/readiness/SLO burn.")
+    ap.add_argument("--targets", required=True,
+                    help="comma-separated base URLs "
+                         "(http://host:port,...)")
+    ap.add_argument("--labels", default=None,
+                    help="comma-separated engine labels (default: "
+                         "derived host_port)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged exposition to this .prom "
+                         "file (atomic) instead of stdout")
+    ap.add_argument("--interval", type=float, default=0.0,
+                    help="loop every N seconds (default: one pass)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-target fetch timeout (default 2s)")
+    args = ap.parse_args(argv)
+    scraper = FleetScraper(
+        [t for t in args.targets.split(",") if t],
+        labels=([x for x in args.labels.split(",")]
+                if args.labels else None),
+        timeout=args.timeout)
+    while True:
+        snap = scraper.scrape()
+        if args.out:
+            scraper.write(args.out, snap)
+        else:
+            print(scraper.render(snap), end="")
+        if args.interval <= 0:
+            return 0 if snap["fleet"]["up"] == snap["fleet"]["engines"] \
+                else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,12 +19,19 @@ tooling wants something it can ``json.loads`` or scrape.
 from __future__ import annotations
 
 import json
-import math
 import os
-import re
 import time
 from pathlib import Path
 from typing import Callable, Sequence
+
+# Exposition formatting lives in expfmt.py (shared verbatim with the
+# live /metrics endpoint in server.py — the two outputs are
+# byte-compatible by construction); re-exported here for compatibility.
+from .expfmt import (format_prometheus_value, parse_prometheus_textfile,
+                     prometheus_name, render_exposition)
+
+__all__ = ["JsonlSink", "PrometheusTextfileSink", "prometheus_name",
+           "format_prometheus_value", "parse_prometheus_textfile"]
 
 
 class JsonlSink:
@@ -100,20 +107,6 @@ class JsonlSink:
         self._pending = 0
 
 
-_PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
-_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
-
-
-def prometheus_name(name: str, prefix: str = "dstpu") -> str:
-    """Metric name → legal Prometheus identifier (``Serve/ttft_s/p99`` →
-    ``dstpu_serve_ttft_s_p99``)."""
-    n = _PROM_BAD_CHARS.sub("_", name.strip()).strip("_").lower()
-    full = f"{prefix}_{n}" if prefix else n
-    if not _PROM_NAME_OK.match(full):
-        full = "_" + full
-    return full
-
-
 class PrometheusTextfileSink:
     """Latest-value gauge exporter in Prometheus exposition format.
 
@@ -143,46 +136,16 @@ class PrometheusTextfileSink:
     def flush(self) -> None:
         if not self._dirty:
             return
-        # The step is its own gauge, NOT a label: a step label would mint a
-        # brand-new Prometheus series per metric per step (label sets key
-        # series), fragmenting graphs and blowing up TSDB head cardinality.
-        step_name = prometheus_name("step", self.prefix)
-        lines = [f"# HELP {step_name} deepspeed_tpu metric 'step'",
-                 f"# TYPE {step_name} gauge",
-                 f"{step_name} {self._step}"]
-        for name in sorted(self._values):
-            lines.append(f"# HELP {name} deepspeed_tpu metric "
-                         f"{self._source.get(name, name)!r}")
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {format_prometheus_value(self._values[name])}")
+        # one shared renderer (expfmt.render_exposition) with the live
+        # /metrics endpoint: same step-gauge-first layout, same HELP
+        # lines, same non-finite spellings — byte-compatible by
+        # construction, pinned by the telemetry round-trip test
+        body = render_exposition(self._values, self._source, self._step,
+                                 self.prefix)
         tmp = self.path.with_suffix(".prom.tmp")
-        tmp.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        tmp.write_text(body, encoding="utf-8")
         os.replace(tmp, self.path)
         self._dirty = False
 
     def close(self) -> None:
         self.flush()
-
-
-def format_prometheus_value(v: float) -> str:
-    """Exposition-format scalar: non-finite values spell ``+Inf`` /
-    ``-Inf`` / ``NaN`` (a bare ``nan``/``inf`` from ``%g`` is rejected by
-    strict scrapers)."""
-    if math.isnan(v):
-        return "NaN"
-    if math.isinf(v):
-        return "+Inf" if v > 0 else "-Inf"
-    return f"{v:.10g}"
-
-
-def parse_prometheus_textfile(text: str) -> dict[str, float]:
-    """Tiny exposition-format reader (tests + doctors): name -> value."""
-    out: dict[str, float] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+(\S+)", line)
-        if m:
-            out[m.group(1)] = float(m.group(2))
-    return out

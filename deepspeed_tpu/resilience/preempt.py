@@ -86,6 +86,8 @@ class PreemptionGuard:
     # ----------------------------------------------------------- the handler
     def _handle(self, signum, frame) -> None:
         self.preempted = True
+        gp = getattr(self.engine, "goodput", None)
+        gp_t0 = gp.clock() if gp is not None else 0.0
         log_dist(f"preemption: signal {signum} received — committing the "
                  "in-flight checkpoint before exit", ranks=[0],
                  level="WARNING")
@@ -102,6 +104,11 @@ class PreemptionGuard:
         self.engine.wait_for_checkpoint()
         log_dist("preemption: checkpoint durable; 'latest' flipped",
                  ranks=[0], level="WARNING")
+        if gp is not None:
+            # the whole grace window — extra save + commit await — is
+            # preemption badput in the goodput ledger's decomposition
+            gp.account("preempt", gp_t0, gp.clock())
+            gp.export()
         flight = getattr(self.engine, "flight", None)
         if flight is not None:
             # leave the black box next to the checkpoint: the next

@@ -556,6 +556,23 @@ class Engine:
                     "knobs — the training engine only wires "
                     "step_time_mad_k; set them under the serving "
                     "config's `slo` block instead", level="WARNING")
+        # goodput/badput wall-time ledger (observability/goodput.py):
+        # Train/goodput_* decomposition of step dispatch vs compile /
+        # inter-step idle / checkpoint / preemption. None (default) =
+        # zero clock reads added to train_batch.
+        self.goodput = None
+        self._gp_stepped = False
+        if obs.goodput:
+            from ..observability.goodput import GoodputLedger
+
+            self.goodput = GoodputLedger(registry=self.metrics,
+                                         prefix="Train")
+        # live telemetry server (observability/server.py): /metrics,
+        # /healthz, /goodput, /flight + POST /flight/dump for the
+        # training process. Off (default) = zero threads. Started at the
+        # END of _post_init — a probe racing construction must find
+        # global_steps / the resilience fields already in place.
+        self.telemetry = None
         mb, gas = self.config.train_micro_batch_size_per_gpu, self.config.gradient_accumulation_steps
         try:
             peak = peak_flops_for(self.acc.current_device()) * len(jax.devices())
@@ -616,6 +633,15 @@ class Engine:
             from .checkpoint.engine import auto_resume
 
             auto_resume(self, res.resume_dir)
+        # config-gated telemetry server, after every field a probe can
+        # read exists (global_steps, the sentinel state, the registry)
+        tele = obs.telemetry
+        if tele and tele.get("enabled"):
+            from ..observability.server import TelemetryConfig
+
+            tc = TelemetryConfig.from_any(tele)
+            self.serve_telemetry(port=tc.port, host=tc.host,
+                                 token=tc.token)
 
     def _pinned_host_outputs_work(self) -> bool:
         """Compile AND run a trivial pinned_host-output jit: advertised
@@ -1431,7 +1457,81 @@ class Engine:
     def metrics_snapshot(self) -> dict:
         """Machine-readable view of the training registry (the serving
         analog lives on ``InferenceEngine.metrics_snapshot``)."""
-        return self.metrics.snapshot()
+        snap = self.metrics.snapshot()
+        if self.goodput is not None:
+            snap["goodput"] = self.goodput.snapshot()
+        return snap
+
+    def health(self) -> dict:
+        """Liveness/readiness snapshot for the telemetry probes (the
+        training analog of ``ServingEngine.health()``): a training
+        process is ``ready`` while it can take steps — i.e. it hasn't
+        halted on the non-finite sentinel (a halted engine only stays
+        alive long enough for a post-mortem scrape)."""
+        snap = self.metrics.snapshot()
+        streak = getattr(self, "_bad_step_streak", 0)
+        # getattr: the telemetry server starts inside _post_init, a few
+        # lines before the resilience fields land — a probe racing
+        # construction must degrade, not 500
+        max_bad = getattr(self, "_max_bad_steps", 0)
+        halted = bool(max_bad and streak >= max_bad)
+        hist = snap["histograms"].get("Train/step_time_s", {})
+        return {
+            "state": "halted" if halted else "training",
+            "ready": not halted,
+            "global_steps": self.global_steps,
+            "bad_step_streak": streak,
+            "skipped_steps": int(
+                snap["counters"].get("Train/skipped_steps", 0)),
+            "last_step_s": hist.get("last"),
+            "step_time_regressions": int(
+                snap["counters"].get("Train/step_time_regressions", 0)),
+        }
+
+    def serve_telemetry(self, port: Optional[int] = None,
+                        host: Optional[str] = None,
+                        token: Optional[str] = None) -> int:
+        """Start the live telemetry plane for the training process
+        (``/metrics`` ``/healthz`` ``/readyz`` ``/goodput`` ``/flight``
+        + token-gated ``POST /flight/dump``; the serving-only endpoints
+        — ``/requests``, ``/drain``, ``/slo/reload`` — 404 cleanly).
+        Returns the bound port; idempotent. Config gate:
+        ``observability.telemetry = {"enabled": true, ...}``."""
+        if self.telemetry is not None:
+            return self.telemetry.port
+        from ..observability.server import (TelemetryConfig, TelemetryHooks,
+                                            TelemetryServer, flight_summary)
+
+        tc = TelemetryConfig.from_any(self.config.observability.telemetry
+                                      or None)
+        host = host if host is not None else (
+            tc.host if tc is not None else "127.0.0.1")
+        port = port if port is not None else (tc.port if tc is not None
+                                              else 0)
+        token = token if token is not None else (
+            tc.token if tc is not None else "")
+
+        def refresh():
+            if self.goodput is not None:
+                self.goodput.export()
+
+        hooks = TelemetryHooks(
+            registry=self.metrics,
+            step_fn=lambda: int(self.global_steps),
+            refresh_fn=refresh,
+            health_fn=self.health,
+            goodput_fn=(self.goodput.export if self.goodput is not None
+                        else None),
+            flight_fn=((lambda: flight_summary(self.flight))
+                       if self.flight is not None else None),
+            dump_fn=((lambda: self.dump_flight("manual"))
+                     if self.flight is not None else None))
+        server = TelemetryServer(hooks, host=host, port=port, token=token)
+        # bind FIRST: a failed bind must not leave a dead server object
+        # that the idempotency guard then treats as running
+        bound = server.start()
+        self.telemetry = server
+        return bound
 
     def dump_flight(self, reason: str = "manual"):
         """Freeze the flight recorder (observability/flight.py) now;
@@ -1441,10 +1541,14 @@ class Engine:
         return self.flight.dump(reason)
 
     def close(self) -> None:
-        """Teardown: close any open XLA trace window and the monitor's
-        file handles. Safe to call more than once."""
+        """Teardown: close any open XLA trace window, the telemetry
+        server's listener thread, and the monitor's file handles. Safe
+        to call more than once."""
         if self._trace_window is not None:
             self._trace_window.close()
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
         if self.monitor:
             self.monitor.close()
 
@@ -1452,6 +1556,25 @@ class Engine:
         """One optimizer step over train_batch_size samples (micro-stepping,
         grad accumulation, and the update are all inside the compiled step;
         in offload mode the update runs on the host optimizer instead)."""
+        gp = self.goodput
+        if gp is None:
+            return self._train_batch_impl(batch)
+        # goodput attribution: the call window is productive step
+        # dispatch (the first call — which builds the XLA program — is
+        # the compile window); gaps between calls land in queue_empty
+        # (data/host time) via the ledger's gap rule. Two clock reads.
+        # Accounted on SUCCESS only: a first call that raises must not
+        # flip the compiled-once flag (the retry pays the real compile
+        # and must be attributed to it), and an aborted window reads as
+        # idle gap rather than fake productive time.
+        t0 = gp.clock()
+        first = not self._gp_stepped
+        out = self._train_batch_impl(batch)
+        self._gp_stepped = True
+        gp.on_train_step(t0, gp.clock(), compiled=first)
+        return out
+
+    def _train_batch_impl(self, batch: dict) -> dict:
         if self._abstract:
             raise RuntimeError(
                 "engine was built with abstract_state=True (AOT probe "
@@ -1642,6 +1765,11 @@ class Engine:
             from ..elasticity import assert_elastic_config_consistent
 
             assert_elastic_config_consistent(self.config.elasticity, save_dir)
+        if self.goodput is not None:
+            # checkpoint commit is honest badput: time the save window
+            # into its own bucket instead of letting it read as idle
+            with self.goodput.window("checkpoint"):
+                return _save(self, save_dir, tag)
         return _save(self, save_dir, tag)
 
     def load_checkpoint(self, load_dir: str, tag: str | None = None) -> str:

@@ -128,24 +128,21 @@ class ServingEngine:
             self.workload = WorkloadAnalyzer(
                 self.cfg.workload, registry=self.stats.registry,
                 clock=self.stats.clock)
-        self.slo = None
-        self._step_anomaly = None
-        self._compile_storm = None
-        if self.cfg.slo is not None and self.cfg.slo.any_enabled:
-            from ..observability.slo import (CompileStormDetector,
-                                            MedianMADDetector, SLOScorer)
+        self._build_slo(self.cfg.slo)
+        # goodput/badput wall-time ledger (observability/goodput.py):
+        # None (default) = zero clock reads added to the loop; enabled =
+        # two host clock reads per iteration, still zero programs/syncs
+        self.goodput = None
+        if self.cfg.goodput:
+            from ..observability.goodput import GoodputLedger
 
-            slo = self.cfg.slo
-            self.slo = SLOScorer(slo, self.stats.registry,
-                                 flight=self.flight)
-            if slo.step_time_mad_k:
-                self._step_anomaly = MedianMADDetector(
-                    slo.step_time_mad_k, slo.step_time_window,
-                    slo.step_time_min_samples)
-            if slo.compile_storm_threshold:
-                self._compile_storm = CompileStormDetector(
-                    slo.compile_storm_threshold, slo.compile_storm_window,
-                    slo.compile_storm_grace)
+            self.goodput = GoodputLedger(clock=self.stats.clock,
+                                         registry=self.stats.registry,
+                                         prefix="Serve")
+        # live telemetry server (observability/server.py): started at the
+        # END of __init__ when config-enabled (the state must exist
+        # before a scrape can land), or explicitly via serve_telemetry()
+        self.telemetry = None
         self._request_logs: list = []
         # ---- paged KV cache (serving/pages.py, docs/SERVING.md): page
         # pool + radix prefix tree + host page-table mirror. Disabled
@@ -208,6 +205,49 @@ class ServingEngine:
                     lambda: init_slots(mcfg, self.cfg.slots,
                                        self.cfg.max_len,
                                        engine.compute_dtype)))()
+        tcfg = self.cfg.telemetry
+        if tcfg is not None and tcfg.enabled:
+            self.serve_telemetry(port=tcfg.port, host=tcfg.host,
+                                 token=tcfg.token)
+
+    def _build_slo(self, slo) -> None:
+        """(Re)build the SLO scorer + anomaly detectors from a
+        :class:`~..observability.slo.SLOConfig` (or None). Shared by
+        __init__ and the live ``/slo/reload`` control endpoint."""
+        self.slo = None
+        self._step_anomaly = None
+        self._compile_storm = None
+        if slo is not None and slo.any_enabled:
+            from ..observability.slo import (CompileStormDetector,
+                                            MedianMADDetector, SLOScorer)
+
+            self.slo = SLOScorer(slo, self.stats.registry,
+                                 flight=self.flight)
+            if slo.step_time_mad_k:
+                self._step_anomaly = MedianMADDetector(
+                    slo.step_time_mad_k, slo.step_time_window,
+                    slo.step_time_min_samples)
+            if slo.compile_storm_threshold:
+                self._compile_storm = CompileStormDetector(
+                    slo.compile_storm_threshold, slo.compile_storm_window,
+                    slo.compile_storm_grace)
+
+    def reload_slo(self, cfg) -> dict:
+        """Swap the SLO config live (the ``POST /slo/reload`` hook): a
+        None/empty ``cfg`` tears the scoring machinery down, a dict
+        builds it exactly as __init__ would. Burn gauges and the
+        violation counter carry over (same registry); detectors restart
+        with fresh windows. Raises ``ValueError`` on unknown keys — the
+        endpoint maps that to a 400, nothing half-applies."""
+        import dataclasses as _dc
+
+        from ..observability.slo import SLOConfig
+
+        slo = SLOConfig.from_any(cfg) if cfg else None
+        self.cfg.slo = slo
+        self._build_slo(slo)
+        return {"reloaded": True, "enabled": self.slo is not None,
+                "slo": _dc.asdict(slo) if slo is not None else None}
 
     def _flush_table(self) -> None:
         """Mirror the host page tables into the decode carry when they
@@ -326,6 +366,13 @@ class ServingEngine:
         no host syncs beyond the step's one fused read-back."""
         finished: list[Request] = []
         ran_chunk = ran_decode = False
+        stall_excess = 0.0
+        gp = self.goodput
+        if gp is not None:
+            # the iteration window: two clock reads (entry/exit) — the
+            # ledger's whole hot-path cost; None (default) pays nothing
+            gp_t0 = gp.clock()
+            gp_compiles0 = self.compiles
         chaos = self.chaos
         if chaos is not None:
             it = chaos.on_iteration()
@@ -411,6 +458,7 @@ class ServingEngine:
                     new_episode = self._last_stall_iter != \
                         self._iterations - 1
                     self._last_stall_iter = self._iterations
+                    stall_excess = self._last_step_s - wd
                     self.stats.on_watchdog_stall(self._last_step_s, wd)
                     warning_once(
                         f"serving watchdog: a decode step exceeded "
@@ -464,6 +512,14 @@ class ServingEngine:
                                      total_compiles=self.compiles,
                                      iteration=self._iterations)
         self._iterations += 1
+        if gp is not None:
+            gp.on_serving_iteration(
+                gp_t0, gp.clock(),
+                decode_s=self._last_step_s if ran_decode else 0.0,
+                ran_decode=ran_decode, ran_chunk=ran_chunk,
+                compiled=self.compiles > gp_compiles0,
+                stall_excess_s=stall_excess, draining=self._draining,
+                idle=self.sched.idle and self._prefill is None)
         for req in finished:
             self._store_result(req)
         return finished
@@ -654,7 +710,13 @@ class ServingEngine:
         submit right now": not draining and not at queue capacity.
         ``degraded`` flags a watchdog stall within the last
         ``_DEGRADED_WINDOW`` iterations — and recovers once steps are
-        healthy again (the cumulative ``watchdog_stalls`` count doesn't)."""
+        healthy again (the cumulative ``watchdog_stalls`` count doesn't).
+
+        On the paged engine the snapshot also mirrors the page-pool
+        picture (``pages``: free/used/tree-held + ``pool_pressure`` when
+        the free list is empty — admissions are deferring or shedding),
+        so ``/readyz`` reports pool-exhaustion pressure alongside the
+        queue/drain state it always knew about."""
         snap = self.stats.registry.snapshot()
         stalls = int(snap["counters"].get("Serve/watchdog_stalls", 0))
         queue_full = bool(self.cfg.max_queue
@@ -674,13 +736,37 @@ class ServingEngine:
             "last_step_s": self._last_step_s,
             "watchdog_stalls": stalls,
             "results_held": len(self.results),
+            "pool_pressure": False,
         }
-        self.stats.registry.set_gauges({
+        gauges = {
             "Serve/ready": float(out["ready"]),
             "Serve/draining": float(self._draining),
             "Serve/degraded": float(degraded),
             "Serve/last_step_s": self._last_step_s,
-        })
+            # results-store depth: a caller that never collects results
+            # shows up as a climbing gauge long before evictions start
+            "Serve/results_held": float(len(self.results)),
+        }
+        if self._paged:
+            ps = self.pool.snapshot()
+            pressure = ps["free_pages"] == 0
+            out["pages"] = {
+                "free_pages": ps["free_pages"],
+                "used_pages": ps["used_pages"],
+                "usable_pages": ps["usable_pages"],
+                "tree_held_pages": ps["tree_held_pages"],
+                "pool_pressure": pressure,
+            }
+            out["pool_pressure"] = pressure
+            # keep the Serve/page_* gauges fresh at probe time too (the
+            # pool only rewrites them on alloc/free events)
+            gauges.update({
+                "Serve/page_pool_free": float(ps["free_pages"]),
+                "Serve/page_pool_used": float(ps["used_pages"]),
+                "Serve/page_pool_tree_held": float(ps["tree_held_pages"]),
+                "Serve/page_pool_pressure": float(pressure),
+            })
+        self.stats.registry.set_gauges(gauges)
         return out
 
     def metrics_snapshot(self) -> dict:
@@ -689,7 +775,19 @@ class ServingEngine:
             out["workload"] = self.workload.snapshot()
         if self._paged:
             out["pages"] = self.pool.snapshot()
+        if self.goodput is not None:
+            out["goodput"] = self.goodput.snapshot()
         return out
+
+    def requests_table(self) -> list[dict]:
+        """Live in-flight table (the ``GET /requests`` endpoint): every
+        request currently queued, prefilling, or decoding — host-side
+        bookkeeping only, no device reads. Reads the prefill lane
+        through ONE local binding: the HTTP thread races the serving
+        loop, which may clear ``_prefill`` between a check and a
+        subscript."""
+        p = self._prefill
+        return self.sched.inflight_table(p[0] if p is not None else None)
 
     # ----------------------------------------------------------- capacity
     def capacity_census(self) -> dict:
@@ -828,10 +926,92 @@ class ServingEngine:
     def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
         """Push ``Serve/*`` through a monitor fan-out (same contract as
         ``InferenceEngine.publish_metrics`` — the serving loop owns the
-        cadence). Scores SLOs first so the burn gauges ride the same
+        cadence). Scores SLOs and exports the goodput decomposition
+        first so burn and ``Serve/goodput_*`` gauges ride the same
         flush."""
         from ..observability.metrics import publish_registry
 
         self.score_slo()
+        if self.goodput is not None:
+            self.goodput.export()
         return publish_registry(self.stats.registry, monitor, step,
                                 default_step_counter="Serve/iterations")
+
+    # ----------------------------------------------------------- telemetry
+    def serve_telemetry(self, port: Optional[int] = None,
+                        host: Optional[str] = None,
+                        token: Optional[str] = None) -> int:
+        """Start the live telemetry & control plane
+        (:class:`~..observability.server.TelemetryServer`) for this
+        engine; returns the bound port (pass ``port=0`` for an
+        ephemeral one). Explicit arguments override the config block;
+        idempotent — a second call returns the running server's port.
+
+        The server thread only reads host-side state (registry under
+        its own lock, scheduler tables copied per request) — it adds no
+        device work, no syncs, and no compiled programs to the serving
+        loop."""
+        if self.telemetry is not None:
+            return self.telemetry.port
+        from ..observability.server import (TelemetryHooks, TelemetryServer,
+                                            flight_summary)
+
+        tcfg = self.cfg.telemetry
+        host = host if host is not None else (
+            tcfg.host if tcfg is not None else "127.0.0.1")
+        port = port if port is not None else (
+            tcfg.port if tcfg is not None else 0)
+        token = token if token is not None else (
+            tcfg.token if tcfg is not None else "")
+        reg = self.stats.registry
+
+        def refresh():
+            # /metrics must carry the truth of NOW: the health mirror
+            # (ready/draining/pool gauges) and the goodput decomposition
+            # refresh before every exposition render
+            self.health()
+            if self.goodput is not None:
+                self.goodput.export()
+
+        hooks = TelemetryHooks(
+            registry=reg,
+            step_fn=lambda: int(reg.counter("Serve/iterations").value),
+            refresh_fn=refresh,
+            health_fn=self.health,
+            requests_fn=self.requests_table,
+            capacity_fn=lambda census: self.capacity_report(census=census),
+            goodput_fn=(self.goodput.export if self.goodput is not None
+                        else None),
+            flight_fn=((lambda: flight_summary(self.flight))
+                       if self.flight is not None else None),
+            drain_fn=self._drain_control,
+            dump_fn=((lambda: self.dump_flight("manual"))
+                     if self.flight is not None else None),
+            slo_reload_fn=self.reload_slo)
+        server = TelemetryServer(hooks, host=host, port=port, token=token)
+        # bind FIRST: a failed bind (port in use) must not leave a dead
+        # server object behind that makes the idempotency guard return
+        # an unbound port on every retry
+        bound = server.start()
+        self.telemetry = server
+        return bound
+
+    def _drain_control(self, end: bool) -> dict:
+        """The ``POST /drain`` hook: begin (default) or end
+        (``{"end": true}``) a graceful drain; returns the resulting
+        health-relevant state."""
+        if end:
+            self.end_drain()
+        else:
+            self.begin_drain()
+        return {"draining": self._draining,
+                "queue_depth": self.sched.queue_depth,
+                "occupancy": self.sched.occupancy}
+
+    def close(self) -> None:
+        """Teardown: stop the telemetry server's listener thread (when
+        one is running). Safe to call more than once; the engine remains
+        usable for serving afterwards."""
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None

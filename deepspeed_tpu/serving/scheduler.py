@@ -412,6 +412,35 @@ class Scheduler:
         return out
 
     # ------------------------------------------------------------- readout
+    def inflight_table(self, prefill: Optional[Request] = None) -> list:
+        """Live in-flight request table for the telemetry plane's
+        ``GET /requests``: the engine's prefill-lane resident (passed
+        in — the scheduler doesn't hold it), every decoding slot, then
+        the queue in FIFO order. Pure host bookkeeping, copied
+        defensively so the HTTP thread never iterates a mutating
+        container."""
+
+        def row(req: Request, state: str) -> dict:
+            return {
+                "rid": req.rid, "state": state,
+                "slot": req.slot if req.slot >= 0 else None,
+                "prompt_len": req.prompt_len, "max_new": req.max_new,
+                "tokens": len(req.tokens), "submit_t": req.submit_t,
+                "admit_t": req.admit_t,
+                "deadline_ttft": req.deadline_ttft,
+                "deadline_total": req.deadline_total,
+            }
+
+        rows = []
+        if prefill is not None:
+            rows.append(row(prefill, "prefill"))
+        running = dict(self.running)
+        for slot in sorted(running):
+            rows.append(row(running[slot], "decoding"))
+        for req in list(self.queue):
+            rows.append(row(req, "queued"))
+        return rows
+
     @property
     def queue_depth(self) -> int:
         return len(self.queue)

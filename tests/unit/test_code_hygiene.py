@@ -36,6 +36,8 @@ PRINT_ALLOWED = {
     "launcher/runner.py",      # multinode launcher CLI
     "runtime/checkpoint/to_fp32.py",   # zero_to_fp32-style CLI (stderr note)
     "observability/doctor.py",  # ops triage CLI: the report IS its stdout
+    "observability/fleet_scrape.py",  # aggregator CLI: stdout is the
+                                      # merged exposition (no --out)
 }
 
 _BARE_PRINT = re.compile(r"^\s*print\(")
@@ -122,7 +124,9 @@ def test_no_bare_or_silent_except_in_library_code():
 
 # ------------------------------------------------------ clock-seam hygiene
 # Every timestamp in the serving/observability/resilience stack must be
-# fake-clock-testable: modules take an injectable ``clock`` (default-arg
+# fake-clock-testable — the observability/ glob below covers the PR-8
+# telemetry plane (server.py, goodput.py, fleet_scrape.py) like every
+# earlier module: modules take an injectable ``clock`` (default-arg
 # references like ``clock=time.perf_counter`` are the seam and are fine);
 # a DIRECT ``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()``
 # call inside a function body hard-wires wall time and makes the chaos /
