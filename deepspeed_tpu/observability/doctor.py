@@ -305,6 +305,64 @@ def report_live(url: str, timeout: float = 3.0) -> list:
     return findings
 
 
+def report_fleet(targets: list, timeout: float = 3.0) -> list:
+    """Fleet triage (``--targets a,b,c``): one
+    :class:`~.fleet_scrape.FleetScraper` pass over N engine telemetry
+    endpoints plus each live target's ``/flight`` manifest, with the
+    SAME gate semantics as single-engine triage — findings are every
+    DOWN replica, every burning SLO gauge anywhere, and every flight
+    record carrying why-markers. A dead target is a finding, never an
+    exception (the scraper's degradation contract)."""
+    from .fleet_scrape import FleetScraper
+
+    findings: list = []
+    scraper = FleetScraper(targets, timeout=timeout)
+    snap = scraper.scrape()
+    fl = snap["fleet"]
+    print(f"[fleet] {fl['up']}/{fl['engines']} up, "
+          f"{fl['ready']} ready"
+          + (f", goodput_frac={_fmt(fl['goodput_frac'])}"
+             if fl["goodput_frac"] is not None else "")
+          + (f", slo_burn_max={_fmt(fl['slo_burn_max'])}"
+             if fl["slo_burn_max"] is not None else ""))
+    for e in snap["engines"]:
+        if not e["up"]:
+            print(f"[fleet] {e['engine']} ({e['target']}) DOWN "
+                  f"({e['error']})")
+            findings.append(f"replica {e['engine']} at {e['target']} "
+                            "is down")
+            continue
+        vals = e["metrics"]
+        keys = ("dstpu_serve_ready", "dstpu_serve_draining",
+                "dstpu_serve_degraded", "dstpu_serve_queue_depth",
+                "dstpu_serve_slot_occupancy", "dstpu_serve_goodput_frac")
+        brief = " ".join(f"{k.replace('dstpu_serve_', '')}={_fmt(vals[k])}"
+                         for k in keys if k in vals)
+        ready = {True: "ready", False: "NOT-ready", None: "ready?"}
+        print(f"[fleet] {e['engine']} up ({ready[e['ready']]}, "
+              f"{len(vals)} metrics) {brief}".rstrip())
+        findings += [f"SLO burn gauge {k} = {_fmt(v)} on {e['engine']}"
+                     for k, v in sorted(vals.items())
+                     if k.endswith("_burn") and "_slo_" in k
+                     and isinstance(v, float) and v > 0]
+        # the live flight gate, per replica: why-markers in the newest
+        # record mean something fired there since it was cut
+        code, body = _http_get(e["target"] + "/flight", timeout)
+        if code == 200:
+            try:
+                flr = json.loads(body)
+            except json.JSONDecodeError:
+                flr = {}
+            newest = flr.get("newest")
+            if newest and newest.get("markers"):
+                names = sorted(str(n) for n in newest["markers"])
+                print(f"[fleet]   flight why-markers: {', '.join(names)}")
+                findings.append(
+                    f"flight record on {e['engine']} contains "
+                    "why-marker(s): " + ", ".join(names))
+    return findings
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.observability.doctor",
@@ -325,10 +383,19 @@ def main(argv=None) -> int:
                     help="triage a LIVE engine at this base URL "
                          "(http://host:port) via its telemetry "
                          "endpoints instead of reading files")
+    ap.add_argument("--targets", default=None,
+                    help="fleet triage: comma-separated telemetry base "
+                         "URLs (http://host:port,...) scraped via the "
+                         "fleet aggregator; any down replica, burning "
+                         "SLO gauge, or flight why-marker gates")
     ap.add_argument("--timeout", type=float, default=3.0,
                     help="per-endpoint timeout in live mode (default 3s)")
     args = ap.parse_args(argv)
-    if args.url:
+    if args.targets:
+        findings = report_fleet(
+            [t for t in args.targets.split(",") if t],
+            timeout=args.timeout)
+    elif args.url:
         findings = report_live(args.url, timeout=args.timeout)
     else:
         d = Path(args.dir)

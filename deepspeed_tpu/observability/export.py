@@ -254,6 +254,9 @@ def request_record(req, queue_wait_s: Optional[float] = None) -> dict:
         "submit_t": req.submit_t, "first_token_t": req.first_token_t,
         "finish_t": req.finish_t, "ttft_s": ttft, "tpot_s": tpot,
         "queue_wait_s": queue_wait_s, "error": req.error or None,
+        # failover visibility: >0 means the fleet router moved this
+        # request to a surviving replica (REQUEUED transitions)
+        "attempts": getattr(req, "attempts", 0),
     }
 
 

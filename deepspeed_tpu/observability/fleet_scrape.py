@@ -34,7 +34,6 @@ fleet without sockets), as is ``clock``.
 
 from __future__ import annotations
 
-import math
 import os
 import re
 import time
@@ -147,8 +146,12 @@ class FleetScraper:
         up = [e for e in engines if e["up"]]
         # wall-weighted goodput: weight each engine's fraction by its
         # ledger wall time (any *_goodput_wall_s / *_goodput_frac pair,
-        # serving or training)
-        wsum = fsum = 0.0
+        # serving or training) — the SAME weighting the in-process
+        # FleetEngine rollup uses (goodput.weighted_goodput_frac), so
+        # the scraped and in-process fleet numbers cannot drift
+        from .goodput import weighted_goodput_frac
+
+        pairs = []
         burn_max = None
         for e in up:
             frac = wall = None
@@ -159,17 +162,14 @@ class FleetScraper:
                     wall = v
                 if _SLO_BURN.search(k):
                     burn_max = v if burn_max is None else max(burn_max, v)
-            if frac is not None and not math.isnan(frac):
-                w = wall if wall and wall > 0 else 1.0
-                wsum += w
-                fsum += frac * w
+            pairs.append((frac, wall))
         return {
             "engines": engines,
             "fleet": {
                 "engines": len(engines),
                 "up": len(up),
                 "ready": sum(1 for e in up if e["ready"]),
-                "goodput_frac": (fsum / wsum) if wsum > 0 else None,
+                "goodput_frac": weighted_goodput_frac(pairs),
                 "slo_burn_max": burn_max,
             },
         }

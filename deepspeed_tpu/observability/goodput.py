@@ -228,3 +228,45 @@ class GoodputLedger:
             gauges[f"{p}/goodput_badput_{b}_s"] = v
         reg.set_gauges(gauges)
         return snap
+
+
+def weighted_goodput_frac(pairs) -> "float | None":
+    """Wall-weighted mean over ``(goodput_frac, wall_s)`` pairs — THE
+    fleet goodput definition, shared by the in-process rollup below and
+    the scrape aggregator (``fleet_scrape.py``) so the two surfaces
+    cannot drift. A replica that has lived 10x longer carries 10x the
+    weight (a freshly joined replica must not mask fleet-wide badput);
+    None/NaN fractions drop out, and a zero/unknown wall falls back to
+    weight 1.0 (the replica still counts, it just cannot dominate).
+    None when no replica has a usable fraction."""
+    wsum = fsum = 0.0
+    for frac, wall in pairs:
+        if frac is None or (isinstance(frac, float) and math.isnan(frac)):
+            continue
+        w = wall if wall and wall > 0 else 1.0
+        wsum += w
+        fsum += frac * w
+    return (fsum / wsum) if wsum > 0 else None
+
+
+def rollup_goodput(snaps: list) -> dict:
+    """Fleet rollup over per-replica ledger snapshots — the SAME math
+    the ``fleet_scrape`` aggregator applies to scraped gauges (both go
+    through :func:`weighted_goodput_frac`), applied to in-process
+    :meth:`GoodputLedger.snapshot` dicts: per-bucket seconds sum plus
+    the wall-weighted fleet fraction."""
+    out = {"replicas": len(snaps), "wall_s": 0.0, "productive_s": 0.0,
+           "badput_s": {b: 0.0 for b in BADPUT_BUCKETS},
+           "badput_total_s": 0.0, "goodput_frac": None}
+    pairs = []
+    for s in snaps:
+        if not s:
+            continue
+        out["wall_s"] += s.get("wall_s", 0.0)
+        out["productive_s"] += s.get("productive_s", 0.0)
+        for b, v in (s.get("badput_s") or {}).items():
+            out["badput_s"][b] = out["badput_s"].get(b, 0.0) + v
+        out["badput_total_s"] += s.get("badput_total_s", 0.0)
+        pairs.append((s.get("goodput_frac"), s.get("wall_s", 0.0)))
+    out["goodput_frac"] = weighted_goodput_frac(pairs)
+    return out

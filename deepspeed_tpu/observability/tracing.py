@@ -247,6 +247,15 @@ class ServingStats:
         self.registry.counter("Serve/aborted").inc()
         return t
 
+    def on_requeue(self, queue_depth: int) -> None:
+        """A fleet failover re-queued a request onto this replica after
+        its original replica was lost (status ``REQUEUED``, attempts
+        bumped) — counted here so the SURVIVOR's load picture shows the
+        inherited work."""
+        r = self.registry
+        r.counter("Serve/requeued").inc()
+        r.gauge("Serve/queue_depth").set(queue_depth)
+
     def on_watchdog_stall(self, step_s: float, threshold_s: float) -> None:
         """One decode step exceeded the watchdog budget."""
         r = self.registry
@@ -309,6 +318,7 @@ class ServingStats:
             "nonfinite": int(c.get("Serve/nonfinite", 0)),
             "watchdog_stalls": int(c.get("Serve/watchdog_stalls", 0)),
             "results_evicted": int(c.get("Serve/results_evicted", 0)),
+            "requeued": int(c.get("Serve/requeued", 0)),
             "queue_depth": g.get("Serve/queue_depth"),
             "slot_occupancy": g.get("Serve/slot_occupancy"),
             "slot_occupancy_avg": g.get("Serve/slot_occupancy_avg"),

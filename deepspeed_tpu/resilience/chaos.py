@@ -146,6 +146,64 @@ class ChaosConfig:
         return cls(**cfg)
 
 
+@dataclasses.dataclass
+class FleetChaosConfig:
+    """Seeded fleet-level fault schedule (``FleetEngine(chaos=...)``).
+
+    One fault class for now: replica loss. At fleet iteration
+    ``kill_replica_step`` the fleet abruptly drops one live replica —
+    its queued and in-flight requests requeue onto survivors with a
+    typed ``REQUEUED`` transition and a bumped ``attempts`` counter (the
+    zero-request-loss oracle in ``bench_fleet.py --smoke``). The victim
+    is ``kill_replica`` when named, else a seeded choice among the live
+    replicas at that instant. ``enabled: false`` (default) builds no
+    monkey — the fleet step pays one ``is not None`` check."""
+
+    enabled: bool = False
+    seed: int = 0
+    kill_replica_step: int = -1     # fleet iteration of the kill (-1 never)
+    kill_replica: str = ""          # victim name; "" = seeded choice
+
+    @classmethod
+    def from_any(cls, cfg: "FleetChaosConfig | dict | None") \
+            -> "FleetChaosConfig | None":
+        if cfg is None or isinstance(cfg, cls):
+            return cfg
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown fleet chaos config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+class FleetChaosMonkey:
+    """Drives one :class:`FleetChaosConfig` against one FleetEngine:
+    counts fleet iterations, picks the victim, keeps the ``injected``
+    audit log tests assert against (the fault must actually fire)."""
+
+    def __init__(self, cfg: FleetChaosConfig):
+        self.cfg = cfg
+        self.rng = np.random.default_rng(cfg.seed)
+        self.injected: list[dict] = []
+        self._iterations = 0
+
+    def maybe_kill(self, live: list) -> "str | None":
+        """Name of the replica to kill THIS fleet iteration, or None.
+        ``live`` is the current replica-name list; a configured victim
+        that already left the fleet degrades to a seeded choice."""
+        it = self._iterations
+        self._iterations += 1
+        c = self.cfg
+        if c.kill_replica_step < 0 or it != c.kill_replica_step or not live:
+            return None
+        victim = c.kill_replica if c.kill_replica in live \
+            else str(self.rng.choice(sorted(live)))
+        self.injected.append({"point": "replica_kill", "iteration": it,
+                              "replica": victim})
+        return victim
+
+
 class ChaosMonkey:
     """Drives one :class:`ChaosConfig` against one ServingEngine.
 

@@ -21,7 +21,14 @@ class RequestStatus(enum.Enum):
     ``TIMEOUT``   — a TTFT or total-wall deadline expired;
     ``CANCELLED`` — ``cancel(rid)`` retired it;
     ``SHED``      — rejected at admission (queue full / draining) — the
-                    status carried by :class:`QueueFullError`.
+                    status carried by :class:`QueueFullError`;
+    ``REQUEUED``  — NOT terminal: the fleet router moved this request to
+                    a surviving replica after its original replica was
+                    lost (``Request.attempts`` counts the moves). The
+                    request is live again and finishes with one of the
+                    terminal statuses above — the transition exists as a
+                    status so the in-flight table and the request log
+                    show failover per request instead of hiding it.
     """
 
     OK = "ok"
@@ -29,6 +36,7 @@ class RequestStatus(enum.Enum):
     TIMEOUT = "timeout"
     CANCELLED = "cancelled"
     SHED = "shed"
+    REQUEUED = "requeued"
 
 
 class QueueFullError(RuntimeError):

@@ -12,11 +12,14 @@ bit-identical to single-request ``generate()`` with the same request seed
 from ..resilience.guards import PagePoolExhausted, QueueFullError, \
     RequestStatus
 from .engine import ServingEngine
-from .pages import PagePool, RadixPrefixTree, init_paged_slots
+from .fleet import FleetEngine
+from .pages import (PagePool, RadixPrefixTree, export_slot, import_slot,
+                    init_paged_slots)
 from .scheduler import ChunkPlan, Request, Scheduler, plan_chunks
 from .slots import init_slots, insert_request
 
-__all__ = ["ServingEngine", "Scheduler", "Request", "ChunkPlan",
-           "plan_chunks", "init_slots", "insert_request",
+__all__ = ["ServingEngine", "FleetEngine", "Scheduler", "Request",
+           "ChunkPlan", "plan_chunks", "init_slots", "insert_request",
            "PagePool", "RadixPrefixTree", "init_paged_slots",
+           "export_slot", "import_slot",
            "RequestStatus", "QueueFullError", "PagePoolExhausted"]

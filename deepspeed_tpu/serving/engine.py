@@ -44,7 +44,8 @@ from ..observability.tracing import ServingStats
 from ..resilience.chaos import ChaosMonkey
 from ..resilience.guards import QueueFullError, RequestStatus
 from ..utils.logging import warning_once
-from .pages import PagePool, hydrate_cache, init_paged_slots, insert_paged
+from .pages import (PagePool, export_slot, hydrate_cache, import_slot,
+                    init_paged_slots, insert_paged)
 from .scheduler import Request, Scheduler
 from .slots import init_slots, insert_request
 
@@ -61,6 +62,26 @@ _MAX_RESULTS = 4096
 _DEGRADED_WINDOW = 64
 
 
+def expand_per_request(v, n: int, default, coerce=None) -> list:
+    """One scalar-or-per-request ``serve_batch`` argument expanded to
+    ``n`` values (shared by ``ServingEngine`` and ``FleetEngine`` so the
+    two surfaces cannot drift on coercion/validation). ``coerce`` (e.g.
+    ``int``) applies to every non-None value; None skips coercion —
+    session ids keep their caller type."""
+    if v is None:
+        vals = [default] * n
+    elif isinstance(v, (list, tuple, np.ndarray)):
+        if len(v) != n:
+            raise ValueError(f"expected {n} per-request values, "
+                             f"got {len(v)}")
+        vals = list(v)
+    else:
+        vals = [v] * n
+    if coerce is not None:
+        vals = [x if x is None else coerce(x) for x in vals]
+    return vals
+
+
 class ServingEngine:
     """submit()/step()/drain() continuous batching on an InferenceEngine.
 
@@ -74,8 +95,17 @@ class ServingEngine:
 
     def __init__(self, engine: InferenceEngine,
                  serving: ServingConfig | dict | None = None,
-                 registry=None, clock=None):
+                 registry=None, clock=None, programs=None, rid_source=None,
+                 name: str = ""):
         self.engine = engine
+        # fleet seams (serving/fleet.py): ``programs`` shares ONE compiled
+        # program cache across replicas of the same InferenceEngine (a
+        # joining replica warms from it — elasticity never compile-storms),
+        # ``rid_source`` shares one request-id namespace so a rid names a
+        # request fleet-wide, ``name`` labels this replica in fleet
+        # metrics. All None/"" on the single-engine path — behavior is
+        # byte-identical to the pre-fleet engine.
+        self.name = name
         if serving is None:
             serving = engine.config.serving
         self.cfg = ServingConfig.from_any(serving)
@@ -172,8 +202,18 @@ class ServingEngine:
                                eos_token_id=self._eos, stats=self.stats,
                                ttft_deadline_s=self.cfg.ttft_deadline_s,
                                total_deadline_s=self.cfg.total_deadline_s,
-                               spans=self.spans, pages=self.pool)
-        self._programs: OrderedDict = OrderedDict()
+                               spans=self.spans, pages=self.pool,
+                               rid_source=rid_source)
+        self._programs: OrderedDict = \
+            programs if programs is not None else OrderedDict()
+        # disaggregated-serving hook (serving/fleet.py): a side-effecting
+        # callback invoked right after a prefill lands in a slot with
+        # (req, slot). The fleet's handler takes the request over INSIDE
+        # the call (export_request + release_request), so by the time
+        # this step reaches its decode lane the request is gone; the
+        # return value is ignored. None (default) costs one `is not
+        # None` per placement.
+        self.on_placed = None
         self.compiles = 0        # program builds — bounded in steady state
         # finished requests awaiting pickup, BOUNDED (oldest evicted): a
         # server whose caller consumes step()'s return values — or
@@ -339,6 +379,19 @@ class ServingEngine:
         if req.deadline_ttft is not None or req.deadline_total is not None:
             self._any_deadlines = True
         return req.rid
+
+    def requeue(self, req: Request) -> Request:
+        """Failover intake (serving/fleet.py): adopt a request whose
+        replica was lost — typed ``REQUEUED`` transition via the
+        scheduler, plus the engine-side deadline bookkeeping a normal
+        ``submit`` would have done (the requeued request keeps its
+        ORIGINAL absolute deadlines; this engine's sweep must see
+        them). Bypasses ``max_queue`` and the drain gate: failover work
+        is already-admitted work, not new intake."""
+        self.sched.requeue(req)
+        if req.deadline_ttft is not None or req.deadline_total is not None:
+            self._any_deadlines = True
+        return req
 
     def cancel(self, rid: int) -> Optional[Request]:
         """Cancel a request wherever it currently lives — queue, prefill
@@ -628,6 +681,12 @@ class ServingEngine:
             ins = self._prog("insert", lambda: jax.jit(
                 insert_request, donate_argnums=(0,)))
             self._state = ins(self._state, jnp.int32(slot), pf)
+        if self.on_placed is not None:
+            # disaggregated handoff: the fleet may export the freshly
+            # seated request and release the slot before this very
+            # iteration's decode lane runs — a prefill replica never
+            # spends a decode step on a handed-off request
+            self.on_placed(req, slot)
         return []
 
     def begin_drain(self) -> None:
@@ -665,6 +724,80 @@ class ServingEngine:
         or already collected."""
         return self.results.pop(rid, None)
 
+    # ------------------------------------------------- fleet handoff seams
+    def export_request(self, req: Request) -> dict:
+        """Gather a slot-resident request's complete decode state (pool
+        page tiles + slot vectors) to HOST numpy — the source half of the
+        disaggregated prefill→decode handoff (serving/fleet.py). One
+        compiled program regardless of request or slot (the table row is
+        data). Paged engines only."""
+        if not self._paged:
+            raise RuntimeError("export_request needs the paged KV cache "
+                               "(set serving.page_size)")
+        if req.slot < 0 or self.sched.running.get(req.slot) is not req:
+            raise ValueError(f"request {req.rid} is not slot-resident here")
+        with self.engine.mesh:
+            self._flush_table()
+            exp = self._prog("export", lambda: jax.jit(export_slot))
+            out = exp(self._state, jnp.asarray(self._table[req.slot]),
+                      jnp.int32(req.slot))
+        return jax.device_get(out)
+
+    def release_request(self, req: Request) -> None:
+        """Drop a slot-resident request WITHOUT retiring it: free the
+        slot, release its page refs (the prompt's blocks stay tree-held
+        for future sharing), neutralize the table row. The request
+        object itself stays live — the fleet seats it elsewhere. No
+        retirement stats, no terminal span: this is a move, not an
+        outcome."""
+        slot = req.slot
+        if slot >= 0 and self.sched.running.get(slot) is req:
+            del self.sched.running[slot]
+            self.sched.free.append(slot)
+        self.sched._release_pages(req)
+        req.page_alloc = None
+        req.slot = -1
+        if self._paged and slot >= 0 \
+                and self.sched.running.get(slot) is None:
+            self._table[slot] = 0
+            self._table_dirty = True
+
+    def import_request(self, req: Request, payload: dict) -> bool:
+        """Seat an exported request into THIS engine's pool and a free
+        slot — the destination half of the handoff. Returns False (try
+        again after a retirement) when no slot is free or the pool is
+        transiently full; True when the request is decoding here. The
+        imported bits continue the source's exact RNG chain, so the
+        output stream is bit-identical to a single engine's."""
+        if not self._paged:
+            raise RuntimeError("import_request needs the paged KV cache "
+                               "(set serving.page_size)")
+        if not self.sched.free:
+            return False
+        # book_savings=False: seating already-computed KV skips no
+        # prefill — the SOURCE replica owns the savings accounting
+        alloc = self.pool.try_admit(req.prompt, req.max_new, req.rid,
+                                    book_savings=False)
+        if alloc is None:
+            return False
+        req.page_alloc = alloc
+        slot = self.sched.adopt(req)
+        if req.deadline_ttft is not None or req.deadline_total is not None:
+            # this engine never saw the request's submit(): the deadline
+            # sweep must still cover the imported residency
+            self._any_deadlines = True
+        with self.engine.mesh:
+            self._table[slot] = alloc.row
+            self._table_dirty = True
+            self._flush_table()
+            imp = self._prog("import", lambda: jax.jit(
+                import_slot, donate_argnums=(0,)))
+            self._state = imp(self._state, jnp.int32(slot),
+                              {k: jnp.asarray(v) for k, v in payload.items()},
+                              jnp.asarray(alloc.row), jnp.int32(alloc.shared))
+            self.pool.on_inserted(req.rid, req.prompt)
+        return True
+
     def serve_batch(self, prompts, max_new_tokens=None, seeds=None) -> list:
         """Convenience: submit a list of (ragged) prompts, drain, return
         each request's tokens as an int32 array, in submission order.
@@ -672,20 +805,8 @@ class ServingEngine:
         lists. Results are collected (popped) — repeated calls on one
         engine don't accumulate host state."""
         n = len(prompts)
-
-        def expand(v, default):
-            # per-request list/tuple/ndarray OR one scalar for everyone
-            if v is None:
-                return [default] * n
-            if isinstance(v, (list, tuple, np.ndarray)):
-                if len(v) != n:
-                    raise ValueError(f"expected {n} per-request values, "
-                                     f"got {len(v)}")
-                return [x if x is None else int(x) for x in v]
-            return [int(v)] * n
-
-        mn = expand(max_new_tokens, None)
-        sd = expand(seeds, 0)
+        mn = expand_per_request(max_new_tokens, n, None, int)
+        sd = expand_per_request(seeds, n, 0, int)
         rids = [self.submit(p, mn[i], seed=sd[i]) for i, p in
                 enumerate(prompts)]
         want = set(rids)
@@ -703,6 +824,23 @@ class ServingEngine:
         return [np.asarray(got[r].tokens, np.int32) for r in rids]
 
     # ------------------------------------------------------------ metrics
+    @property
+    def degraded(self) -> bool:
+        """A watchdog stall within the last ``_DEGRADED_WINDOW``
+        iterations (recovers once steps are healthy again; the
+        cumulative stall COUNT doesn't) — one definition shared by
+        :meth:`health` and the fleet router."""
+        return (self._last_stall_iter is not None
+                and self._iterations - self._last_stall_iter
+                <= _DEGRADED_WINDOW)
+
+    @property
+    def pool_pressure(self) -> bool:
+        """Paged engine with an empty free list: admissions are
+        deferring or shedding — shared by :meth:`health` and the fleet
+        router."""
+        return self._paged and not self.pool.free
+
     def health(self) -> dict:
         """Liveness/readiness snapshot for probes, also exported as
         ``Serve/*`` gauges (so the Prometheus textfile carries the same
@@ -721,9 +859,7 @@ class ServingEngine:
         stalls = int(snap["counters"].get("Serve/watchdog_stalls", 0))
         queue_full = bool(self.cfg.max_queue
                           and self.sched.queue_depth >= self.cfg.max_queue)
-        degraded = (self._last_stall_iter is not None
-                    and self._iterations - self._last_stall_iter
-                    <= _DEGRADED_WINDOW)
+        degraded = self.degraded
         out = {
             "state": "draining" if self._draining else "serving",
             "ready": not self._draining and not queue_full,

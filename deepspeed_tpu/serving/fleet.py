@@ -1,0 +1,798 @@
+"""Multi-replica serving fleet: SLO-aware router over N ServingEngines.
+
+Reference analog: the DeepSpeed-MII / FastGen serving deployment layer
+(the survey's "from one engine to a service" step) — N replicas behind
+one ``submit/step/drain/pop_result`` surface — with ZeRO-Infinity's
+streaming discipline applied to KV handoff: finished prefill state moves
+between roles as a page transfer instead of being recomputed.
+
+:class:`FleetEngine` fronts N in-process
+:class:`~.engine.ServingEngine` replicas built over ONE shared
+:class:`~..inference.engine.InferenceEngine` (params and compiled
+programs are shared; queues, slots, page pools, and metrics registries
+are per-replica). What the fleet adds:
+
+- **SLO-aware routing** — every admission consults each replica's live
+  ``health()`` snapshot plus its ``Serve/slo_*_burn`` and
+  ``Serve/goodput_frac`` gauges: least-loaded wins, and a draining,
+  degraded, queue-full, or pool-pressured replica is never chosen while
+  an alternative exists. All replicas draining → a typed
+  :class:`~..resilience.guards.QueueFullError` shed, exactly like a
+  single engine's drain.
+- **Session affinity** — requests carrying a ``session_id`` stick to
+  the replica whose radix tree already holds their prefix (that is
+  where their prefill is nearly free). When the sticky replica is
+  unhealthy the router falls back to policy and records the move in
+  ``Fleet/affinity_misses``.
+- **Replica loss/join** — ``remove_replica`` / a chaos kill requeues
+  the victim's queued and in-flight requests onto survivors with a
+  typed ``REQUEUED`` transition and a bumped ``Request.attempts`` (zero
+  request loss — the ``bench_fleet.py --smoke`` oracle); per-request
+  RNG folds from the seed, so a rerun's bits match a fresh submission.
+  ``add_replica`` warms from the fleet's shared compiled-program cache:
+  a joining replica serves traffic with ZERO new compiles.
+- **Disaggregated prefill/decode** — ``prefill_replicas=k`` dedicates k
+  replicas to chunked prefill; a finished prefill is exported from the
+  source page pool (:func:`~.pages.export_slot` — gather the request's
+  page-table row), moved host-side, and imported into a decode
+  replica's pool (:func:`~.pages.import_slot` — scatter into a fresh
+  allocation, shared-prefix entries redirected to scratch). The RNG
+  chain travels with the payload, so disaggregated output is
+  bit-identical to a single engine's (the parity oracle in tier-1).
+
+``Fleet/*`` metrics land in the fleet's own
+:class:`~..observability.metrics.MetricsRegistry` (same sinks as
+everything else via :meth:`publish_metrics`); fleet goodput is the
+PR-8 rollup math (:func:`~..observability.goodput.rollup_goodput`) over
+per-replica ledgers. Everything is host-side — the fleet layer adds no
+device programs beyond the export/import pair, no syncs, and no
+threads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..inference.config import ServingConfig
+from ..inference.engine import InferenceEngine
+from ..observability.metrics import MetricsRegistry
+from ..resilience.chaos import FleetChaosConfig, FleetChaosMonkey
+from ..resilience.guards import QueueFullError, RequestStatus
+from ..utils.logging import warning_once
+from .engine import _MAX_RESULTS, ServingEngine
+from .scheduler import Request
+
+__all__ = ["FleetEngine"]
+
+# Uniform fleets have one role; disaggregated fleets split it. Routing
+# matches roles exactly: a prefill replica never takes decode residency
+# and vice versa.
+ROLE_SERVE = "serve"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+
+
+class FleetEngine:
+    """N in-process serving replicas behind one engine-shaped surface.
+
+    ``engine`` supplies params/mesh/model (shared by every replica);
+    ``serving`` is the per-replica :class:`ServingConfig` (or dict) —
+    replicas are homogeneous by construction. ``prefill_replicas > 0``
+    switches to disaggregated roles (requires the paged KV cache — the
+    handoff is a page transfer). ``chaos`` takes a
+    :class:`~..resilience.chaos.FleetChaosConfig` for deterministic
+    replica-kill tests; ``clock`` is injectable and shared with every
+    replica, so fake-clock tests drive the whole fleet.
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 serving: ServingConfig | dict | None = None,
+                 replicas: int = 2, prefill_replicas: int = 0,
+                 names: Optional[list] = None, chaos=None,
+                 registry=None, clock=None, session_cap: int = 4096,
+                 programs: Optional[OrderedDict] = None):
+        if replicas < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
+        if prefill_replicas < 0 or (prefill_replicas
+                                    and prefill_replicas >= replicas):
+            raise ValueError(
+                f"prefill_replicas={prefill_replicas} must be >= 0 and "
+                f"leave at least one decode replica (replicas={replicas})")
+        self.engine = engine
+        if serving is None:
+            # the replicas would fall back to engine.config.serving (the
+            # ServingEngine default) — validate against THAT config, not
+            # a default-constructed one
+            serving = engine.config.serving
+        self._spec = serving
+        cfg0 = ServingConfig.from_any(
+            dataclasses.replace(serving) if isinstance(serving,
+                                                       ServingConfig)
+            else serving)
+        self._disagg = prefill_replicas > 0
+        if self._disagg and cfg0.page_size == 0:
+            raise ValueError(
+                "disaggregated prefill/decode needs the paged KV cache "
+                "(set serving.page_size) — the handoff is a page transfer")
+        tcfg = cfg0.telemetry
+        # checked BEFORE any replica binds (below) and again at every
+        # later _build_replica, so add_replica() on a 1-replica fleet
+        # cannot bind-crash on the same port either
+        self._fixed_port_telemetry = bool(
+            tcfg is not None and tcfg.enabled and tcfg.port)
+        if replicas > 1 and self._fixed_port_telemetry:
+            raise ValueError(
+                "serving.telemetry with a fixed port cannot be shared by "
+                f"{replicas} replicas — use port=0 (ephemeral) or start "
+                "telemetry per replica via engine.serve_telemetry()")
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._clock = clock if clock is not None else time.perf_counter
+        self._engine_clock = clock
+        # fleet-shared seams: ONE compiled-program cache (a joining
+        # replica warms from it) and ONE rid namespace (a rid names a
+        # request fleet-wide; requeue keeps the id). ``programs`` lets a
+        # caller seed the cache from another fleet over the SAME engine
+        # and an IDENTICAL serving config (blue/green rollouts, test
+        # suites) — programs bake in shapes AND the sampling policy, so
+        # sharing across differing configs is a caller bug.
+        self._programs: OrderedDict = \
+            programs if programs is not None else OrderedDict()
+        self._rid_next = [0]
+
+        def _rid():
+            rid = self._rid_next[0]
+            self._rid_next[0] += 1
+            return rid
+
+        self._rid = _rid
+        self.replicas: "OrderedDict[str, ServingEngine]" = OrderedDict()
+        self.roles: dict = {}
+        self._draining = False
+        self._joined = 0              # monotonic: default-name uniqueness
+        if names is not None and len(names) != replicas:
+            raise ValueError(f"{len(names)} names for {replicas} replicas")
+        try:
+            for i in range(replicas):
+                if self._disagg:
+                    role = (ROLE_PREFILL if i < prefill_replicas
+                            else ROLE_DECODE)
+                    default = (f"p{i}" if i < prefill_replicas
+                               else f"d{i - prefill_replicas}")
+                else:
+                    role, default = ROLE_SERVE, f"r{i}"
+                self._build_replica(
+                    names[i] if names is not None else default, role)
+        except Exception:
+            # a failed build (bad name, port bind, ...) must not leak
+            # the replicas — and their telemetry listeners — already up
+            for eng_built in self.replicas.values():
+                eng_built.close()
+            raise
+        # router state: rid -> owning replica name; (role, session) ->
+        # sticky replica, LRU-bounded so a million sessions can't leak
+        self._owner: dict[int, str] = {}
+        self._session: OrderedDict = OrderedDict()
+        self._session_cap = int(session_cap)
+        # finished requests awaiting pickup, bounded exactly like one
+        # engine's store; evictions attribute to the OWNING replica
+        self.results: "OrderedDict[int, Request]" = OrderedDict()
+        self._max_results = _MAX_RESULTS
+        # pending prefill→decode handoffs: (request, host payload)
+        self._handoffs: list = []
+        # requests the FLEET layer itself retired (handoff-deadline
+        # timeouts, requeue sheds) — drained into the next step()'s
+        # return so its "everything that retired" contract stays true
+        self._retired_inline: list = []
+        self.chaos: Optional[FleetChaosMonkey] = None
+        cc = FleetChaosConfig.from_any(chaos)
+        if cc is not None and cc.enabled:
+            self.chaos = FleetChaosMonkey(cc)
+        self._iterations = 0
+
+    # ------------------------------------------------------------ replicas
+    def _replica_cfg(self) -> ServingConfig | dict | None:
+        """A FRESH config per replica (``reload_slo`` mutates in place —
+        replicas must not share one instance)."""
+        if isinstance(self._spec, ServingConfig):
+            return dataclasses.replace(self._spec)
+        return self._spec
+
+    def _build_replica(self, name: str, role: str) -> ServingEngine:
+        if name in self.replicas:
+            raise ValueError(f"duplicate replica name {name!r}")
+        if self.replicas and self._fixed_port_telemetry:
+            raise ValueError(
+                "serving.telemetry with a fixed port cannot be shared by "
+                "multiple replicas — use port=0 (ephemeral) or start "
+                "telemetry per replica via engine.serve_telemetry()")
+        eng = ServingEngine(self.engine, self._replica_cfg(),
+                            clock=self._engine_clock,
+                            programs=self._programs, rid_source=self._rid,
+                            name=name)
+        if role == ROLE_PREFILL:
+            eng.on_placed = (lambda req, slot, _n=name:
+                             self._on_prefill_placed(_n, req, slot))
+        if self._draining:
+            eng.begin_drain()
+        self.replicas[name] = eng
+        self.roles[name] = role
+        self._joined += 1
+        return eng
+
+    def add_replica(self, name: Optional[str] = None,
+                    role: Optional[str] = None) -> str:
+        """Elastic join: build one more replica over the SAME inference
+        engine and the fleet's shared program cache — it serves traffic
+        with zero new compiles (warm join; the tier-1 test pins
+        ``compiles == 0`` on the joined replica). Returns its name."""
+        if role is None:
+            role = ROLE_DECODE if self._disagg else ROLE_SERVE
+        valid = {ROLE_PREFILL, ROLE_DECODE} if self._disagg \
+            else {ROLE_SERVE}
+        if role not in valid:
+            raise ValueError(f"role {role!r} not in {sorted(valid)} for "
+                             "this fleet")
+        if name is None:
+            stem = {ROLE_SERVE: "r", ROLE_PREFILL: "p",
+                    ROLE_DECODE: "d"}[role]
+            name = f"{stem}{self._joined}"
+            while name in self.replicas:
+                self._joined += 1
+                name = f"{stem}{self._joined}"
+        self._build_replica(name, role)
+        self.registry.counter("Fleet/replica_joins").inc()
+        return name
+
+    def remove_replica(self, name: str) -> list:
+        """Planned scale-down: take ``name`` out of the fleet; its
+        queued and in-flight requests requeue onto survivors (typed
+        ``REQUEUED``, ``attempts`` bumped, original deadlines kept).
+        Returns the requeued rids."""
+        return self._remove(name)
+
+    def kill_replica(self, name: str) -> list:
+        """Abrupt replica loss (the chaos fault): mechanically identical
+        to :meth:`remove_replica` — the router's knowledge of its
+        outstanding requests IS the failover source — but counted as a
+        kill so dashboards separate incidents from scale-downs. A
+        REFUSED kill (unknown name, last replica of a role) raises
+        without counting: dashboards never show a phantom incident."""
+        out = self._remove(name)
+        self.registry.counter("Fleet/replica_kills").inc()
+        return out
+
+    def _remove(self, name: str) -> list:
+        if name not in self.replicas:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(have {list(self.replicas)})")
+        if len(self.replicas) == 1:
+            raise RuntimeError("cannot remove the last replica")
+        if self._disagg:
+            role = self.roles[name]
+            others = [n for n in self.replicas
+                      if n != name and self.roles[n] == role]
+            if not others:
+                raise RuntimeError(
+                    f"cannot remove the last {role} replica of a "
+                    "disaggregated fleet")
+        eng = self.replicas.pop(name)
+        self.roles.pop(name)
+        # results that retired before the loss are NOT lost: harvest
+        for rid in list(eng.results):
+            self._adopt_result(eng.pop_result(rid), name)
+        # live requests: the prefill lane + every slot + the queue
+        live = []
+        if eng._prefill is not None:
+            live.append(eng._prefill[0])
+            eng._prefill = None
+        live += eng.sched.take_live()
+        requeued = []
+        requeue_role = ROLE_PREFILL if self._disagg else ROLE_SERVE
+        # ONE ranking pass for the whole failover burst (the pattern
+        # _pump_handoffs uses): re-ranking per orphan would re-snapshot
+        # every survivor's registry exactly when the fleet is absorbing
+        # a spike. take_live is oldest-first; iterating it REVERSED
+        # (newest-first) against Scheduler.requeue's push-to-head leaves
+        # each survivor's queue head oldest-first — the deadline-closest
+        # request admits first.
+        ranked = [i["name"]
+                  for i in self._ranked(requeue_role, admission=False)]
+        for req in reversed(live):
+            self._requeue(req, requeue_role, ranked)
+            requeued.append(req.rid)
+        requeued.reverse()
+        eng.close()
+        return requeued
+
+    def _requeue(self, req: Request, role: str,
+                 ranked: "Optional[list]" = None) -> None:
+        """Move one orphaned request onto a survivor: affinity-aware
+        (its session's prefix may live on another replica too), typed
+        REQUEUED transition via the survivor's scheduler. Requeue
+        bypasses ``max_queue`` — this is already-admitted work, not new
+        intake. ``ranked`` lets :meth:`_remove` amortize one ranking
+        pass over the whole failover burst."""
+        if ranked is None:
+            ranked = [i["name"]
+                      for i in self._ranked(role, admission=False)]
+        sticky = (self._session.get((role, req.session_id))
+                  if req.session_id is not None else None)
+        name = sticky if sticky in ranked else \
+            (ranked[0] if ranked else None)
+        if name is None:
+            # no survivor of this role can ever host it: terminal shed
+            req.status = RequestStatus.SHED
+            req.error = "no surviving replica to requeue onto"
+            req.finish_t = self._clock()
+            self.registry.counter("Fleet/requeue_sheds").inc()
+            self._adopt_result(req, "")
+            self._retired_inline.append(req)
+            return
+        self.replicas[name].requeue(req)
+        self._owner[req.rid] = name
+        if req.session_id is not None:
+            self._stick(role, req.session_id, name)
+        self.registry.counter("Fleet/requeued").inc()
+
+    # -------------------------------------------------------------- router
+    def _replica_info(self, name: str) -> dict:
+        """One replica's routing picture: direct host state (queue,
+        slots, drain/degraded/pool flags — the same definitions
+        ``health()`` reports, via the engine's shared properties) plus
+        ONE registry snapshot for the SLO-burn and goodput gauges.
+        Routing runs per admission, so it must not pay ``health()``'s
+        full gauge-mirror pass on top."""
+        eng = self.replicas[name]
+        g = eng.stats.registry.snapshot()["gauges"]
+        burn = 0.0
+        for k, v in g.items():
+            if k.startswith("Serve/slo_") and k.endswith("_burn") \
+                    and isinstance(v, float) and not math.isnan(v):
+                burn = max(burn, v)
+        gp = g.get("Serve/goodput_frac")
+        if not isinstance(gp, float) or math.isnan(gp):
+            gp = 1.0
+        queue_depth = eng.sched.queue_depth
+        queue_full = bool(eng.cfg.max_queue
+                          and queue_depth >= eng.cfg.max_queue)
+        load = (queue_depth + eng.sched.occupancy
+                + (1 if eng._prefill is not None else 0)) \
+            / max(1, eng.cfg.slots)
+        return {
+            "name": name,
+            "draining": eng.draining,
+            # "would I route here if anyone else could take it": ready
+            # (not draining / queue-full), no recent watchdog stall, no
+            # page-pool pressure, no burning SLO
+            "healthy": (not eng.draining and not queue_full
+                        and not eng.degraded and not eng.pool_pressure
+                        and burn <= 1.0),
+            "load": load, "burn": burn, "goodput": gp,
+        }
+
+    def _ranked(self, role: str, exclude=(), admission: bool = True) \
+            -> list:
+        """Routing infos of ``role``'s replicas, best-first: healthy
+        before unhealthy, then least-loaded, then lowest SLO burn, then
+        highest goodput. ``admission=False`` keeps draining replicas in
+        the pool (handoffs and requeues are backlog, which a drain must
+        finish). Returns the info dicts so callers reuse ONE snapshot
+        pass instead of re-reading registries per decision."""
+        infos = [self._replica_info(n) for n in self.replicas
+                 if self.roles[n] == role and n not in exclude]
+        if admission:
+            infos = [i for i in infos if not i["draining"]]
+        infos.sort(key=lambda i: (0 if i["healthy"] else 1, i["load"],
+                                  i["burn"], -i["goodput"], i["name"]))
+        return infos
+
+    def _stick(self, role: str, sid, name: str) -> None:
+        key = (role, sid)
+        self._session[key] = name
+        self._session.move_to_end(key)
+        if len(self._session) > self._session_cap:
+            self._session.popitem(last=False)
+
+    def _route(self, role: str, session_id=None, exclude=()) -> str:
+        """Pick the admission target; raises a typed shed when no
+        replica of ``role`` is accepting (all draining/removed)."""
+        infos = self._ranked(role, exclude=exclude, admission=True)
+        if not infos:
+            self.registry.counter("Fleet/sheds").inc()
+            raise QueueFullError(
+                f"no {role} replica accepting admissions (all draining); "
+                "request shed")
+        by_name = {i["name"]: i for i in infos}
+        choice = infos[0]["name"]
+        if session_id is not None:
+            sticky = self._session.get((role, session_id))
+            if sticky is not None:
+                # stick when the sticky replica is routable AND healthy;
+                # otherwise fall back to policy and record the miss (the
+                # prefix will be rebuilt at the new home)
+                si = by_name.get(sticky)
+                if si is not None and si["healthy"]:
+                    choice = sticky
+                if choice == sticky:
+                    self.registry.counter("Fleet/affinity_hits").inc()
+                else:
+                    self.registry.counter("Fleet/affinity_misses").inc()
+            self._stick(role, session_id, choice)
+        return choice
+
+    # -------------------------------------------------------------- intake
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               seed: int = 0, session_id=None,
+               ttft_deadline_s: Optional[float] = None,
+               total_deadline_s: Optional[float] = None) -> int:
+        """Route one request into the fleet; returns its fleet-wide rid.
+        Same contract as ``ServingEngine.submit`` plus ``session_id``
+        (opaque, hashable): requests of one session prefer the replica
+        holding their shared prefix. Raises the same typed
+        :class:`QueueFullError` when every eligible replica sheds."""
+        role = ROLE_PREFILL if self._disagg else ROLE_SERVE
+        tried: set = set()
+        last: Optional[QueueFullError] = None
+        while True:
+            try:
+                name = self._route(role, session_id=session_id,
+                                   exclude=tried)
+            except QueueFullError:
+                if last is not None:
+                    raise last
+                raise
+            eng = self.replicas[name]
+            try:
+                rid = eng.submit(prompt, max_new_tokens, seed=seed,
+                                 ttft_deadline_s=ttft_deadline_s,
+                                 total_deadline_s=total_deadline_s)
+                break
+            except QueueFullError as e:
+                # this replica flipped to full/draining between the
+                # health read and the submit: try the next-best before
+                # shedding fleet-wide
+                last = e
+                tried.add(name)
+        req = eng.sched.queue[-1]
+        req.session_id = session_id
+        self._owner[rid] = name
+        r = self.registry
+        r.counter("Fleet/submitted").inc()
+        r.counter(f"Fleet/routed_{name}").inc()
+        return rid
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Cancel wherever the request lives — its owning replica or the
+        pending-handoff buffer."""
+        for i, (req, _payload) in enumerate(self._handoffs):
+            if req.rid == rid:
+                del self._handoffs[i]
+                self.registry.gauge("Fleet/handoff_pending").set(
+                    len(self._handoffs))
+                req.status = RequestStatus.CANCELLED
+                req.error = "cancelled during prefill→decode handoff"
+                req.finish_t = self._clock()
+                self._adopt_result(req, self._owner.get(rid, ""))
+                return req
+        name = self._owner.get(rid)
+        if name in self.replicas:
+            req = self.replicas[name].cancel(rid)
+            if req is not None:
+                self.replicas[name].pop_result(rid)
+                self._adopt_result(req, name)
+            return req
+        return None
+
+    # ------------------------------------------------------------- serving
+    def step(self) -> list:
+        """One fleet iteration: chaos hook, pending handoffs, then one
+        ``step()`` on every replica. Returns every request that retired
+        anywhere in the fleet this iteration; results are also held in
+        the fleet's own bounded store for :meth:`pop_result`."""
+        out: list = []
+        if self.chaos is not None:
+            # only offer LEGALLY removable victims (never the last
+            # replica, never the last of a disaggregated role) — a
+            # chaos fault must inject failure, not crash the router
+            victim = self.chaos.maybe_kill(self._killable())
+            if victim is not None:
+                self.kill_replica(victim)
+        if self._handoffs:
+            self._pump_handoffs()
+        for name in list(self.replicas):
+            eng = self.replicas[name]
+            for req in eng.step():
+                eng.pop_result(req.rid)
+                self._adopt_result(req, name)
+                out.append(req)
+        if self._retired_inline:
+            # retirements the fleet layer itself produced (handoff
+            # timeouts, requeue sheds) ride the same return channel
+            out.extend(self._retired_inline)
+            self._retired_inline = []
+        self._iterations += 1
+        self.registry.counter("Fleet/iterations").inc()
+        return out
+
+    def _killable(self) -> list:
+        """Replica names whose removal :meth:`_remove` would accept."""
+        if len(self.replicas) <= 1:
+            return []
+        if not self._disagg:
+            return list(self.replicas)
+        counts: dict = {}
+        for n in self.replicas:
+            counts[self.roles[n]] = counts.get(self.roles[n], 0) + 1
+        return [n for n in self.replicas if counts[self.roles[n]] > 1]
+
+    def _on_prefill_placed(self, name: str, req: Request,
+                           slot: int) -> None:
+        """The disaggregation seam (``ServingEngine.on_placed``): a
+        prefill replica just seated a finished prefill — export its
+        pages to host, release the slot (the prompt's blocks stay in the
+        source tree for future sharing), queue the handoff. The takeover
+        happens via these side effects; the hook returns nothing."""
+        eng = self.replicas[name]
+        payload = eng.export_request(req)
+        eng.release_request(req)
+        self._handoffs.append((req, payload))
+        self.registry.counter("Fleet/handoffs").inc()
+        self.registry.gauge("Fleet/handoff_pending").set(
+            len(self._handoffs))
+
+    def _pump_handoffs(self) -> None:
+        """Try to land every pending handoff on a decode replica:
+        affinity-aware, best-ranked first, and a destination that cannot
+        take it right now (no free slot / pool pressure) just leaves the
+        payload host-held for the next iteration. Expired deadlines
+        retire here — a handed-off request is in no scheduler's sweep.
+        The ranking snapshot is taken ONCE per pump and refreshed only
+        after a successful import changes a replica's load — not per
+        pending request (handoffs pile up exactly when this loop runs
+        hottest)."""
+        remaining = []
+        ranked = [i["name"]
+                  for i in self._ranked(ROLE_DECODE, admission=False)]
+        for req, payload in self._handoffs:
+            now = self._clock()
+            if req.deadline_total is not None and now >= req.deadline_total:
+                req.status = RequestStatus.TIMEOUT
+                req.error = "total deadline expired during handoff"
+                req.finish_t = now
+                self.registry.counter("Fleet/handoff_timeouts").inc()
+                self._adopt_result(req, self._owner.get(req.rid, ""))
+                self._retired_inline.append(req)
+                continue
+            order = list(ranked)
+            sticky = (self._session.get((ROLE_DECODE, req.session_id))
+                      if req.session_id is not None else None)
+            if sticky in order:
+                order.remove(sticky)
+                order.insert(0, sticky)
+            placed = False
+            for name in order:
+                if self.replicas[name].import_request(req, payload):
+                    self._owner[req.rid] = name
+                    if req.session_id is not None:
+                        self._stick(ROLE_DECODE, req.session_id, name)
+                    self.registry.counter("Fleet/handoff_imports").inc()
+                    placed = True
+                    ranked = [i["name"] for i in
+                              self._ranked(ROLE_DECODE, admission=False)]
+                    break
+            if not placed:
+                remaining.append((req, payload))
+        self._handoffs = remaining
+        self.registry.gauge("Fleet/handoff_pending").set(
+            len(self._handoffs))
+
+    def _adopt_result(self, req: Request, name: str) -> None:
+        self.results[req.rid] = req
+        if name:
+            self._owner[req.rid] = name
+        if len(self.results) > self._max_results:
+            old_rid, _old = self.results.popitem(last=False)
+            owner = self._owner.pop(old_rid, None)
+            rep = self.replicas.get(owner)
+            if rep is not None:
+                # the eviction is attributed to the replica that served
+                # the request — its Serve/results_evicted counter is the
+                # one dashboards already watch
+                rep.stats.on_results_evicted()
+            self.registry.counter("Fleet/results_evicted").inc()
+            warning_once(
+                f"fleet results store hit its cap ({self._max_results}); "
+                "evicting oldest finished requests — collect results via "
+                "step()'s return value or pop_result()")
+
+    def pop_result(self, rid: int) -> Optional[Request]:
+        """Collect (and release) a finished request by rid, regardless
+        of which replica retired it — routed by rid through the owner
+        map, never a scan."""
+        req = self.results.pop(rid, None)
+        if req is None:
+            name = self._owner.get(rid)
+            if name in self.replicas:
+                req = self.replicas[name].pop_result(rid)
+        if req is not None:
+            self._owner.pop(rid, None)
+        return req
+
+    # ---------------------------------------------------------- lifecycle
+    def begin_drain(self) -> None:
+        """Fleet-wide drain: every replica stops admitting (new submits
+        shed typed); queued, running, and handed-off requests finish."""
+        self._draining = True
+        for eng in self.replicas.values():
+            eng.begin_drain()
+
+    def end_drain(self) -> None:
+        self._draining = False
+        for eng in self.replicas.values():
+            eng.end_drain()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def idle(self) -> bool:
+        return (not self._handoffs
+                and all(e.sched.idle and e._prefill is None
+                        for e in self.replicas.values()))
+
+    def drain(self, max_iterations: int = 1_000_000) -> dict:
+        """Graceful fleet shutdown: drain mode, run until every replica
+        is idle and no handoff is pending, return the fleet results."""
+        self.begin_drain()
+        it = 0
+        while not self.idle:
+            self.step()
+            it += 1
+            if it > max_iterations:
+                raise RuntimeError(
+                    f"fleet failed to drain in {max_iterations} "
+                    "iterations — scheduler wedged?")
+        return self.results
+
+    def serve_batch(self, prompts, max_new_tokens=None, seeds=None,
+                    session_ids=None) -> list:
+        """Convenience mirror of ``ServingEngine.serve_batch`` across the
+        fleet: submit, drive, return each request's tokens in submission
+        order (results popped)."""
+        import numpy as np
+
+        from .engine import expand_per_request
+
+        n = len(prompts)
+        mn = expand_per_request(max_new_tokens, n, None, int)
+        sd = expand_per_request(seeds, n, 0, int)
+        sid = expand_per_request(session_ids, n, None)
+        rids = [self.submit(p, mn[i], seed=sd[i], session_id=sid[i])
+                for i, p in enumerate(prompts)]
+        want = set(rids)
+        got: dict = {}
+        it = 0
+        while len(got) < n:
+            for req in self.step():
+                if req.rid in want:
+                    got[req.rid] = req
+                    self.results.pop(req.rid, None)
+                    self._owner.pop(req.rid, None)
+            it += 1
+            if it > 1_000_000:
+                raise RuntimeError("fleet serve_batch failed to finish — "
+                                   "scheduler wedged?")
+        return [np.asarray(got[r].tokens, np.int32) for r in rids]
+
+    # ------------------------------------------------------------- readout
+    def health(self) -> dict:
+        """Fleet liveness/readiness rollup + per-replica snapshots,
+        mirrored to ``Fleet/*`` gauges (replicas/ready/queue/occupancy/
+        handoffs) so the scrape surface carries the router's picture."""
+        per = {name: eng.health() for name, eng in self.replicas.items()}
+        ready = sum(1 for h in per.values() if h["ready"])
+        out = {
+            "replicas": len(per),
+            "ready_replicas": ready,
+            "ready": ready > 0 and not self._draining,
+            "state": "draining" if self._draining else "serving",
+            "queue_depth": sum(h["queue_depth"] for h in per.values()),
+            "occupancy": sum(h["occupancy"] for h in per.values()),
+            "handoff_pending": len(self._handoffs),
+            "iterations": self._iterations,
+            "roles": dict(self.roles),
+            "per_replica": per,
+        }
+        self.registry.set_gauges({
+            "Fleet/replicas": float(out["replicas"]),
+            "Fleet/replicas_ready": float(ready),
+            "Fleet/ready": float(out["ready"]),
+            "Fleet/queue_depth": float(out["queue_depth"]),
+            "Fleet/occupancy": float(out["occupancy"]),
+            "Fleet/handoff_pending": float(len(self._handoffs)),
+        })
+        return out
+
+    def fleet_goodput(self) -> Optional[dict]:
+        """The PR-8 rollup math over per-replica goodput ledgers
+        (wall-weighted fraction, summed buckets), exported as
+        ``Fleet/goodput_*`` gauges. None when no replica has a ledger
+        (``serving.goodput`` off)."""
+        from ..observability.goodput import rollup_goodput
+
+        snaps = [eng.goodput.snapshot() for eng in self.replicas.values()
+                 if eng.goodput is not None]
+        if not snaps:
+            return None
+        roll = rollup_goodput(snaps)
+        gauges = {"Fleet/goodput_wall_s": roll["wall_s"],
+                  "Fleet/goodput_productive_s": roll["productive_s"],
+                  "Fleet/goodput_badput_total_s": roll["badput_total_s"]}
+        if roll["goodput_frac"] is not None:
+            gauges["Fleet/goodput_frac"] = roll["goodput_frac"]
+        self.registry.set_gauges(gauges)
+        return roll
+
+    def metrics_snapshot(self) -> dict:
+        # refresh the derived gauges FIRST (publish_metrics order) so
+        # the "fleet" section carries current health/goodput, not the
+        # previous call's
+        self.health()
+        gp = self.fleet_goodput()
+        snap = self.registry.snapshot()
+        out = {
+            "iterations": self._iterations,
+            "fleet": {**snap["counters"], **snap["gauges"]},
+            "replicas": {name: {"role": self.roles[name],
+                                "compiles": eng.compiles,
+                                **eng.stats.snapshot()}
+                         for name, eng in self.replicas.items()},
+        }
+        if gp is not None:
+            out["goodput"] = gp
+        return out
+
+    def requests_table(self) -> list:
+        """Fleet-wide in-flight table: every replica's rows plus the
+        pending-handoff residents, each labeled with its replica."""
+        rows = []
+        for name, eng in self.replicas.items():
+            for row in eng.requests_table():
+                row["replica"] = name
+                rows.append(row)
+        for req, _payload in self._handoffs:
+            rows.append({"rid": req.rid, "state": "handoff", "slot": None,
+                         "prompt_len": req.prompt_len,
+                         "max_new": req.max_new,
+                         "tokens": len(req.tokens),
+                         "submit_t": req.submit_t, "admit_t": req.admit_t,
+                         "deadline_ttft": req.deadline_ttft,
+                         "deadline_total": req.deadline_total,
+                         "status": req.status.value,
+                         "attempts": req.attempts,
+                         # the SOURCE replica that produced the payload:
+                         # a stuck handoff must be attributable
+                         "replica": self._owner.get(req.rid)})
+        return rows
+
+    def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
+        """Push ``Fleet/*`` (health rollup + goodput refreshed first)
+        through a monitor fan-out, same contract as the engines'."""
+        from ..observability.metrics import publish_registry
+
+        self.health()
+        self.fleet_goodput()
+        return publish_registry(self.registry, monitor, step,
+                                default_step_counter="Fleet/iterations")
+
+    def close(self) -> None:
+        """Teardown every replica (telemetry listeners etc.); the fleet
+        object is not reusable afterwards."""
+        for eng in self.replicas.values():
+            eng.close()

@@ -51,7 +51,7 @@ from ..resilience.guards import PagePoolExhausted
 
 __all__ = ["PagePool", "RadixPrefixTree", "PageAllocation",
            "init_paged_slots", "insert_paged", "hydrate_cache",
-           "PagePoolExhausted"]
+           "export_slot", "import_slot", "PagePoolExhausted"]
 
 _SCRATCH = 0        # reserved pool page: idle-slot / shared-entry sink
 
@@ -166,6 +166,63 @@ def hydrate_cache(state: GenCarry, cache, hydrate_row, count):
     cv = jnp.where(keep, gv, _page_split(cache.v, n, ps))
     return cache._replace(k=_page_merge(ck, cache.k),
                           v=_page_merge(cv, cache.v))
+
+
+def export_slot(state: GenCarry, row, slot) -> dict:
+    """Gather ONE request's pool pages + per-slot decode vectors into a
+    position-major payload: the SOURCE half of the disaggregated
+    prefill→decode handoff (serving/fleet.py). ``row`` is the slot's
+    full (pages_per_slot,) table row — page indirection is DATA, so one
+    compiled program exports any request on any slot. The payload is the
+    request's complete decode state: its prompt KV tiles (int8 pools
+    include the scale planes), the first sampled token, the per-request
+    RNG chain *after* that sample, the done flag, and the cache length —
+    everything a decode replica needs to continue the exact bit-stream.
+    The caller ``device_get``s the result: the transfer is host-mediated
+    by design (replicas share no device state)."""
+    c = state.cache
+    out = {"k": c.k[:, row], "v": c.v[:, row],           # (L, n, KV, ps, hd)
+           "tok": lax.dynamic_slice(state.tok, (slot,), (1,)),
+           "rng": lax.dynamic_slice(state.rng, (slot, 0), (1, 2)),
+           "done": lax.dynamic_slice(state.done, (slot,), (1,)),
+           "length": lax.dynamic_slice(c.length, (slot,), (1,))}
+    if c.k_scale is not None:
+        out["k_scale"] = c.k_scale[:, row]
+        out["v_scale"] = c.v_scale[:, row]
+    return out
+
+
+def import_slot(state: GenCarry, slot, payload: dict, row,
+                first_private) -> GenCarry:
+    """Scatter an exported payload into THIS pool's pages and seat the
+    slot vectors: the DESTINATION half of the handoff. ``row`` is the
+    destination allocation's table row; tiles below ``first_private``
+    (prefix pages the destination already shares via its own radix tree
+    — bit-identical KV by the parity oracle) redirect to the scratch
+    page exactly like :func:`insert_paged`'s shared entries, so a live
+    shared page is never rewritten. Every private page is overwritten
+    across its full extent (the stale-KV-impossible contract); garbage
+    tiles beyond ``length`` are invisible to the per-row attention mask
+    and progressively overwritten by decode appends."""
+    c = state.cache
+    n = row.shape[0]
+    tgt = jnp.where(jnp.arange(n) >= first_private, row, _SCRATCH)
+    k = c.k.at[:, tgt].set(payload["k"].astype(c.k.dtype))
+    v = c.v.at[:, tgt].set(payload["v"].astype(c.v.dtype))
+    if c.k_scale is not None:
+        k_scale = c.k_scale.at[:, tgt].set(payload["k_scale"])
+        v_scale = c.v_scale.at[:, tgt].set(payload["v_scale"])
+    else:
+        k_scale, v_scale = c.k_scale, c.v_scale
+    length = lax.dynamic_update_slice(
+        c.length, payload["length"].astype(jnp.int32), (slot,))
+    tok = lax.dynamic_update_slice(state.tok,
+                                   payload["tok"].astype(jnp.int32), (slot,))
+    rng = lax.dynamic_update_slice(state.rng, payload["rng"], (slot, 0))
+    done = lax.dynamic_update_slice(state.done, payload["done"], (slot,))
+    cache = PagedKVCache(k=k, v=v, k_scale=k_scale, v_scale=v_scale,
+                         page_table=c.page_table, length=length)
+    return GenCarry(tok=tok, cache=cache, rng=rng, done=done)
 
 
 # -------------------------------------------------------------- host side
@@ -422,12 +479,19 @@ class PagePool:
         return freed >= need
 
     def try_admit(self, prompt: np.ndarray, max_new: int,
-                  rid: int) -> Optional[PageAllocation]:
+                  rid: int, book_savings: bool = True) \
+            -> Optional[PageAllocation]:
         """Admission-time page plan: consult the prefix tree, take refs
         on the shared run, allocate private pages for the rest (evicting
         LRU tree-only pages under pressure). None = transiently full —
         the caller leaves the request at the queue head and retries
-        after a retirement."""
+        after a retirement.
+
+        ``book_savings=False`` (the disaggregated IMPORT path) still
+        allocates and shares pages but books no prefill-savings or
+        copy-on-write stats: a decode replica seating already-computed
+        KV skips no prefill compute, so counting its ``skip`` tokens as
+        saved would double-count the prefill replica's real savings."""
         prompt = np.asarray(prompt).reshape(-1)
         P, ps, n = len(prompt), self.page_size, self.pages_per_slot
         shared_ids, cow = (self.tree.match(prompt)
@@ -482,23 +546,27 @@ class PagePool:
             hyd[shared] = cow_src
             hydrate_pages = shared + 1
             skip += cow_len
-            self.cow_copies += 1
-            if self.registry is not None:
-                self.registry.counter("Serve/page_cow_copies").inc()
+            if book_savings:
+                self.cow_copies += 1
+                if self.registry is not None:
+                    self.registry.counter("Serve/page_cow_copies").inc()
         skip = min(skip, P - 1)
         alloc = PageAllocation(
             rid=rid, row=row, pages=total_need, shared=shared, skip=skip,
             hydrate_row=hyd, hydrate_pages=hydrate_pages,
             cow=cow_src is not None, cow_src=cow_src)
         self._alloc[rid] = alloc
-        self.prompt_tokens += P
-        self.prefill_tokens_saved += skip
+        if book_savings:
+            self.prompt_tokens += P
+            self.prefill_tokens_saved += skip
+            if self.registry is not None:
+                self.registry.counter(
+                    "Serve/page_prefill_tokens_saved").inc(skip)
         self.shared_page_acquires += shared
         self.private_page_acquires += private_need
         if self.registry is not None:
-            r = self.registry
-            r.counter("Serve/page_prefill_tokens_saved").inc(skip)
-            r.histogram("Serve/pages_per_request").observe(total_need)
+            self.registry.histogram(
+                "Serve/pages_per_request").observe(total_need)
         self._publish()
         return alloc
 

@@ -121,6 +121,13 @@ class Request:
     prompt: np.ndarray
     max_new: int
     seed: int
+    # failover visibility (serving/fleet.py): how many times this request
+    # was REQUEUED onto another replica after its original replica was
+    # lost. 0 on the single-engine path; surfaced in inflight_table and
+    # the request-log record so failover is never silent.
+    attempts: int = 0
+    # fleet session affinity key (None outside the fleet router)
+    session_id: "object | None" = None
     submit_t: float = 0.0
     admit_t: Optional[float] = None       # left the queue (prefill starts)
     first_token_t: Optional[float] = None
@@ -166,7 +173,7 @@ class Scheduler:
                  ttft_deadline_s: float = 0.0,
                  total_deadline_s: float = 0.0,
                  spans: "Optional[_spans.SpanRecorder]" = None,
-                 pages=None):
+                 pages=None, rid_source=None):
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
@@ -187,6 +194,11 @@ class Scheduler:
         self.queue: deque[Request] = deque()
         self.free: list[int] = list(range(slots))
         self.running: dict[int, Request] = {}
+        # rid allocation seam: the fleet router shares ONE counter across
+        # every replica's scheduler so a request id names a request
+        # fleet-wide (pop_result routes by rid, requeue keeps the id).
+        # None (default) = this scheduler owns its own namespace.
+        self.rid_source = rid_source
         self._next_rid = 0
 
     # -------------------------------------------------------------- intake
@@ -217,9 +229,13 @@ class Scheduler:
             except QueueFullError:
                 self.stats.on_shed(len(self.queue))
                 raise
-        req = Request(rid=self._next_rid, prompt=prompt, max_new=int(max_new),
+        if self.rid_source is not None:
+            rid = int(self.rid_source())
+        else:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = Request(rid=rid, prompt=prompt, max_new=int(max_new),
                       seed=int(seed))
-        self._next_rid += 1
         self.queue.append(req)
         req.submit_t = self.stats.on_submit(len(self.queue))
         ttft = self.ttft_deadline_s if ttft_deadline_s is None \
@@ -288,6 +304,64 @@ class Scheduler:
             self.spans.emit(_spans.PLACED, req.first_token_t, rid=req.rid,
                             slot=slot)
         return slot
+
+    def adopt(self, req: Request) -> int:
+        """Seat an ALREADY-prefilled request into a free slot without
+        re-recording its first token (disaggregated serving: the prefill
+        replica stamped ``first_token_t`` and appended the first token;
+        this decode-side scheduler only takes over the residency). The
+        caller guarantees a free slot exists."""
+        slot = self.free.pop(0)
+        req.slot = slot
+        self.running[slot] = req
+        if self.spans is not None:
+            self.spans.emit(_spans.PLACED, self.stats.clock(), rid=req.rid,
+                            slot=slot)
+        return slot
+
+    def requeue(self, req: Request) -> Request:
+        """Failover intake (serving/fleet.py): re-queue a request whose
+        replica was lost. The typed ``REQUEUED`` transition + ``attempts``
+        bump make the move visible; everything transient (tokens, slot,
+        first-token stamp, page plan) resets so the request re-runs from
+        prefill on THIS scheduler — per-request RNG folds from the seed,
+        so the rerun's bits match a fresh submission. ``submit_t`` and the
+        ABSOLUTE deadlines are preserved: failover does not grant a
+        request more wall time than its caller asked for."""
+        if len(req.prompt) + req.max_new > self.max_len:
+            raise ValueError(
+                f"requeued request {req.rid} (prompt {len(req.prompt)} + "
+                f"max_new {req.max_new}) exceeds max_len={self.max_len}")
+        req.status = RequestStatus.REQUEUED
+        req.attempts += 1
+        req.tokens = []
+        req.slot = -1
+        req.first_token_t = None
+        req.finish_t = None
+        req.admit_t = None
+        req.page_alloc = None
+        req.error = ""
+        # oldest-first at the head: a requeued request already spent its
+        # queue wait once; survivors' fresher submissions queue behind it
+        self.queue.appendleft(req)
+        self.stats.on_requeue(len(self.queue))
+        if self.spans is not None:
+            self.spans.emit(_spans.RETIRED, self.stats.clock(), rid=req.rid,
+                            slot=None, status=req.status.value,
+                            tokens=0)
+        return req
+
+    def take_live(self) -> list:
+        """Pull EVERY live request out of this scheduler (queue + running
+        slots), oldest submission first — the replica-loss path: the
+        fleet requeues them onto survivors. Slots free and the queue
+        empties; page refs are NOT released (the pool dies with the
+        replica)."""
+        live = list(self.queue) + list(self.running.values())
+        self.queue.clear()
+        self.running.clear()
+        self.free = list(range(self.slots))
+        return sorted(live, key=lambda r: (r.submit_t, r.rid))
 
     def complete_at_prefill(self, req: Request, first_tok: int) -> Request:
         """max_new == 1, or the first token was eos: done without ever
@@ -429,6 +503,10 @@ class Scheduler:
                 "admit_t": req.admit_t,
                 "deadline_ttft": req.deadline_ttft,
                 "deadline_total": req.deadline_total,
+                # failover visibility: a requeued request shows its typed
+                # status and move count while it waits again
+                "status": req.status.value,
+                "attempts": req.attempts,
             }
 
         rows = []
