@@ -21,6 +21,13 @@ through the two fleet scenarios the ROADMAP names:
 ``--smoke`` is the CPU tier-1 gate (wired via tests/unit/test_fleet.py,
 same pattern as bench_serving.py): asserts both oracles plus a warm
 ``add_replica`` join compiling NOTHING, and writes ``FLEET_BENCH.json``.
+The disaggregated phase additionally runs with distributed tracing ON
+and a decode-replica kill mid-traffic: it asserts the hop sum-to-e2e
+invariant (every completed request's queue_wait/prefill/handoff_wait/
+import/decode hops tile its e2e wall within 1% on the fake clock), a
+route-audit entry for every routing decision, and a merged fleet
+Chrome trace (replicas as pids, cross-replica request flows) that
+passes ``validate_chrome_trace`` — written to ``FLEET_TRACE.json``.
 Prints one JSON line ending in "smoke-pass"; exits nonzero on failure.
 """
 
@@ -179,22 +186,40 @@ def smoke():
     assert je.stats.snapshot()["retired"] >= 1, \
         "joined replica never received traffic"
     gp = fleet.fleet_goodput()
+    # (6) requeue attribution: kill → re-admission lands in its OWN
+    # Serve/requeue_delay_s histogram, one observation per requeue (so
+    # TTFT and failover delay stay separable in the request log)
+    rq_delays = sum(int(e.stats.registry.snapshot()["histograms"]
+                        .get("Serve/requeue_delay_s", {}).get("count", 0))
+                    for e in fleet.replicas.values())
+    assert rq_delays == requeued, \
+        f"requeue_delay_s observations {rq_delays} != requeued {requeued}"
+    # tracing stayed DISABLED in this phase: no fleet ring, no audit —
+    # and the compile counters above already pinned the program set
+    assert fleet.spans is None and fleet.route_audit() == []
     res["failover"] = {
         "replicas": 3, "requests": len(rids), "requeued": requeued,
         "kills": int(snap["fleet"].get("Fleet/replica_kills", 0)),
         "lost": 0, "warm_compiles_total": total_warm,
         "survivor_compiles_frozen": True,
         "joined_replica_compiles": je.compiles,
+        "requeue_delay_observations": rq_delays,
         "fleet_goodput_frac": (round(gp["goodput_frac"], 4)
                                if gp and gp["goodput_frac"] is not None
                                else None),
     }
     fleet.close()
 
-    # ---- B) disaggregated prefill/decode parity --------------------
+    # ---- B) disaggregated chaos run + distributed tracing ----------
+    # prefill replica + 2 decode replicas, tracing ON, one decode
+    # replica killed mid-decode: the acceptance scenario for the
+    # fleet-wide trace (hops sum to e2e, merged trace w/ cross-replica
+    # flows, a route-audit entry behind every decision)
+    from deepspeed_tpu.observability import validate_chrome_trace
+
     clock2 = TickClock()
     fl2 = build_fleet(eng, replicas=3, prefill_replicas=1, clock=clock2,
-                      page_size=8)
+                      page_size=8, spans=True)
     sys_p = np.random.default_rng(7).integers(0, 256, (16,)).astype(np.int32)
     rng = np.random.default_rng(5)
     prompts = [np.concatenate([sys_p, rng.integers(0, 256, (k,))
@@ -203,17 +228,29 @@ def smoke():
     rids2 = [fl2.submit(p, 5, seed=200 + i, session_id=f"s{i % 3}")
              for i, p in enumerate(prompts)]
     done2 = {}
+    killed = False
     it = 0
     while len(done2) < len(rids2):
         for req in fl2.step():
             done2[req.rid] = req
+        if not killed and "d1" in fl2.replicas \
+                and fl2.replicas["d1"].sched.running:
+            # d1 is decoding a handed-off request: kill it NOW — its
+            # residents requeue through prefill and hand off again
+            fl2.kill_replica("d1")
+            killed = True
         it += 1
         assert it < 100_000
+    assert killed, "d1 never held a decoding request — kill never fired"
+    requeued2 = int(fl2.registry.snapshot()["counters"]
+                    .get("Fleet/requeued", 0))
+    assert requeued2 >= 1, "the kill orphaned nothing"
     for i, (p, rid) in enumerate(zip(prompts, rids2)):
         got = np.asarray(done2[rid].tokens, np.int32)
         want = solo_oracle(eng, p, 5, 200 + i, max_len)
         assert np.array_equal(got, want[:len(got)]), \
-            f"disaggregated rid {rid} diverged from solo generate"
+            f"disaggregated rid {rid} diverged from solo generate " \
+            f"(attempts={done2[rid].attempts})"
     snap2 = fl2.metrics_snapshot()
     handoffs = int(snap2["fleet"].get("Fleet/handoffs", 0))
     imports = int(snap2["fleet"].get("Fleet/handoff_imports", 0))
@@ -230,11 +267,65 @@ def smoke():
     saved = sum(e.pool.snapshot()["prefill_tokens_saved"]
                 for n, e in fl2.replicas.items()
                 if fl2.roles[n] == "prefill")
+    # (t1) hop sum-to-e2e invariant: every completed request's non-null
+    # hops tile [submit, finish] — within 1% on the fake clock
+    worst_err = 0.0
+    with_handoff = 0
+    hop_keys = ("queue_wait", "prefill", "handoff_wait", "import",
+                "decode")
+    for rid in rids2:
+        tr = fl2.request_trace(rid)
+        assert tr is not None, f"request_trace({rid}) unknown"
+        hops = tr["hops"]
+        total = sum(hops[f"{k}_s"] or 0.0 for k in hop_keys)
+        assert hops["e2e_s"] and hops["e2e_s"] > 0
+        err = abs(total - hops["e2e_s"]) / hops["e2e_s"]
+        assert err <= 0.01, f"rid {rid}: hops {total} vs e2e " \
+            f"{hops['e2e_s']} ({err:.2%})"
+        worst_err = max(worst_err, err)
+        if hops["handoff_wait_s"] is not None:
+            with_handoff += 1
+    assert with_handoff >= 1, "no request carried handoff hops"
+    # (t2) route audit: every routing decision is explained — ranked
+    # candidates with per-replica exclusion reasons behind each rid
+    for rid in rids2:
+        audit = fl2.route_audit(rid)
+        assert audit, f"rid {rid} has no route-audit entry"
+        assert all(e["candidates"] for e in audit), rid
+    kill_moves = [e for e in fl2.route_audit()
+                  if e["event"] in ("requeue", "requeue_shed")]
+    assert len(kill_moves) == requeued2
+    # (t3) ONE merged Chrome trace: replicas as pids, request hops
+    # stitched into cross-replica flows, schema-valid
+    merged = fl2.merge_trace()
+    problems = validate_chrome_trace(merged)
+    assert problems == [], problems
+    evs = merged["traceEvents"]
+    flow = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    pids = sorted({e["pid"] for e in evs if e["ph"] != "M"})
+    assert flow, "merged trace has no flow events"
+    assert len({e["pid"] for e in flow}) >= 2, \
+        "flows never crossed a replica boundary"
+    assert len(pids) >= 3, f"expected router + >=2 replica pids: {pids}"
+    trace_out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "FLEET_TRACE.json")
+    with open(trace_out, "w") as f:
+        json.dump(merged, f)
     res["disaggregated"] = {
         "replicas": 3, "prefill_replicas": 1, "requests": len(rids2),
         "handoffs": handoffs, "handoff_imports": imports,
-        "parity_with_solo": True,
+        "parity_with_solo": True, "decode_replica_killed": True,
+        "requeued": requeued2,
         "prefill_tokens_saved_at_source": int(saved),
+    }
+    res["tracing"] = {
+        "hop_sum_worst_rel_err": round(worst_err, 6),
+        "requests_with_handoff_hops": with_handoff,
+        "route_audit_entries": len(fl2.route_audit()),
+        "merged_trace_valid": True,
+        "merged_trace_events": len(evs),
+        "flow_events": len(flow), "pids": pids,
+        "trace_file": "FLEET_TRACE.json",
     }
     fl2.close()
 

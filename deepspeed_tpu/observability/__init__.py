@@ -15,7 +15,8 @@ from .capacity import (ProgramCensus, capacity_report, hbm_ledger,
                        kv_cache_bytes, validate_capacity_report,
                        write_capacity_report)
 from .expfmt import exposition_from_events, render_exposition
-from .export import (RequestLogSink, request_record, to_chrome_trace,
+from .export import (HOP_NAMES, RequestLogSink, hop_trace,
+                     merge_fleet_trace, request_record, to_chrome_trace,
                      validate_chrome_trace, write_chrome_trace)
 from .fleet_scrape import FleetScraper
 from .flight import (FlightRecorder, newest_flight_record,
@@ -49,6 +50,7 @@ __all__ = [
     "FlightRecorder", "newest_flight_record", "read_flight_record",
     "RequestLogSink", "request_record", "to_chrome_trace",
     "validate_chrome_trace", "write_chrome_trace",
+    "merge_fleet_trace", "hop_trace", "HOP_NAMES",
     "SLOConfig", "SLOScorer", "MedianMADDetector", "CompileStormDetector",
     "WorkloadAnalyzer", "WorkloadConfig",
     "ProgramCensus", "hbm_ledger", "kv_cache_bytes", "capacity_report",

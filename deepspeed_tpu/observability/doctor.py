@@ -8,15 +8,23 @@ running engine, no device):
   grouped by terminal status;
 - the newest flight record (``flight_*/``) — reason, markers, the
   slowest spans, and where the trace.json lives for Perfetto;
+- the newest incident dir (``incident_*/`` — the fleet's correlated
+  cross-replica capture) — which replicas dumped, the merged
+  cross-replica timeline, the route-audit summary, and where the merged
+  Perfetto trace lives;
 - the newest capacity report (``CAPACITY_REPORT*.json``) — HBM ledger
   totals and the advisor's ranked levers (docs/OPERATIONS.md
   capacity-planning runbook).
 
 Exit code is the CI/cron gate: **nonzero** when the newest flight record
 contains a why-marker (watchdog stall, SLO breach, anomaly, compile
-storm — something fired since the record was cut) or when any
-``dstpu_*_burn`` SLO gauge in the latest .prom is above zero; 0 on a
-clean replica. ``--no-gate`` restores the always-0 report-only behavior.
+storm — something fired since the record was cut), when any
+``dstpu_*_burn`` SLO gauge in the latest .prom is above zero, or when
+the newest incident dir is UNRECONCILED (per-replica dumps from fewer
+replicas than the fleet had live — the post-mortem is incomplete); 0 on
+a clean replica. ``--no-gate`` restores the always-0 report-only
+behavior. ``--targets`` combined with ``--flight-dir`` runs the
+incident gate alongside fleet triage.
 
 ``--url http://host:port`` switches to **live mode**: instead of files,
 the doctor scrapes a running engine's telemetry plane
@@ -159,6 +167,101 @@ def report_flight(d: Path, slow: int = 5) -> list:
         return [f"flight record {rec_dir.name} contains why-marker(s): "
                 + ", ".join(names)]
     return []
+
+
+def newest_incident_dir(d: Path) -> Optional[Path]:
+    """Most recent ``incident_*`` directory (the fleet's correlated
+    cross-replica capture — serving/fleet.py) under ``d``, or None."""
+    if not d.is_dir():
+        return None
+    cands = [p for p in d.iterdir()
+             if p.is_dir() and p.name.startswith("incident_")]
+    if not cands:
+        return None
+    return max(cands, key=lambda p: (p.stat().st_mtime, p.name))
+
+
+def report_incidents(d: Path, events: int = 12) -> list:
+    """Print the newest incident dir and reconstruct the cross-replica
+    timeline (every replica's dumped events + the fleet ring, merged by
+    timestamp — all rings share the fleet's injectable clock). Gate
+    finding: an UNRECONCILED incident — per-replica dumps from fewer
+    replicas than the fleet had live when it opened (a replica's
+    recorder hit max_dumps, an unwritable disk, or a crash mid-fan-out:
+    the post-mortem is incomplete and someone should know)."""
+    from .flight import load_jsonl_tolerant
+
+    inc = newest_incident_dir(d)
+    if inc is None:
+        return []
+    findings: list = []
+    try:
+        mf = json.loads((inc / "incident.json").read_text(errors="replace"))
+    except (OSError, json.JSONDecodeError):
+        mf = {}
+    if not isinstance(mf, dict):
+        mf = {}
+    live = mf.get("replicas_live")
+    expected = mf.get("replicas") if isinstance(mf.get("replicas"), list) \
+        else []
+    # a replica's dump is real only when its subdir carries a manifest —
+    # an empty directory left by a crashed dump does not reconcile
+    sub = sorted(p.name for p in inc.iterdir()
+                 if p.is_dir() and p.name != "fleet"
+                 and (p / "manifest.json").exists())
+    print(f"[incident] {inc}")
+    print(f"  id={mf.get('incident_id', inc.name)} "
+          f"reason={mf.get('reason')} "
+          f"trigger={mf.get('trigger_replica')} at {mf.get('wall_time')}")
+    print(f"  replica dumps: {len(sub)}/{live if live is not None else '?'}"
+          f" live ({', '.join(sub) or 'none'})")
+    if isinstance(live, int) and len(sub) < live:
+        missing = sorted(set(str(n) for n in expected) - set(sub))
+        findings.append(
+            f"unreconciled incident {inc.name}: dumps from {len(sub)} of "
+            f"{live} live replicas"
+            + (f" (missing: {', '.join(missing)})" if missing else ""))
+    # cross-replica timeline: merge the dumped rings by t0 (one shared
+    # injectable clock), label each event with where it happened
+    rows: list = []
+    for name in sub:
+        p = inc / name / "events.jsonl"
+        if p.exists():
+            evs, _ = load_jsonl_tolerant(p)
+            rows += [(e.get("t0", 0.0), name, e) for e in evs
+                     if isinstance(e, dict)]
+    fev = inc / "fleet" / "events.jsonl"
+    if fev.exists():
+        evs, _ = load_jsonl_tolerant(fev)
+        rows += [(e.get("t0", 0.0), "fleet", e) for e in evs
+                 if isinstance(e, dict)]
+    rows.sort(key=lambda r: r[0])
+    if rows:
+        print(f"  timeline (last {min(events, len(rows))} of {len(rows)} "
+              "events across replicas):")
+        for t0, who, e in rows[-events:]:
+            kind = e.get("kind", "?")
+            if kind == "marker":
+                kind = f"marker:{dict(e.get('meta', {})).get('name', '?')}"
+            extra = " ".join(f"{k}={e[k]}" for k in ("rid", "slot", "step")
+                             if k in e)
+            meta = dict(e.get("meta", {}))
+            status = meta.get("status")
+            if status:
+                extra = (extra + f" status={status}").strip()
+            print(f"    t={t0:<12.6g} [{who:>8s}] {kind:<18s} "
+                  f"{extra}".rstrip())
+    audit = inc / "fleet" / "route_audit.jsonl"
+    if audit.exists():
+        entries, _ = load_jsonl_tolerant(audit)
+        by_ev = _Counter(e.get("event", "?") for e in entries)
+        print("  route audit: " + " ".join(f"{k}={n}" for k, n
+                                           in sorted(by_ev.items())))
+    tr = inc / "fleet" / "trace_merged.json"
+    if tr.exists():
+        print(f"  perfetto: load {tr} at https://ui.perfetto.dev "
+              "(replicas as processes, requests as flows)")
+    return findings
 
 
 def report_capacity(d: Path, levers: int = 4) -> None:
@@ -372,7 +475,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default="./monitor",
                     help="monitor output directory (default ./monitor)")
     ap.add_argument("--flight-dir", default=None,
-                    help="flight-record directory (default: --dir)")
+                    help="flight-record / incident directory (default: "
+                         "--dir); with --targets, enables the "
+                         "unreconciled-incident gate alongside live "
+                         "triage")
     ap.add_argument("--requests", type=int, default=8,
                     help="recent request rows to show (default 8)")
     ap.add_argument("--no-gate", action="store_true",
@@ -395,14 +501,20 @@ def main(argv=None) -> int:
         findings = report_fleet(
             [t for t in args.targets.split(",") if t],
             timeout=args.timeout)
+        if args.flight_dir:
+            # fleet triage + a shared flight dir: the incident gate runs
+            # too — an unreconciled incident (dumps from fewer replicas
+            # than were live) trips CI even when every target is up
+            findings += report_incidents(Path(args.flight_dir))
     elif args.url:
         findings = report_live(args.url, timeout=args.timeout)
     else:
         d = Path(args.dir)
         findings = report_prometheus(d)
         report_requests(d, args.requests)
-        findings += report_flight(Path(args.flight_dir) if args.flight_dir
-                                  else d)
+        fdir = Path(args.flight_dir) if args.flight_dir else d
+        findings += report_flight(fdir)
+        findings += report_incidents(fdir)
         report_capacity(d)
     if findings:
         print(f"[gate] {len(findings)} finding(s):")

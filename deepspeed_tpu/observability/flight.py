@@ -33,6 +33,25 @@ from ..utils.logging import log_dist
 from . import spans as S
 
 
+def sanitize_reason(reason: str, fallback: str = "manual") -> str:
+    """A dump/incident reason as a filesystem-safe directory-name part
+    (shared by the flight recorder and the fleet's incident capture so
+    the two artifact families cannot drift on naming)."""
+    return "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in reason)[:48] or fallback
+
+
+def unique_dir(base: Path) -> Path:
+    """``base``, or ``base.k`` for the first k that doesn't exist yet
+    (same second + same reason collide on the strftime stamp)."""
+    d = base
+    k = 0
+    while d.exists():
+        k += 1
+        d = base.with_name(f"{base.name}.{k}")
+    return d
+
+
 def _json_default(o):
     # numpy values reach dumps() from metric snapshots: scalars via
     # .item(), arrays via .tolist() (.item() RAISES on size != 1, and a
@@ -77,6 +96,14 @@ class FlightRecorder:
         self.job_name = job_name
         self.max_dumps = int(max_dumps)
         self.dumps: list[Path] = []
+        # incident-correlation seam (serving/fleet.py): when set, every
+        # dump asks ``redirect(reason)`` for a target directory FIRST —
+        # the fleet's handler opens a shared incident dir, fans the dump
+        # out to every sibling recorder, and returns this recorder's
+        # subdirectory, so one replica's trigger becomes one correlated
+        # cross-replica capture. None (default) = dumps land under
+        # ``dump_dir`` exactly as before.
+        self.redirect: Optional[Callable[[str], Optional[Path]]] = None
         self._markers = S.SpanRecorder(capacity=256, clock=self.clock)
         self._requests: deque[dict] = deque(maxlen=int(recent_requests))
         # RLock for the same reason as SpanRecorder: dump() runs inside
@@ -117,22 +144,39 @@ class FlightRecorder:
         evs.sort(key=lambda e: e.t0)
         return evs
 
-    def dump(self, reason: str = "manual") -> Optional[Path]:
+    def dump(self, reason: str = "manual",
+             into: "Optional[Path]" = None) -> Optional[Path]:
         """Freeze the black box into ``<dump_dir>/flight_<stamp>_<reason>``.
         Returns the directory, or None once ``max_dumps`` is reached (the
-        rings keep recording; only new directories stop)."""
+        rings keep recording; only new directories stop). ``into`` dumps
+        to that EXACT directory instead (the fleet's incident fan-out
+        targets ``<incident_dir>/<replica>``); when unset, an installed
+        :attr:`redirect` hook is asked for one first."""
         with self._lock:
             if self.max_dumps and len(self.dumps) >= self.max_dumps:
+                # checked BEFORE the redirect hook: a dump-capped
+                # recorder must not keep opening fleet incidents (the
+                # cap bounds disk for the whole correlated capture too)
                 return None
-            stamp = time.strftime("%Y%m%d-%H%M%S")
-            safe = "".join(c if c.isalnum() or c in "-_" else "_"
-                           for c in reason)[:48] or "manual"
+        if into is None and self.redirect is not None:
             try:
-                d = self.dump_dir / f"flight_{stamp}_{safe}"
-                k = 0
-                while d.exists():      # same second, same reason: suffix
-                    k += 1
-                    d = self.dump_dir / f"flight_{stamp}_{safe}.{k}"
+                into = self.redirect(reason)
+            except Exception as e:
+                # the correlation plumbing must never cost the LOCAL
+                # post-mortem: fall back to a plain dump
+                log_dist(f"flight recorder: incident redirect failed "
+                         f"({e!r}); dumping locally", ranks=[0],
+                         level="WARNING")
+                into = None
+        with self._lock:
+            if self.max_dumps and len(self.dumps) >= self.max_dumps:
+                return None          # raced a dump during the redirect
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            safe = sanitize_reason(reason)
+            try:
+                d = unique_dir(Path(into) if into is not None
+                               else self.dump_dir
+                               / f"flight_{stamp}_{safe}")
                 d.mkdir(parents=True)
             except OSError as e:
                 # full/read-only disk: losing the dump is acceptable;

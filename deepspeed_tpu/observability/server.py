@@ -19,7 +19,11 @@ Read endpoints (GET):
   AOT program census (expensive — off by default per scrape);
 - ``/goodput``  — the goodput/badput decomposition (``goodput.py``);
 - ``/flight``   — newest flight-record summary (manifest + why-marker
-  names), the live analog of the doctor's file-mode flight section.
+  names), the live analog of the doctor's file-mode flight section;
+- ``/trace``    — the engine's span ring as a Chrome/Perfetto trace
+  (save and load at ui.perfetto.dev); ``?rid=N`` returns that request's
+  hop-latency decomposition (queue_wait/prefill/handoff_wait/import/
+  decode/e2e) instead.
 
 Control endpoints (POST, token-gated — see below):
 
@@ -108,6 +112,8 @@ class TelemetryHooks:
     capacity_fn: Optional[Callable[[bool], dict]] = None   # (census) ->
     goodput_fn: Optional[Callable[[], dict]] = None
     flight_fn: Optional[Callable[[], dict]] = None
+    # (rid | None) -> chrome trace dict / hop decomposition / None(→404)
+    trace_fn: Optional[Callable[[Optional[int]], object]] = None
     drain_fn: Optional[Callable[[bool], dict]] = None      # (end) ->
     dump_fn: Optional[Callable[[], Optional[str]]] = None
     slo_reload_fn: Optional[Callable[[dict], dict]] = None
@@ -305,6 +311,25 @@ def _make_handler(server: TelemetryServer):
                                               "configured"})
                 else:
                     self._json(200, h.flight_fn())
+            elif path == "/trace":
+                if h.trace_fn is None:
+                    self._json(404, {"error": "no trace hook"})
+                    return
+                q = parse_qs(parsed.query)
+                rid_s = q.get("rid", [None])[0]
+                try:
+                    rid = None if rid_s is None else int(rid_s)
+                except ValueError:
+                    self._json(400, {"error": f"bad rid {rid_s!r}"})
+                    return
+                obj = h.trace_fn(rid)
+                if obj is None:
+                    self._json(404, {"error":
+                                     f"unknown rid {rid}" if rid is not None
+                                     else "span ring disabled "
+                                          "(set serving.spans)"})
+                else:
+                    self._json(200, obj)
             elif path == "/":
                 eps = {"/metrics": h.registry is not None,
                        "/healthz": True, "/readyz": True,
@@ -312,6 +337,7 @@ def _make_handler(server: TelemetryServer):
                        "/capacity": h.capacity_fn is not None,
                        "/goodput": h.goodput_fn is not None,
                        "/flight": h.flight_fn is not None,
+                       "/trace": h.trace_fn is not None,
                        "POST /drain": h.drain_fn is not None,
                        "POST /flight/dump": h.dump_fn is not None,
                        "POST /slo/reload": h.slo_reload_fn is not None}

@@ -45,12 +45,23 @@ TRAIN_STEP = "train_step"          # span: one train_batch() call
 TRAIN_PHASE = "train_phase"        # span: a wall-clock-breakdown timer
                                    # interval (batch_prep/step_dispatch/
                                    # step_sync, fwd/bwd/host_step offload)
+# Fleet request hops (serving/fleet.py — recorded in the FLEET-level
+# ring, rid-carrying; the cross-replica half of a distributed trace):
+ROUTE = "route"                    # instant: router picked an admission
+                                   # target (meta: replica)
+REQUEUE = "requeue"                # instant: failover moved the request
+                                   # onto a survivor (meta: replica,
+                                   # attempt)
+HANDOFF_EXPORT = "handoff_export"  # span: prefill pages gathered to host
+HANDOFF_PENDING = "handoff_pending"  # span: payload host-held, waiting
+                                   # for a decode slot/pool
+HANDOFF_IMPORT = "handoff_import"  # span: scatter into the decode replica
 # Cross-cutting:
 MARKER = "marker"                  # instant: SLO burn, anomaly, watchdog,
                                    # compile storm — the "why" of a dump
 
 _COUNTER_KINDS = frozenset({OCCUPANCY})
-_INSTANT_KINDS = frozenset({PLACED, RETIRED, MARKER})
+_INSTANT_KINDS = frozenset({PLACED, RETIRED, MARKER, ROUTE, REQUEUE})
 
 
 @dataclasses.dataclass

@@ -256,6 +256,14 @@ class ServingStats:
         r.counter("Serve/requeued").inc()
         r.gauge("Serve/queue_depth").set(queue_depth)
 
+    def on_requeue_delay(self, delay_s: float) -> None:
+        """A REQUEUED request was re-admitted: ``delay_s`` is kill →
+        re-admission on the injectable clock. Its own histogram keeps
+        failover cost separable from TTFT in the request log (a requeued
+        request's TTFT legitimately includes this delay — without the
+        split, a failover burst reads as a latency regression)."""
+        self.registry.histogram("Serve/requeue_delay_s").observe(delay_s)
+
     def on_watchdog_stall(self, step_s: float, threshold_s: float) -> None:
         """One decode step exceeded the watchdog budget."""
         r = self.registry
@@ -326,4 +334,5 @@ class ServingStats:
             "ttft_s": h.get("Serve/ttft_s", {}),
             "tpot_s": h.get("Serve/tpot_s", {}),
             "queue_wait_s": h.get("Serve/queue_wait_s", {}),
+            "requeue_delay_s": h.get("Serve/requeue_delay_s", {}),
         }

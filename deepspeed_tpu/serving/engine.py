@@ -639,6 +639,7 @@ class ServingEngine:
         params = self.engine.params
         sp = self.spans
         ct0 = sp.clock() if sp is not None else 0.0
+        att = self.sched._attempt_meta(req)
         if not ch.final:
             fwd = self._prog(("chunk", ch.size), lambda: jax.jit(
                 self._chunk_impl, donate_argnums=(1,)))
@@ -647,7 +648,7 @@ class ServingEngine:
                 # dispatch wall time: honest on CPU, a lower bound where
                 # the chunk overlaps the async device queue
                 sp.emit(_spans.PREFILL_CHUNK, ct0, sp.clock(), rid=req.rid,
-                        chunk=idx, size=ch.size, final=False)
+                        chunk=idx, size=ch.size, final=False, **att)
             self._prefill = (req, plan, idx + 1, cache, rng)
             return []
         fin = self._prog(("final", ch.size), lambda: jax.jit(
@@ -656,7 +657,7 @@ class ServingEngine:
                  jnp.int32(ch.last_index), jnp.int32(ch.true_len), rng)
         if sp is not None:
             sp.emit(_spans.PREFILL_CHUNK, ct0, sp.clock(), rid=req.rid,
-                    chunk=idx, size=ch.size, final=True)
+                    chunk=idx, size=ch.size, final=True, **att)
         self._prefill = None
         first_tok = int(np.asarray(pf.tok)[0])
         if req.max_new == 1 or bool(np.asarray(pf.done)[0]):
@@ -780,6 +781,10 @@ class ServingEngine:
                                     book_savings=False)
         if alloc is None:
             return False
+        # hop stamp: the import window opens now (the attempt that will
+        # seat the request — failed probes above returned before work);
+        # handoff_wait_s ends here, import_s covers the scatter below
+        req.import_t0 = self.stats.clock()
         req.page_alloc = alloc
         slot = self.sched.adopt(req)
         if req.deadline_ttft is not None or req.deadline_total is not None:
@@ -796,6 +801,7 @@ class ServingEngine:
                               {k: jnp.asarray(v) for k, v in payload.items()},
                               jnp.asarray(alloc.row), jnp.int32(alloc.shared))
             self.pool.on_inserted(req.rid, req.prompt)
+        req.import_t1 = self.stats.clock()
         return True
 
     def serve_batch(self, prompts, max_new_tokens=None, seeds=None) -> list:
@@ -924,6 +930,41 @@ class ServingEngine:
         subscript."""
         p = self._prefill
         return self.sched.inflight_table(p[0] if p is not None else None)
+
+    def _find_request(self, rid: int) -> Optional[Request]:
+        """The request wherever it lives on THIS engine — results,
+        prefill lane, slots, or queue; None if unknown here. Containers
+        are copied before iteration: the telemetry HTTP thread calls
+        this while the serving loop mutates them."""
+        req = self.results.get(rid)
+        if req is not None:
+            return req
+        p = self._prefill
+        if p is not None and p[0].rid == rid:
+            return p[0]
+        for r in list(self.sched.running.values()):
+            if r.rid == rid:
+                return r
+        for r in list(self.sched.queue):
+            if r.rid == rid:
+                return r
+        return None
+
+    def request_trace(self, rid: int) -> Optional[dict]:
+        """One request's hop-latency decomposition
+        (:func:`~..observability.export.hop_trace`) — finished requests
+        from ``results``, live ones from the scheduler (hops completed
+        so far; the rest null). None when this engine doesn't know the
+        rid. Host timestamps only — no span ring required, no device
+        reads."""
+        from ..observability.export import hop_trace
+
+        req = self._find_request(rid)
+        if req is None:
+            return None
+        return {"rid": rid, "status": req.status.value,
+                "finished": req.finished, "slot": req.slot,
+                "tokens": len(req.tokens), "hops": hop_trace(req)}
 
     # ----------------------------------------------------------- capacity
     def capacity_census(self) -> dict:
@@ -1120,6 +1161,7 @@ class ServingEngine:
                         else None),
             flight_fn=((lambda: flight_summary(self.flight))
                        if self.flight is not None else None),
+            trace_fn=self._trace_endpoint,
             drain_fn=self._drain_control,
             dump_fn=((lambda: self.dump_flight("manual"))
                      if self.flight is not None else None),
@@ -1131,6 +1173,20 @@ class ServingEngine:
         bound = server.start()
         self.telemetry = server
         return bound
+
+    def _trace_endpoint(self, rid: Optional[int]):
+        """The ``GET /trace`` hook: ``?rid=N`` returns that request's
+        hop-latency decomposition (:meth:`request_trace`); without a rid
+        it returns the engine's span ring as a Chrome/Perfetto trace —
+        None (→404) when spans are disabled or the rid is unknown."""
+        if rid is not None:
+            return self.request_trace(rid)
+        if self.spans is None:
+            return None
+        from ..observability.export import to_chrome_trace
+
+        return to_chrome_trace(self.spans.events(),
+                               job_name=self.name or "serving")
 
     def _drain_control(self, end: bool) -> dict:
         """The ``POST /drain`` hook: begin (default) or end

@@ -40,6 +40,27 @@ are per-replica). What the fleet adds:
   chain travels with the payload, so disaggregated output is
   bit-identical to a single engine's (the parity oracle in tier-1).
 
+- **Distributed request tracing** — with ``serving.spans`` on, the
+  fleet keeps its OWN span ring (router decisions, requeues, handoff
+  export/pending/import hops) next to each replica's lifecycle ring, a
+  bounded **route-audit ring** (every route / shed / affinity-fallback
+  / requeue with the ranked candidates and per-replica exclusion
+  reasons — :meth:`FleetEngine.route_audit`), a per-request
+  **hop-latency decomposition** whose non-null hops tile the request's
+  e2e wall (:meth:`FleetEngine.request_trace`, ``Fleet/hop_*``
+  histograms), and :meth:`FleetEngine.merge_trace` — ONE
+  Chrome/Perfetto trace with every replica as a named pid and each
+  cross-replica request stitched into a flow. Disabled (the default),
+  none of it exists.
+- **Correlated incident capture** — with ``serving.flight_dir`` set,
+  ANY replica's flight-recorder trigger (watchdog stall, nonfinite
+  halt, SIGTERM, manual) redirects into one shared
+  ``incident_<stamp>_<reason>/`` directory and fans out: every sibling
+  replica dumps too, and the fleet adds ``incident.json``, its ring,
+  the route audit, and the merged cross-replica trace. The doctor's
+  incident section reconstructs the timeline and gates on an
+  unreconciled capture.
+
 ``Fleet/*`` metrics land in the fleet's own
 :class:`~..observability.metrics.MetricsRegistry` (same sinks as
 everything else via :meth:`publish_metrics`); fleet goodput is the
@@ -52,17 +73,22 @@ threads.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
+from pathlib import Path
 from typing import Optional
 
 from ..inference.config import ServingConfig
 from ..inference.engine import InferenceEngine
+from ..observability import spans as _spans
+from ..observability.export import HOP_NAMES, hop_trace
 from ..observability.metrics import MetricsRegistry
 from ..resilience.chaos import FleetChaosConfig, FleetChaosMonkey
 from ..resilience.guards import QueueFullError, RequestStatus
-from ..utils.logging import warning_once
+from ..utils.logging import log_dist, warning_once
 from .engine import _MAX_RESULTS, ServingEngine
 from .scheduler import Request
 
@@ -74,6 +100,10 @@ __all__ = ["FleetEngine"]
 ROLE_SERVE = "serve"
 ROLE_PREFILL = "prefill"
 ROLE_DECODE = "decode"
+
+# Router decision audit ring capacity (host dicts; ~minutes of context
+# around an incident — the flight/incident dump carries it to disk).
+_AUDIT_RING = 1024
 
 
 class FleetEngine:
@@ -94,7 +124,8 @@ class FleetEngine:
                  replicas: int = 2, prefill_replicas: int = 0,
                  names: Optional[list] = None, chaos=None,
                  registry=None, clock=None, session_cap: int = 4096,
-                 programs: Optional[OrderedDict] = None):
+                 programs: Optional[OrderedDict] = None,
+                 tracing: Optional[bool] = None):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
         if prefill_replicas < 0 or (prefill_replicas
@@ -150,6 +181,38 @@ class FleetEngine:
             return rid
 
         self._rid = _rid
+        # ---- distributed tracing (docs/OBSERVABILITY.md fleet tracing).
+        # Follows the replicas' span knob by default: serving.spans=True
+        # gives every replica its ring AND the fleet this router/handoff
+        # ring + the route-audit ring. Disabled (the default) builds
+        # NEITHER — the fleet layer pays `is not None` checks only, zero
+        # new programs (the bench_fleet --smoke compile freeze stays the
+        # oracle).
+        self._tracing = bool(cfg0.spans) if tracing is None \
+            else bool(tracing)
+        self.spans: "Optional[_spans.SpanRecorder]" = None
+        self._audit: "Optional[deque]" = None
+        self._audit_seq = 0
+        if self._tracing:
+            self.spans = _spans.SpanRecorder(cfg0.spans_ring,
+                                             clock=self._clock)
+            self._audit = deque(maxlen=_AUDIT_RING)
+        # ---- correlated incident capture: when the replicas carry
+        # flight recorders (serving.flight_dir), any one replica's dump
+        # trigger (watchdog stall, nonfinite halt, SIGTERM, manual) is
+        # redirected into ONE shared incident dir and fanned out to
+        # every other replica + the fleet's own artifacts + a merged
+        # trace. No flight_dir = no machinery.
+        self._incident_base: Optional[Path] = \
+            Path(cfg0.flight_dir) if cfg0.flight_dir is not None else None
+        self._incident_open: Optional[Path] = None
+        # (dir, fleet iteration) of the newest capture: a second
+        # TRIGGER in the same iteration joins it instead of opening a
+        # duplicate (two replicas tripping on one event, or a manual
+        # /flight/dump racing the serving thread's watchdog)
+        self._incident_last: "Optional[tuple[Path, int]]" = None
+        self._incident_lock = threading.RLock()
+        self._incidents = 0
         self.replicas: "OrderedDict[str, ServingEngine]" = OrderedDict()
         self.roles: dict = {}
         self._draining = False
@@ -217,6 +280,12 @@ class FleetEngine:
         if role == ROLE_PREFILL:
             eng.on_placed = (lambda req, slot, _n=name:
                              self._on_prefill_placed(_n, req, slot))
+        if eng.flight is not None:
+            # correlated incident capture: this replica's dump triggers
+            # (watchdog stall, nonfinite halt, SIGTERM, manual) redirect
+            # into a shared fleet incident dir and fan out to siblings
+            eng.flight.redirect = (lambda reason, _n=name:
+                                   self._incident_redirect(_n, reason))
         if self._draining:
             eng.begin_drain()
         self.replicas[name] = eng
@@ -300,36 +369,41 @@ class FleetEngine:
         # (newest-first) against Scheduler.requeue's push-to-head leaves
         # each survivor's queue head oldest-first — the deadline-closest
         # request admits first.
-        ranked = [i["name"]
-                  for i in self._ranked(requeue_role, admission=False)]
+        ranked_infos = self._ranked(requeue_role, admission=False)
         for req in reversed(live):
-            self._requeue(req, requeue_role, ranked)
+            self._requeue(req, requeue_role, ranked_infos,
+                          lost_replica=name)
             requeued.append(req.rid)
         requeued.reverse()
         eng.close()
         return requeued
 
     def _requeue(self, req: Request, role: str,
-                 ranked: "Optional[list]" = None) -> None:
+                 ranked: "Optional[list]" = None,
+                 lost_replica: str = "") -> None:
         """Move one orphaned request onto a survivor: affinity-aware
         (its session's prefix may live on another replica too), typed
         REQUEUED transition via the survivor's scheduler. Requeue
         bypasses ``max_queue`` — this is already-admitted work, not new
-        intake. ``ranked`` lets :meth:`_remove` amortize one ranking
-        pass over the whole failover burst."""
+        intake. ``ranked`` (routing-info dicts) lets :meth:`_remove`
+        amortize one ranking pass over the whole failover burst."""
         if ranked is None:
-            ranked = [i["name"]
-                      for i in self._ranked(role, admission=False)]
+            ranked = self._ranked(role, admission=False)
+        names = [i["name"] for i in ranked]
         sticky = (self._session.get((role, req.session_id))
                   if req.session_id is not None else None)
-        name = sticky if sticky in ranked else \
-            (ranked[0] if ranked else None)
+        name = sticky if sticky in names else \
+            (names[0] if names else None)
         if name is None:
             # no survivor of this role can ever host it: terminal shed
             req.status = RequestStatus.SHED
             req.error = "no surviving replica to requeue onto"
             req.finish_t = self._clock()
             self.registry.counter("Fleet/requeue_sheds").inc()
+            self._audit_record("requeue_shed", rid=req.rid, role=role,
+                               session_id=req.session_id,
+                               candidates=ranked,
+                               lost_replica=lost_replica)
             self._adopt_result(req, "")
             self._retired_inline.append(req)
             return
@@ -338,6 +412,16 @@ class FleetEngine:
         if req.session_id is not None:
             self._stick(role, req.session_id, name)
         self.registry.counter("Fleet/requeued").inc()
+        self._audit_record("requeue", rid=req.rid, role=role,
+                           session_id=req.session_id, chosen=name,
+                           sticky=sticky, candidates=ranked,
+                           lost_replica=lost_replica)
+        if self.spans is not None:
+            # the cross-replica hop event: this rid's trace continues
+            # on the survivor, attempt bumped (scheduler stamped it)
+            self.spans.emit(_spans.REQUEUE, req.requeue_t, rid=req.rid,
+                            replica=name, attempt=req.attempts,
+                            lost_replica=lost_replica)
 
     # -------------------------------------------------------------- router
     def _replica_info(self, name: str) -> dict:
@@ -363,15 +447,26 @@ class FleetEngine:
         load = (queue_depth + eng.sched.occupancy
                 + (1 if eng._prefill is not None else 0)) \
             / max(1, eng.cfg.slots)
+        # "would I route here if anyone else could take it": healthy =
+        # no exclusion reason holds. The reasons list IS the router's
+        # explanation — the audit ring records it verbatim, so every
+        # decision is explicable after the fact.
+        reasons = []
+        if eng.draining:
+            reasons.append("draining")
+        if queue_full:
+            reasons.append("queue_full")
+        if eng.degraded:
+            reasons.append("degraded")
+        if eng.pool_pressure:
+            reasons.append("pool_pressure")
+        if burn > 1.0:
+            reasons.append("slo_burn")
         return {
             "name": name,
             "draining": eng.draining,
-            # "would I route here if anyone else could take it": ready
-            # (not draining / queue-full), no recent watchdog stall, no
-            # page-pool pressure, no burning SLO
-            "healthy": (not eng.draining and not queue_full
-                        and not eng.degraded and not eng.pool_pressure
-                        and burn <= 1.0),
+            "healthy": not reasons,
+            "reasons": reasons,
             "load": load, "burn": burn, "goodput": gp,
         }
 
@@ -398,17 +493,71 @@ class FleetEngine:
         if len(self._session) > self._session_cap:
             self._session.popitem(last=False)
 
-    def _route(self, role: str, session_id=None, exclude=()) -> str:
+    # --------------------------------------------------------- route audit
+    def _audit_record(self, event: str, rid: Optional[int] = None,
+                      role: Optional[str] = None, session_id=None,
+                      chosen: Optional[str] = None,
+                      sticky: Optional[str] = None,
+                      affinity: Optional[str] = None,
+                      candidates=(), lost_replica: str = "") -> None:
+        """One router decision into the bounded audit ring: the ranked
+        candidates with their per-replica exclusion reasons (draining /
+        queue_full / degraded / pool_pressure / slo_burn) — why the
+        chosen replica won and why every other one didn't. No-op when
+        tracing is disabled (the ring doesn't exist)."""
+        if self._audit is None:
+            return
+        self._audit_seq += 1
+        entry = {
+            "seq": self._audit_seq, "t": self._clock(), "event": event,
+            "rid": rid, "role": role, "session_id": session_id,
+            "chosen": chosen, "sticky": sticky, "affinity": affinity,
+            "candidates": [
+                {"name": i["name"], "healthy": i["healthy"],
+                 "reasons": list(i["reasons"]),
+                 "load": i["load"], "burn": i["burn"],
+                 "goodput": i["goodput"]}
+                for i in candidates],
+        }
+        if lost_replica:
+            entry["lost_replica"] = lost_replica
+        self._audit.append(entry)
+
+    def route_audit(self, rid: Optional[int] = None) -> list:
+        """The router decision audit: every route / shed /
+        affinity-fallback / requeue still in the ring, oldest first —
+        filtered to one request when ``rid`` is given. Each entry
+        explains the decision: the ranked candidates with per-replica
+        exclusion reasons. Empty when tracing is disabled."""
+        if self._audit is None:
+            return []
+        entries = list(self._audit)
+        if rid is None:
+            return entries
+        return [e for e in entries if e.get("rid") == rid]
+
+    def _route(self, role: str, session_id=None, exclude=()) \
+            -> "tuple[str, dict]":
         """Pick the admission target; raises a typed shed when no
-        replica of ``role`` is accepting (all draining/removed)."""
-        infos = self._ranked(role, exclude=exclude, admission=True)
-        if not infos:
+        replica of ``role`` is accepting (all draining/removed).
+        Returns ``(name, decision)`` — the decision dict carries the
+        ranked candidates and the affinity outcome so :meth:`submit`
+        can write ONE audit entry once the rid exists."""
+        infos = self._ranked(role, exclude=exclude, admission=False)
+        eligible = [i for i in infos if not i["draining"]]
+        if not eligible:
             self.registry.counter("Fleet/sheds").inc()
+            # the request never got a rid — the shed is still a routing
+            # decision someone will ask about
+            self._audit_record("shed", role=role, session_id=session_id,
+                               candidates=infos)
             raise QueueFullError(
                 f"no {role} replica accepting admissions (all draining); "
                 "request shed")
-        by_name = {i["name"]: i for i in infos}
-        choice = infos[0]["name"]
+        by_name = {i["name"]: i for i in eligible}
+        choice = eligible[0]["name"]
+        affinity = None
+        sticky = None
         if session_id is not None:
             sticky = self._session.get((role, session_id))
             if sticky is not None:
@@ -419,11 +568,15 @@ class FleetEngine:
                 if si is not None and si["healthy"]:
                     choice = sticky
                 if choice == sticky:
+                    affinity = "hit"
                     self.registry.counter("Fleet/affinity_hits").inc()
                 else:
+                    affinity = "miss"
                     self.registry.counter("Fleet/affinity_misses").inc()
             self._stick(role, session_id, choice)
-        return choice
+        return choice, {"role": role, "session_id": session_id,
+                        "sticky": sticky, "affinity": affinity,
+                        "candidates": infos}
 
     # -------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -440,8 +593,8 @@ class FleetEngine:
         last: Optional[QueueFullError] = None
         while True:
             try:
-                name = self._route(role, session_id=session_id,
-                                   exclude=tried)
+                name, decision = self._route(role, session_id=session_id,
+                                             exclude=tried)
             except QueueFullError:
                 if last is not None:
                     raise last
@@ -464,6 +617,18 @@ class FleetEngine:
         r = self.registry
         r.counter("Fleet/submitted").inc()
         r.counter(f"Fleet/routed_{name}").inc()
+        # the decision becomes auditable the moment the rid exists; an
+        # affinity fallback is its own event kind so dashboards can
+        # count prefix-locality losses without parsing candidates
+        self._audit_record(
+            "affinity_fallback" if decision["affinity"] == "miss"
+            else "route",
+            rid=rid, chosen=name, **decision)
+        if self.spans is not None:
+            # the trace context's first fleet hop: rid → replica. The
+            # replica's own ring continues from its queue span.
+            self.spans.emit(_spans.ROUTE, req.submit_t, rid=rid,
+                            replica=name)
         return rid
 
     def cancel(self, rid: int) -> Optional[Request]:
@@ -515,9 +680,24 @@ class FleetEngine:
             # timeouts, requeue sheds) ride the same return channel
             out.extend(self._retired_inline)
             self._retired_inline = []
+        if self._tracing:
+            for req in out:
+                self._observe_hops(req)
         self._iterations += 1
         self.registry.counter("Fleet/iterations").inc()
         return out
+
+    def _observe_hops(self, req: Request) -> None:
+        """One retired request's hop decomposition into the
+        ``Fleet/hop_*`` histograms (p50/p99 per hop across the fleet —
+        the aggregate view of :meth:`request_trace`). Null hops (e.g.
+        handoff on a uniform fleet) are skipped, not recorded as 0."""
+        tr = hop_trace(req)
+        r = self.registry
+        for h in HOP_NAMES + ("e2e",):
+            v = tr.get(f"{h}_s")
+            if v is not None:
+                r.histogram(f"Fleet/hop_{h}_s").observe(v)
 
     def _killable(self) -> list:
         """Replica names whose removal :meth:`_remove` would accept."""
@@ -538,8 +718,20 @@ class FleetEngine:
         source tree for future sharing), queue the handoff. The takeover
         happens via these side effects; the hook returns nothing."""
         eng = self.replicas[name]
+        t0 = self._clock()
         payload = eng.export_request(req)
         eng.release_request(req)
+        # export stamp is unconditional (two host clock reads): hop_trace
+        # needs it to tell "died waiting for a decode slot" apart from
+        # "decoded" even when tracing is off
+        req.export_t = self._clock()
+        if self.spans is not None:
+            # the export hop: pages gathered to host on the source
+            # replica — the first fleet-side leg of this rid's trace
+            self.spans.emit(_spans.HANDOFF_EXPORT, t0, req.export_t,
+                            rid=req.rid, replica=name,
+                            **({"attempt": req.attempts}
+                               if req.attempts else {}))
         self._handoffs.append((req, payload))
         self.registry.counter("Fleet/handoffs").inc()
         self.registry.gauge("Fleet/handoff_pending").set(
@@ -565,6 +757,9 @@ class FleetEngine:
                 req.error = "total deadline expired during handoff"
                 req.finish_t = now
                 self.registry.counter("Fleet/handoff_timeouts").inc()
+                if self.spans is not None:
+                    self.spans.emit(_spans.MARKER, now,
+                                    name="handoff_timeout", rid=req.rid)
                 self._adopt_result(req, self._owner.get(req.rid, ""))
                 self._retired_inline.append(req)
                 continue
@@ -581,6 +776,24 @@ class FleetEngine:
                     if req.session_id is not None:
                         self._stick(ROLE_DECODE, req.session_id, name)
                     self.registry.counter("Fleet/handoff_imports").inc()
+                    if self.spans is not None:
+                        # the pending + import hops: host-held wait,
+                        # then the scatter into the decode replica (the
+                        # engine stamped import_t0/t1 on the request)
+                        att = ({"attempt": req.attempts}
+                               if req.attempts else {})
+                        if req.export_t is not None \
+                                and req.import_t0 is not None:
+                            self.spans.emit(_spans.HANDOFF_PENDING,
+                                            req.export_t,
+                                            req.import_t0, rid=req.rid,
+                                            **att)
+                        if req.import_t0 is not None \
+                                and req.import_t1 is not None:
+                            self.spans.emit(_spans.HANDOFF_IMPORT,
+                                            req.import_t0, req.import_t1,
+                                            rid=req.rid, replica=name,
+                                            **att)
                     placed = True
                     ranked = [i["name"] for i in
                               self._ranked(ROLE_DECODE, admission=False)]
@@ -776,10 +989,204 @@ class FleetEngine:
                          "deadline_total": req.deadline_total,
                          "status": req.status.value,
                          "attempts": req.attempts,
+                         "trace": hop_trace(req),
                          # the SOURCE replica that produced the payload:
                          # a stuck handoff must be attributable
                          "replica": self._owner.get(req.rid)})
         return rows
+
+    # ------------------------------------------------- distributed tracing
+    def request_trace(self, rid: int) -> Optional[dict]:
+        """One request's end-to-end hop-latency decomposition
+        (``queue_wait/prefill/handoff_wait/import/decode/e2e`` — see
+        :func:`~..observability.export.hop_trace`), wherever the request
+        currently lives: the fleet results store, the pending-handoff
+        buffer, or its owning replica (results or live). The non-null
+        hops of a completed request tile ``[submit, finish]`` — their
+        sum IS the e2e wall (the documented invariant, pinned on the
+        fake clock). Works with tracing disabled — the hops come from
+        host timestamps on the request, not from any span ring. None
+        for an unknown (or evicted) rid."""
+        owner = self._owner.get(rid)
+        req = self.results.get(rid)
+        state = None
+        if req is None:
+            for r, _payload in self._handoffs:
+                if r.rid == rid:
+                    req, state = r, "handoff"
+                    break
+        if req is not None:
+            out = {"rid": rid, "status": req.status.value,
+                   "finished": req.finished, "slot": req.slot,
+                   "tokens": len(req.tokens), "hops": hop_trace(req)}
+            if state is not None:
+                out["state"] = state
+        else:
+            if owner not in self.replicas:
+                return None
+            out = self.replicas[owner].request_trace(rid)
+            if out is None:
+                return None
+        out["replica"] = owner
+        return out
+
+    def merge_trace(self, job_name: str = "fleet") -> dict:
+        """ONE Chrome/Perfetto trace for the whole fleet: every live
+        replica's span ring under its own pid, the fleet ring (router
+        decisions, handoff hops) under a ``router`` pid, and each
+        cross-replica request stitched into a flow — see
+        :func:`~..observability.export.merge_fleet_trace`. Empty when
+        tracing is disabled (no rings exist)."""
+        from ..observability.export import merge_fleet_trace
+
+        rings = {n: e.spans.events() for n, e in self.replicas.items()
+                 if e.spans is not None}
+        return merge_fleet_trace(
+            rings,
+            self.spans.events() if self.spans is not None else None,
+            job_name=job_name)
+
+    # ----------------------------------------------------------- incidents
+    def _incident_redirect(self, name: str, reason: str) \
+            -> Optional[Path]:
+        """The per-replica flight-recorder redirect hook: replica
+        ``name`` is about to dump for ``reason``. The first trigger
+        opens a shared incident (fanning the dump out to every
+        sibling); a sibling asked to dump DURING the fan-out — or a
+        second trigger within the same fleet iteration (one event,
+        several tripwires) — gets the existing incident's per-replica
+        subdirectory instead of opening a duplicate."""
+        with self._incident_lock:
+            if self._incident_open is not None:
+                return self._incident_open / name
+            last = self._incident_last
+            if last is not None and last[1] == self._iterations:
+                # join: this iteration's incident already captured the
+                # fleet (this replica's fan-out dump included); a second
+                # dump from the same replica lands beside it suffixed
+                return last[0] / name
+            d = self._open_incident(reason, trigger=name)
+            return None if d is None else d / name
+
+    def dump_incident(self, reason: str = "manual") -> Optional[Path]:
+        """Correlated capture NOW: every replica's flight recorder dumps
+        into one shared incident directory, alongside the fleet's own
+        artifacts (router/handoff ring, route audit, merged trace).
+        Returns the incident directory, or None when no replica carries
+        a flight recorder (``serving.flight_dir`` unset)."""
+        with self._incident_lock:
+            if self._incident_open is not None:
+                return self._incident_open
+            return self._open_incident(reason, trigger=None)
+
+    def _open_incident(self, reason: str,
+                       trigger: Optional[str]) -> Optional[Path]:
+        """Create ``<flight_dir>/incident_<stamp>_<reason>`` and fan the
+        capture out: every replica except ``trigger`` (whose own dump is
+        already in flight, redirected here) dumps into its subdirectory;
+        the fleet writes ``incident.json`` (the shared incident id +
+        which replicas were live), its ring, the route audit, and the
+        merged cross-replica trace under ``fleet/``. Caller holds
+        ``_incident_lock``."""
+        from ..observability.flight import sanitize_reason, unique_dir
+
+        if self._incident_base is None:
+            return None
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        safe = sanitize_reason(reason, fallback="incident")
+        d = unique_dir(self._incident_base / f"incident_{stamp}_{safe}")
+        try:
+            d.mkdir(parents=True)
+        except OSError as e:
+            log_dist(f"fleet incident capture: cannot create {d} "
+                     f"({e!r})", ranks=[0], level="WARNING")
+            return None
+        self._incidents += 1
+        self.registry.counter("Fleet/incidents").inc()
+        if self.spans is not None:
+            self.spans.emit(_spans.MARKER, self._clock(), name="incident",
+                            reason=reason, incident=d.name,
+                            trigger=trigger or "")
+        self._incident_open = d
+        dumped = []
+        try:
+            for n, eng in self.replicas.items():
+                if n == trigger:
+                    dumped.append(n)   # its dump is in flight, into d/n
+                    continue
+                if eng.flight is not None \
+                        and eng.flight.dump(f"incident {reason}",
+                                            into=d / n) is not None:
+                    dumped.append(n)
+            self._write_incident_artifacts(d, reason, trigger, dumped)
+        finally:
+            self._incident_open = None
+            self._incident_last = (d, self._iterations)
+        log_dist(f"fleet incident capture: {len(dumped)}/"
+                 f"{len(self.replicas)} replicas dumped to {d} "
+                 f"(reason: {reason})", ranks=[0], level="WARNING")
+        return d
+
+    def _write_incident_artifacts(self, d: Path, reason: str,
+                                  trigger: Optional[str],
+                                  dumped: list) -> None:
+        """The fleet's half of an incident dir. Per-artifact write
+        guards, like the flight recorder's: incident capture runs on
+        failure paths — one bad artifact must not lose the rest."""
+        from ..observability.flight import _json_default
+
+        fd = d / "fleet"
+
+        def _w(name, write):
+            try:
+                write()
+            except Exception as e:
+                try:
+                    (d / (name + ".error")).write_text(repr(e),
+                                                       encoding="utf-8")
+                except OSError:
+                    pass
+
+        def _w_manifest():
+            (d / "incident.json").write_text(json.dumps({
+                "incident_id": d.name, "reason": reason,
+                "trigger_replica": trigger,
+                "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "clock_now": self._clock(),
+                "replicas_live": len(self.replicas),
+                "replicas": list(self.replicas),
+                "roles": dict(self.roles),
+                "dumped": dumped,
+                "handoff_pending": len(self._handoffs),
+            }, indent=2, default=str), encoding="utf-8")
+
+        def _w_fleet_events():
+            fd.mkdir(exist_ok=True)
+            with open(fd / "events.jsonl", "w", encoding="utf-8") as f:
+                for ev in self.spans.events():
+                    f.write(json.dumps(ev.as_dict(),
+                                       separators=(",", ":"),
+                                       default=_json_default) + "\n")
+
+        def _w_audit():
+            fd.mkdir(exist_ok=True)
+            with open(fd / "route_audit.jsonl", "w",
+                      encoding="utf-8") as f:
+                for entry in self.route_audit():
+                    f.write(json.dumps(entry, separators=(",", ":"),
+                                       default=str) + "\n")
+
+        def _w_trace():
+            fd.mkdir(exist_ok=True)
+            (fd / "trace_merged.json").write_text(
+                json.dumps(self.merge_trace(), default=_json_default),
+                encoding="utf-8")
+
+        _w("incident.json", _w_manifest)
+        if self.spans is not None:
+            _w("events.jsonl", _w_fleet_events)
+            _w("route_audit.jsonl", _w_audit)
+            _w("trace_merged.json", _w_trace)
 
     def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
         """Push ``Fleet/*`` (health rollup + goodput refreshed first)

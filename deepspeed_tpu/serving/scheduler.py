@@ -33,6 +33,7 @@ from typing import Optional
 import numpy as np
 
 from ..observability import spans as _spans
+from ..observability.export import hop_trace
 from ..observability.tracing import ServingStats
 from ..resilience.guards import QueueFullError, RequestStatus
 
@@ -138,6 +139,16 @@ class Request:
     error: str = ""
     deadline_ttft: Optional[float] = None
     deadline_total: Optional[float] = None
+    # distributed-trace hop stamps (observability/export.py hop_trace):
+    # requeue_t — when a failover pulled this request off its dead
+    # replica (kill → re-admission is Serve/requeue_delay_s); export_t —
+    # when the prefill replica finished exporting its pages to host;
+    # import_t0/import_t1 — the disaggregated decode-side import window.
+    # All None on the plain single-engine path.
+    requeue_t: Optional[float] = None
+    export_t: Optional[float] = None
+    import_t0: Optional[float] = None
+    import_t1: Optional[float] = None
     # paged-KV admission plan (serving/pages.py PageAllocation): the
     # slot's page-table row, shared-prefix skip, and hydrate plan. None
     # on the contiguous path.
@@ -276,11 +287,28 @@ class Scheduler:
         req = self.queue.popleft()
         admit_t = self.stats.on_admit(len(self.queue), submit_t=req.submit_t)
         req.admit_t = admit_t
+        if req.requeue_t is not None:
+            # failover attribution: kill → re-admission, its OWN series
+            # so TTFT and requeue delay stay separable in the logs
+            self.stats.on_requeue_delay(admit_t - req.requeue_t)
         if self.spans is not None:
-            # the queue-wait span: submitted → picked for prefill
-            self.spans.emit(_spans.QUEUED, req.submit_t, admit_t,
-                            rid=req.rid)
+            # the queue-wait span: submitted → picked for prefill. A
+            # requeued ATTEMPT's span starts at the requeue (its first
+            # attempt already burned the wait from submit_t) and carries
+            # the attempt index, so per-attempt timings never conflate.
+            self.spans.emit(_spans.QUEUED,
+                            req.submit_t if req.requeue_t is None
+                            else req.requeue_t,
+                            admit_t, rid=req.rid,
+                            **self._attempt_meta(req))
         return req
+
+    @staticmethod
+    def _attempt_meta(req: Request) -> dict:
+        """Span meta labeling which failover attempt an event belongs
+        to — empty on the never-requeued path, so single-engine span
+        streams are byte-identical to before the fleet existed."""
+        return {"attempt": req.attempts} if req.attempts else {}
 
     def plan(self, req: Request) -> list:
         skip = req.page_alloc.skip if req.page_alloc is not None else 0
@@ -302,7 +330,7 @@ class Scheduler:
         self.running[slot] = req
         if self.spans is not None:
             self.spans.emit(_spans.PLACED, req.first_token_t, rid=req.rid,
-                            slot=slot)
+                            slot=slot, **self._attempt_meta(req))
         return slot
 
     def adopt(self, req: Request) -> int:
@@ -316,7 +344,8 @@ class Scheduler:
         self.running[slot] = req
         if self.spans is not None:
             self.spans.emit(_spans.PLACED, self.stats.clock(), rid=req.rid,
-                            slot=slot)
+                            slot=slot, imported=True,
+                            **self._attempt_meta(req))
         return slot
 
     def requeue(self, req: Request) -> Request:
@@ -341,14 +370,22 @@ class Scheduler:
         req.admit_t = None
         req.page_alloc = None
         req.error = ""
+        # per-attempt trace stamps restart with the attempt: the NEW
+        # requeue_t anchors Serve/requeue_delay_s (kill → re-admission)
+        # and the surviving attempt's hop decomposition; a stale import
+        # window from the dead replica must not leak into it
+        req.requeue_t = self.stats.clock()
+        req.export_t = None
+        req.import_t0 = None
+        req.import_t1 = None
         # oldest-first at the head: a requeued request already spent its
         # queue wait once; survivors' fresher submissions queue behind it
         self.queue.appendleft(req)
         self.stats.on_requeue(len(self.queue))
         if self.spans is not None:
-            self.spans.emit(_spans.RETIRED, self.stats.clock(), rid=req.rid,
+            self.spans.emit(_spans.RETIRED, req.requeue_t, rid=req.rid,
                             slot=None, status=req.status.value,
-                            tokens=0)
+                            tokens=0, attempt=req.attempts)
         return req
 
     def take_live(self) -> list:
@@ -382,15 +419,19 @@ class Scheduler:
             return
         if req.slot >= 0 and req.first_token_t is not None \
                 and req.finish_t is not None:
-            self.spans.emit(_spans.DECODE_RESIDENCY, req.first_token_t,
+            self.spans.emit(_spans.DECODE_RESIDENCY,
+                            req.import_t1 if req.import_t1 is not None
+                            else req.first_token_t,
                             req.finish_t, rid=req.rid, slot=req.slot,
-                            tokens=len(req.tokens))
+                            tokens=len(req.tokens),
+                            **self._attempt_meta(req))
         self.spans.emit(_spans.RETIRED,
                         req.finish_t if req.finish_t is not None
                         else req.submit_t,
                         rid=req.rid,
                         slot=req.slot if req.slot >= 0 else None,
-                        status=req.status.value, tokens=len(req.tokens))
+                        status=req.status.value, tokens=len(req.tokens),
+                        **self._attempt_meta(req))
 
     # -------------------------------------------------------------- decode
     def on_step(self, toks: np.ndarray, dones: np.ndarray) -> list:
@@ -507,6 +548,10 @@ class Scheduler:
                 # status and move count while it waits again
                 "status": req.status.value,
                 "attempts": req.attempts,
+                # live hop decomposition: hops the request has completed
+                # so far (the rest null) — /requests shows where an
+                # in-flight request's time is going
+                "trace": hop_trace(req),
             }
 
         rows = []

@@ -474,6 +474,257 @@ def test_disaggregation_requires_paged():
                     replicas=2, prefill_replicas=2)
 
 
+# ---------------------------------------------------- distributed tracing
+def test_distributed_trace_disaggregated(setup):
+    """The PR-10 tentpole on a disaggregated fleet with tracing ON:
+    every request's hop decomposition tiles its e2e wall exactly (fake
+    clock), the handoff hops are real, Fleet/hop_* histograms aggregate
+    them, the merged Chrome trace carries named replica pids + the
+    cross-replica flows, and every routing decision has an audit
+    entry."""
+    from deepspeed_tpu.observability import validate_chrome_trace
+    from deepspeed_tpu.observability import spans as S
+
+    _, _, _, eng = setup
+    clock = TickClock()
+    fleet = _fleet(eng, replicas=3, clock=clock, prefill_replicas=1,
+                   serving={"page_size": 8, "spans": True})
+    assert fleet.spans is not None      # tracing follows serving.spans
+    prompts = _prompts(4, seed=12)
+    rids = [fleet.submit(p, 5, seed=130 + i, session_id=f"s{i % 2}")
+            for i, p in enumerate(prompts)]
+    done = _drive(fleet, rids, collect=False)
+    for rid in rids:
+        tr = fleet.request_trace(rid)
+        assert tr is not None and tr["finished"]
+        h = tr["hops"]
+        # disaggregated path: every hop is real, and they TILE e2e
+        for k in ("queue_wait_s", "prefill_s", "handoff_wait_s",
+                  "import_s", "decode_s"):
+            assert h[k] is not None and h[k] >= 0, (rid, k, h)
+        assert sum(h[k] for k in ("queue_wait_s", "prefill_s",
+                                  "handoff_wait_s", "import_s",
+                                  "decode_s")) \
+            == pytest.approx(h["e2e_s"], rel=1e-9)
+        assert tr["replica"] in fleet.replicas
+        # the request-log record carries the same decomposition
+        rec = request_record(done[rid])
+        assert rec["trace"]["import_s"] == h["import_s"]
+        # ... and the router explains every decision it made for it
+        audit = fleet.route_audit(rid)
+        assert audit and audit[0]["event"] in ("route",
+                                               "affinity_fallback")
+        # the initial route lands on the prefill role (ownership moves
+        # to a decode replica later, at the handoff import)
+        assert audit[0]["chosen"] == "p0"
+        assert all(isinstance(c["reasons"], list)
+                   for c in audit[0]["candidates"])
+    # hop histograms aggregate across the fleet (one sample per request
+    # per hop; e2e too)
+    hist = fleet.registry.snapshot()["histograms"]
+    for h in ("queue_wait", "prefill", "handoff_wait", "import",
+              "decode", "e2e"):
+        assert hist[f"Fleet/hop_{h}_s"]["count"] == len(rids), h
+    # fleet ring carries the cross-replica hop events
+    kinds = {e.kind for e in fleet.spans.events()}
+    assert {S.ROUTE, S.HANDOFF_EXPORT, S.HANDOFF_PENDING,
+            S.HANDOFF_IMPORT} <= kinds
+    # ONE merged trace: router + prefill + decode pids, flows across
+    merged = fleet.merge_trace()
+    assert validate_chrome_trace(merged) == []
+    evs = merged["traceEvents"]
+    pnames = {e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"fleet:router", "fleet:p0", "fleet:d0", "fleet:d1"} <= pnames
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    assert flows and len({e["pid"] for e in flows}) >= 2
+    assert {e["id"] for e in flows} <= set(rids)
+    fleet.close()
+
+
+def test_route_audit_exclusion_reasons_and_shed(setup):
+    _, _, _, eng = setup
+    fleet = _fleet(eng, replicas=2, serving={"spans": True})
+    fleet.replicas["r0"].begin_drain()
+    rid = fleet.submit(np.arange(1, 8, dtype=np.int32), 3, seed=1)
+    audit = fleet.route_audit(rid)
+    assert len(audit) == 1 and audit[0]["chosen"] == "r1"
+    cands = {c["name"]: c for c in audit[0]["candidates"]}
+    # the excluded replica's entry SAYS why it lost
+    assert cands["r0"]["reasons"] == ["draining"]
+    assert not cands["r0"]["healthy"] and cands["r1"]["healthy"]
+    # an all-draining shed is itself an audited decision (rid-less: the
+    # request never existed)
+    fleet.replicas["r1"].begin_drain()
+    with pytest.raises(QueueFullError):
+        fleet.submit(np.arange(1, 8, dtype=np.int32), 3)
+    shed = fleet.route_audit()[-1]
+    assert shed["event"] == "shed" and shed["rid"] is None
+    assert all(c["reasons"] == ["draining"]
+               for c in shed["candidates"])
+    fleet.end_drain()
+    _drive(fleet, [rid])
+    fleet.close()
+
+
+def test_requeue_attempt_attribution(setup):
+    """Satellite: per-attempt spans + the Serve/requeue_delay_s
+    histogram make TTFT and failover delay separable — the requeued
+    attempt's queue span starts at the REQUEUE (not the original
+    submit), labeled with its attempt index."""
+    from deepspeed_tpu.observability import spans as S
+
+    _, _, _, eng = setup
+    clock = TickClock()
+    fleet = _fleet(eng, replicas=2, clock=clock,
+                   serving={"spans": True})
+    prompts = _prompts(4, seed=3)
+    rids = [fleet.submit(p, 3, seed=160 + i)
+            for i, p in enumerate(prompts)]
+    fleet.step()
+    requeued = fleet.remove_replica("r0")
+    assert requeued
+    kill_t = clock.t
+    done = _drive(fleet, rids, collect=False)
+    surv = fleet.replicas["r1"]
+    # one requeue-delay observation per requeue, none for the rest
+    hist = surv.stats.registry.snapshot()["histograms"]
+    assert hist["Serve/requeue_delay_s"]["count"] == len(requeued)
+    for rid in requeued:
+        req = done[rid]
+        assert req.requeue_t is not None and req.requeue_t <= kill_t
+        h = request_record(req)["trace"]
+        assert h["attempts"] == 1
+        # requeue delay = kill -> re-admission, strictly inside the
+        # (original-submit-anchored) queue wait
+        assert h["requeue_delay_s"] == pytest.approx(
+            req.admit_t - req.requeue_t)
+        assert h["requeue_delay_s"] < h["queue_wait_s"]
+        # the survivor's ring stamped the ATTEMPT's own queue span,
+        # starting at the requeue instant
+        qs = [e for e in surv.spans.events()
+              if e.kind == S.QUEUED and e.rid == rid]
+        att = [e for e in qs if e.meta.get("attempt") == 1]
+        assert len(att) == 1 and att[0].t0 == req.requeue_t
+        # and the fleet ring recorded the hop + the audit the reason
+        rq = [e for e in fleet.spans.events()
+              if e.kind == S.REQUEUE and e.rid == rid]
+        assert len(rq) == 1 and rq[0].meta["replica"] == "r1"
+        entries = [e for e in fleet.route_audit(rid)
+                   if e["event"] == "requeue"]
+        assert len(entries) == 1
+        assert entries[0]["lost_replica"] == "r0"
+    # non-requeued requests carry no requeue attribution
+    for rid in set(rids) - set(requeued):
+        h = request_record(done[rid])["trace"]
+        assert h["attempts"] == 0 and h["requeue_delay_s"] is None
+    fleet.close()
+
+
+def test_tracing_disabled_inert_but_hops_still_stamped(setup):
+    """Tracing off (the default): no fleet ring, no audit, no Fleet/hop_*
+    series — but request_trace still answers from the host stamps, and
+    the request-log trace dict carries null handoff hops."""
+    _, _, _, eng = setup
+    fleet = _fleet(eng, replicas=2)
+    assert fleet.spans is None and fleet._audit is None
+    assert fleet.route_audit() == []
+    p = np.arange(1, 10, dtype=np.int32)
+    rid = fleet.submit(p, 3, seed=2)
+    done = _drive(fleet, [rid], collect=False)
+    assert not any(k.startswith("Fleet/hop_")
+                   for k in fleet.registry.snapshot()["histograms"])
+    tr = fleet.request_trace(rid)
+    h = tr["hops"]
+    assert h["handoff_wait_s"] is None and h["import_s"] is None
+    assert h["queue_wait_s"] + h["prefill_s"] + h["decode_s"] \
+        == pytest.approx(h["e2e_s"], rel=1e-9)
+    assert request_record(done[rid])["trace"]["import_s"] is None
+    assert fleet.request_trace(10_000_000) is None
+    fleet.close()
+
+
+# ---------------------------------------------------------------- incidents
+def test_incident_capture_fans_out_and_doctor_gates(setup, tmp_path,
+                                                    capsys):
+    """Correlated incident capture: ONE replica's flight trigger lands
+    every replica's dump + the fleet artifacts + a merged trace in one
+    incident dir under a shared id; the doctor reconstructs the
+    cross-replica timeline and gates on an UNRECONCILED incident (fewer
+    dumps than live replicas), in file mode and in ``--targets`` mode."""
+    import shutil
+
+    from deepspeed_tpu.observability import doctor, validate_chrome_trace
+    from deepspeed_tpu.serving import ServingEngine
+
+    _, _, _, eng = setup
+    fdir = tmp_path / "fl"
+    clock = TickClock()
+    fleet = _fleet(eng, replicas=3, clock=clock,
+                   serving={"spans": True, "flight_dir": str(fdir)})
+    rids = [fleet.submit(p, 3, seed=170 + i)
+            for i, p in enumerate(_prompts(3, seed=6))]
+    _drive(fleet, rids)
+    # r1's own trigger (what a watchdog stall / nonfinite halt calls)
+    d = fleet.replicas["r1"].flight.dump("watchdog_stall")
+    assert d is not None and d.name == "r1"
+    inc = d.parent
+    assert inc.name.startswith("incident_")
+    import json as _json
+    mf = _json.loads((inc / "incident.json").read_text())
+    assert mf["incident_id"] == inc.name
+    assert mf["trigger_replica"] == "r1"
+    assert mf["replicas_live"] == 3
+    subs = sorted(p.name for p in inc.iterdir()
+                  if p.is_dir() and p.name != "fleet")
+    assert subs == ["r0", "r1", "r2"]
+    # every replica's dump is a full flight record in the shared dir
+    for n in subs:
+        assert (inc / n / "manifest.json").exists()
+        assert (inc / n / "events.jsonl").exists()
+    # fleet artifacts: ring + route audit + the merged trace
+    assert (inc / "fleet" / "events.jsonl").exists()
+    assert (inc / "fleet" / "route_audit.jsonl").exists()
+    merged = _json.loads((inc / "fleet" / "trace_merged.json").read_text())
+    assert validate_chrome_trace(merged) == []
+    assert int(fleet.registry.snapshot()["counters"]
+               ["Fleet/incidents"]) == 1
+    # the manual ops entry point opens a SECOND incident of its own
+    inc2 = fleet.dump_incident("manual")
+    assert inc2 is not None and inc2 != inc
+    assert sorted(p.name for p in inc2.iterdir()
+                  if p.is_dir() and p.name != "fleet") \
+        == ["r0", "r1", "r2"]
+    shutil.rmtree(inc2)               # keep ONE newest incident for the
+    fleet.close()                     # doctor assertions below
+    # ---- doctor, file mode: reconciled incident is informational
+    rc = doctor.main(["--dir", str(fdir)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "[incident]" in out and "timeline" in out
+    assert "3/3 live" in out
+    # unreconciled (one replica's dump missing) trips the gate
+    shutil.rmtree(inc / "r2")
+    rc = doctor.main(["--dir", str(fdir)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "unreconciled incident" in out
+    assert doctor.main(["--dir", str(fdir), "--no-gate"]) == 0
+    capsys.readouterr()
+    # ---- doctor, fleet mode: --targets + --flight-dir runs the same
+    # incident gate next to live triage (a clean target does not mask
+    # an incomplete post-mortem)
+    scfg = {"slots": 2, "max_len": M, "prefill_chunk": 16,
+            "temperature": 0.8, "top_k": 20}
+    a = ServingEngine(eng, scfg, programs=_PROGRAMS)
+    try:
+        pa = a.serve_telemetry(port=0)
+        rc = doctor.main(["--targets", f"http://127.0.0.1:{pa}",
+                          "--flight-dir", str(fdir)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "unreconciled incident" in out
+    finally:
+        a.close()
+
+
 # ------------------------------------------------------------ doctor fleet
 def test_doctor_targets_fleet_gate(setup, capsys):
     from deepspeed_tpu.observability import doctor

@@ -32,7 +32,8 @@ from deepspeed_tpu.observability import (CompileStormDetector, FlightRecorder,
                                          newest_flight_record,
                                          parse_prometheus_textfile,
                                          prometheus_name, read_flight_record,
-                                         sample_memory, to_chrome_trace,
+                                         merge_fleet_trace, sample_memory,
+                                         to_chrome_trace,
                                          validate_chrome_trace)
 from deepspeed_tpu.observability import spans as spans_mod
 from deepspeed_tpu.models import build_model, tiny_test
@@ -452,6 +453,121 @@ def test_chrome_trace_validator_catches_malformed():
     assert any("unclosed B" in p for p in validate_chrome_trace(
         {"traceEvents": [{"name": "a", "ph": "B", "pid": 1, "tid": 1,
                           "ts": 0.0}]}))
+
+
+# ------------------------------------------------------ merged fleet trace
+def _replica_ring(rid, t0, clock=None, slot=0):
+    """One replica's serving lifecycle for ``rid`` starting at ``t0``."""
+    sp = SpanRecorder(64, clock=clock if clock is not None else TickClock())
+    sp.emit(spans_mod.QUEUED, t0, t0 + 0.5, rid=rid)
+    sp.emit(spans_mod.PREFILL_CHUNK, t0 + 0.5, t0 + 0.8, rid=rid, chunk=0,
+            size=16, final=True)
+    sp.emit(spans_mod.PLACED, t0 + 0.8, rid=rid, slot=slot)
+    sp.emit(spans_mod.DECODE_RESIDENCY, t0 + 0.8, t0 + 2.0, rid=rid,
+            slot=slot, tokens=5)
+    sp.emit(spans_mod.RETIRED, t0 + 2.0, rid=rid, slot=slot, status="ok",
+            tokens=5)
+    return sp
+
+
+def test_merge_fleet_trace_pids_flows_and_naming():
+    """The fleet merge: replicas as named pids on ONE time axis, fleet
+    ring as the router pid, cross-replica requests stitched into flows
+    — and the result passes the validator."""
+    # rid 7 prefills on p0 (only QUEUED+PREFILL there), hands off, and
+    # decodes on d0; rid 9 lives entirely on d0 (no flow for it)
+    p0 = SpanRecorder(64, clock=TickClock())
+    p0.emit(spans_mod.QUEUED, 0.0, 0.5, rid=7)
+    p0.emit(spans_mod.PREFILL_CHUNK, 0.5, 1.0, rid=7, chunk=0, size=16,
+            final=True)
+    d0 = _replica_ring(9, t0=0.2)
+    d0.emit(spans_mod.DECODE_RESIDENCY, 1.6, 3.0, rid=7, slot=1, tokens=4)
+    fleet = SpanRecorder(64, clock=TickClock())
+    fleet.emit(spans_mod.ROUTE, 0.0, rid=7, replica="p0")
+    fleet.emit(spans_mod.HANDOFF_EXPORT, 1.0, 1.1, rid=7, replica="p0")
+    fleet.emit(spans_mod.HANDOFF_PENDING, 1.1, 1.4, rid=7)
+    fleet.emit(spans_mod.HANDOFF_IMPORT, 1.4, 1.5, rid=7, replica="d0")
+    tr = merge_fleet_trace({"p0": p0.events(), "d0": d0.events()},
+                           fleet.events(), job_name="fleet")
+    assert validate_chrome_trace(tr) == []
+    evs = tr["traceEvents"]
+    # multi-pid track naming: every replica is a named process, the
+    # fleet ring fronts as the router process
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert set(pnames.values()) == {"fleet:router", "fleet:p0",
+                                    "fleet:d0"}
+    # one shared origin: d0's first event (t0=0.2) is NOT at ts 0
+    d0_pid = next(p for p, n in pnames.items() if n == "fleet:d0")
+    d0_ts = [e["ts"] for e in evs if e["ph"] == "X"
+             and e["pid"] == d0_pid]
+    assert min(d0_ts) > 0
+    # rid 7 crossed pids -> one flow chain s ... f, id = rid; rid 9
+    # stayed on d0 -> no flow
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    assert flows and {e["id"] for e in flows} == {7}
+    seq = [e["ph"] for e in flows]
+    assert seq[0] == "s" and seq[-1] == "f" \
+        and all(p == "t" for p in seq[1:-1])
+    assert len({e["pid"] for e in flows}) >= 2   # the arrow crosses
+    # handoff hops render as X slices on the router pid's handoff track
+    router_pid = next(p for p, n in pnames.items()
+                      if n == "fleet:router")
+    hand = [e["name"] for e in evs if e["ph"] == "X"
+            and e["pid"] == router_pid]
+    assert {"export rid=7", "pending rid=7", "import rid=7"} \
+        <= set(hand)
+    # slices carry their replica label
+    assert all(e["args"].get("replica") == "d0" for e in evs
+               if e["ph"] == "X" and e["pid"] == d0_pid)
+    json.loads(json.dumps(tr))       # JSON-serializable
+
+
+def test_merge_fleet_trace_empty_and_single_pid():
+    assert merge_fleet_trace({}, None)["traceEvents"] == []
+    # one replica, no fleet ring: valid, named, and flow-free
+    tr = merge_fleet_trace({"r0": _replica_ring(3, 0.0).events()})
+    assert validate_chrome_trace(tr) == []
+    assert not [e for e in tr["traceEvents"] if e["ph"] in ("s", "t", "f")]
+
+
+def test_chrome_trace_validator_flow_and_pid_negatives():
+    """Satellite: the validator catches the fleet-merge failure modes —
+    dangling flow ids and events under an unnamed pid."""
+    ok = {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 0.0,
+          "dur": 1.0}
+    # dangling flow: s without f
+    bad = {"traceEvents": [ok, {"name": "f1", "ph": "s", "id": 7,
+                                "pid": 1, "tid": 1, "ts": 0.0}]}
+    assert any("dangling flow id 7" in p for p in
+               validate_chrome_trace(bad))
+    # f/t without a preceding s
+    bad = {"traceEvents": [ok, {"name": "f1", "ph": "f", "id": 7,
+                                "pid": 1, "tid": 1, "ts": 0.0,
+                                "bp": "e"}]}
+    assert any("without a preceding s" in p for p in
+               validate_chrome_trace(bad))
+    # flow event with no id at all
+    bad = {"traceEvents": [{"name": "f1", "ph": "s", "pid": 1, "tid": 1,
+                            "ts": 0.0}]}
+    assert any("without id" in p for p in validate_chrome_trace(bad))
+    # complete s->f chain: clean
+    good = {"traceEvents": [
+        ok,
+        {"name": "f1", "ph": "s", "id": 7, "pid": 1, "tid": 1, "ts": 0.0},
+        {"name": "f1", "ph": "f", "id": 7, "pid": 1, "tid": 1, "ts": 0.5,
+         "bp": "e"}]}
+    assert validate_chrome_trace(good) == []
+    # unknown pid: only fires when the trace names processes at all
+    unnamed = {"traceEvents": [ok]}
+    assert validate_chrome_trace(unnamed) == []
+    named = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0, "ts": 0.0,
+         "args": {"name": "r0"}},
+        ok,
+        {"name": "b", "ph": "X", "pid": 99, "tid": 1, "ts": 1.0,
+         "dur": 0.5}]}
+    assert any("unknown pid 99" in p for p in validate_chrome_trace(named))
 
 
 # ------------------------------------------------------- flight recorder

@@ -430,6 +430,21 @@ def test_serving_engine_telemetry_end_to_end(setup, tmp_path, capsys):
         assert d is not None and os.path.isdir(d)
         fl = json.loads(_req(u + "/flight")[2])
         assert fl["newest"]["manifest"]["reason"] == "manual"
+        # /trace: the span ring as a Perfetto-loadable trace, plus the
+        # per-request hop decomposition by rid
+        from deepspeed_tpu.observability import validate_chrome_trace
+
+        code, _, body = _req(u + "/trace")
+        assert code == 200 and validate_chrome_trace(json.loads(body)) == []
+        code, _, body = _req(u + "/trace?rid=0")
+        hops = json.loads(body)["hops"]
+        assert code == 200 and hops["e2e_s"] > 0
+        # single engine, no handoff: those hops are null, the rest tile
+        assert hops["handoff_wait_s"] is None and hops["import_s"] is None
+        assert (hops["queue_wait_s"] + hops["prefill_s"] + hops["decode_s"]
+                ) == pytest.approx(hops["e2e_s"], rel=1e-9)
+        assert _req(u + "/trace?rid=999999")[0] == 404
+        assert _req(u + "/trace?rid=bogus")[0] == 400
         # live doctor triage over the same plane: clean gate
         from deepspeed_tpu.observability import doctor
 
