@@ -23,5 +23,12 @@ sleep 20
 python bench_decompose.py || { echo "[bench_all] decompose failed"; fails=$((fails+1)); }
 sleep 20
 python bench_act_offload.py || { echo "[bench_all] act-offload failed"; fails=$((fails+1)); }
+echo "=== perf ledger ==="
+# Fold every bench JSON this chain just rewrote into the cross-PR
+# trajectory and gate on regressions vs each series' rolling best
+# (observability/perf_ledger.py; report-only here — the chain's own
+# failures already count, and a wall-noise trip should not mask them).
+python -m deepspeed_tpu.observability.perf_ledger --root . --out PERF_LEDGER.json --no-gate \
+  || { echo "[bench_all] perf ledger failed"; fails=$((fails+1)); }
 echo "=== bench_all done, $fails failures $(date -u +%H:%M:%SZ) ==="
 exit $((fails > 0))

@@ -1,0 +1,302 @@
+"""Replay bench: traffic capture, deterministic replay, advisor backtest.
+
+Drives the record→replay→validate loop (``observability/replay.py``) the
+ROADMAP's next walls all need — every "same traffic, better outcome"
+claim starts with replayable traffic and a ledger that remembers:
+
+- **capture → replay parity** — a multi-turn session run (the
+  ``bench_serving.py`` plan: shared system prompt, each turn replays the
+  conversation — the traffic prefix sharing monetizes) is captured live
+  from the engine's submit/result hooks and replayed on a fresh engine:
+  greedy fp replay is bit-identical to the recorded outputs, and a
+  replay under a DIFFERENT sampling config reports per-request
+  divergence instead of crashing (the parity oracle's two halves);
+- **fleet chaos replay** — a 3-replica fleet serves deterministic
+  traffic while a seeded chaos kill removes a replica mid-stream; the
+  capture records the kill as a chaos event and the replay co-replays
+  it on a fresh fleet: same kill, zero loss, bit-identical outputs;
+- **advisor backtest** — the captured session traffic replays under
+  prefix-sharing off/on and int8-KV what-ifs; the capacity advisor's
+  predictions (the live ``CAPACITY_REPORT`` lever) are scored against
+  achieved prefill-tokens-saved / TTFT / goodput into a
+  prediction-error report (``REPLAY_REPORT.json`` carries the parity
+  verdict for the doctor's ``[replay]`` section);
+- **perf ledger** — every ``*_BENCH*.json`` in the repo normalizes into
+  the cross-PR ``PERF_LEDGER.json`` trajectory
+  (``observability/perf_ledger.py``), and the regression gate is proven
+  to trip on an injected regression and pass clean otherwise.
+
+``--smoke`` is the CPU tier-1 gate (wired via
+tests/unit/test_replay.py, same pattern as bench_fleet.py): asserts all
+four loops — fleet replay parity including the recorded kill, backtest
+prefix-sharing prediction within ±10 points, ledger over >= 5 bench
+files with the gate trip/clean pair — and writes ``REPLAY_BENCH.json``
++ ``REPLAY_REPORT.json`` and regenerates ``PERF_LEDGER.json``. Prints
+one JSON line ending in "smoke-pass"; exits nonzero on failure.
+"""
+
+import copy
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_engine(max_len=64):
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    cfg = tiny_test(n_layer=2, d_model=64, d_ff=128, n_head=4,
+                    max_seq=max_len, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return ds.init_inference(model, params,
+                             {"dtype": "float32", "eos_token_id": 510})
+
+
+def fleet_traffic(n, seed, lengths=(5, 16, 20, 9)):
+    """Deterministic prompts over a FIXED length set (every chunk-bucket
+    shape, small so the compiled-program set stays tiny)."""
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, 256, (lengths[i % len(lengths)],))
+             .astype(np.int32), 5, 400 + i) for i in range(n)]
+
+
+# ------------------------------------------------------------------ smoke
+def smoke():
+    """CPU tier-1 gate: capture/replay parity (engine + fleet w/ kill),
+    divergence-as-data, backtest ±10 pts, ledger gate trip/clean."""
+    from bench_serving import make_multiturn_plan, run_multiturn
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.observability import perf_ledger as pl
+    from deepspeed_tpu.observability.replay import (ReplayClock,
+                                                    ReplayDriver,
+                                                    TrafficTrace,
+                                                    advisor_backtest,
+                                                    write_backtest_report)
+    from deepspeed_tpu.serving import FleetEngine
+
+    max_len = 64
+    res = {"smoke": True}
+    eng = build_engine(max_len)        # ONE engine shared by every phase
+
+    # ---- A) capture a greedy multi-turn session run, replay it -------
+    base = {"slots": 2, "max_len": max_len, "prefill_chunk": 16,
+            "greedy": True, "page_size": 8,
+            "workload": {"block": 8}}
+    clock = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(eng, {**base, "capture": True}, clock=clock)
+    plan = make_multiturn_plan(sessions=3, turns=3, seed=3, sys_tokens=16,
+                               user=(4, 8), max_new=(3, 5))
+    run_multiturn(srv, plan)
+    trace = srv.capture.trace()
+    assert trace.validate() == [], trace.validate()
+    assert len(trace.requests) == 9 and len(trace.results) == 9
+    cap_report = srv.capacity_report(census=False)   # the advisor's
+    # predictions ON THIS TRAFFIC — what the backtest scores below
+    cap_saved = srv.pool.snapshot()["prefill_tokens_saved"]
+    srv.close()
+
+    # round-trip through disk: the replay consumes the ARTIFACT, not the
+    # in-memory object (the incident-dir workflow)
+    with tempfile.TemporaryDirectory() as td:
+        tpath = trace.write(Path(td) / "traffic_trace.jsonl")
+        trace = TrafficTrace.read(tpath)
+    assert trace.validate() == [] and trace.torn_lines == 0
+
+    rc = ReplayClock(dt=1e-3)
+    rep = ReplayDriver(ds.ServingEngine(eng, base, clock=rc), trace,
+                       clock=rc).run()
+    assert rep.parity is True and rep.matched == 9, \
+        (rep.parity, rep.matched, rep.diverged)
+    assert rep.chaos_applied == 0 and not rep.failed_submits
+
+    # divergence is DATA: a replay under different sampling reports
+    # per-request divergence + the config drift note, never a crash
+    rc2 = ReplayClock(dt=1e-3)
+    bad = ReplayDriver(
+        ds.ServingEngine(eng, {**base, "greedy": False,
+                               "temperature": 0.8, "top_k": 20},
+                         clock=rc2), trace, clock=rc2).run()
+    assert bad.parity is False and len(bad.diverged) >= 1
+    assert any("config_drift" in n for n in bad.notes)
+    res["capture_replay"] = {
+        "requests": len(trace.requests),
+        "parity": rep.matched == 9,
+        "divergence_reported": len(bad.diverged),
+        "capture_prefill_tokens_saved": int(cap_saved),
+    }
+
+    # ---- B) fleet run with a recorded chaos kill, replayed -----------
+    fserv = {"slots": 2, "max_len": max_len, "prefill_chunk": 16,
+             "greedy": True}
+    fc = ReplayClock(dt=1e-3)
+    fleet = FleetEngine(eng, {**fserv, "capture": True}, replicas=3,
+                        clock=fc,
+                        chaos={"enabled": True, "seed": 1,
+                               "kill_replica": "r1",
+                               "kill_replica_step": 6})
+    reqs = fleet_traffic(10, seed=23)
+    rids = [fleet.submit(p, mn, seed=sd, session_id=f"s{i % 3}")
+            for i, (p, mn, sd) in enumerate(reqs)]
+    done = {}
+    it = 0
+    while len(done) < len(rids):
+        for req in fleet.step():
+            done[req.rid] = req
+            fleet.results.pop(req.rid, None)
+        it += 1
+        assert it < 100_000
+    assert fleet.chaos.injected and "r1" not in fleet.replicas
+    ftrace = fleet.capture.trace()
+    assert ftrace.validate() == []
+    kills = [e for e in ftrace.chaos_events
+             if e["event"] == "kill_replica"]
+    assert len(kills) == 1 and kills[0]["replica"] == "r1"
+    requeued = int(fleet.registry.snapshot()["counters"]
+                   .get("Fleet/requeued", 0))
+    fleet.close()
+
+    frc = ReplayClock(dt=1e-3)
+    f2 = FleetEngine(eng, fserv, replicas=3, clock=frc)
+    frep = ReplayDriver(f2, ftrace, clock=frc).run()
+    assert "r1" not in f2.replicas, "recorded kill was not co-replayed"
+    assert frep.chaos_applied == 1 and frep.chaos_skipped == []
+    assert frep.parity is True and frep.matched == len(rids), \
+        (frep.parity, frep.matched, frep.diverged)
+    f2.close()
+    res["fleet_replay"] = {
+        "replicas": 3, "requests": len(rids),
+        "recorded_kill_replica": "r1",
+        "capture_requeued": requeued,
+        "replay_chaos_applied": frep.chaos_applied,
+        "parity_with_recorded": True,
+    }
+
+    # ---- C) advisor backtest on the captured session traffic ---------
+    bt = advisor_backtest(trace, eng,
+                          {"slots": 2, "max_len": max_len,
+                           "prefill_chunk": 16, "greedy": True},
+                          capacity_report=cap_report, page_size=8)
+    ps = bt["levers"]["prefix_sharing"]
+    assert ps["source"] == "capacity_report", ps["source"]
+    assert ps["abs_error_pts"] is not None and ps["abs_error_pts"] <= 10, \
+        f"prefix-sharing prediction off by {ps['abs_error_pts']:.1f} pts"
+    kv = bt["levers"]["kv_quantization"]
+    assert kv["achieved"] is not None and kv["achieved"] <= 0.5, \
+        "int8 KV failed to at least halve ledger bytes/token in replay"
+    write_backtest_report(bt, os.path.join(_ROOT, "BACKTEST_REPORT.json"))
+    rep.write(os.path.join(_ROOT, "REPLAY_REPORT.json"))
+    res["backtest"] = {
+        "prefix_sharing_predicted": round(ps["predicted"], 4),
+        "prefix_sharing_achieved": round(ps["achieved"], 4),
+        "prefix_sharing_abs_error_pts": round(ps["abs_error_pts"], 2),
+        "kv_bytes_ratio_predicted": kv["predicted"],
+        "kv_bytes_ratio_achieved": kv["achieved"],
+        "what_if_ttft_p50_s": ps["what_if"]["ttft_p50_s"],
+        "what_if_goodput_frac": ps["what_if"]["goodput_frac"],
+    }
+
+    # ---- D) perf ledger: >= 5 benches, gate trips injected, clean else
+    led = pl.update_ledger(_ROOT, os.path.join(_ROOT, "PERF_LEDGER.json"))
+    ing = led["ingested"]
+    assert ing["benches"] >= 5, \
+        f"ledger ingested only {ing['benches']} bench files"
+    assert ing["metrics"] >= 50
+    # trip/clean on a COPY: the real trajectory must not carry a
+    # fabricated regression
+    sick = copy.deepcopy(led)
+    key = next(k for k, s in sick["series"].items()
+               if s["direction"] == "up" and s["points"]
+               and s["points"][-1][1] > 0)
+    sick["series"][key]["points"].append(
+        ["injected", sick["series"][key]["points"][-1][1] * 0.5])
+    tripped = pl.check_regressions(sick, margin=0.2)
+    assert any(f["series"] == key for f in tripped), \
+        "injected 2x regression did not trip the gate"
+    clean = pl.check_regressions(led, margin=0.2)
+    res["perf_ledger"] = {
+        "benches_ingested": ing["benches"],
+        "metrics_ingested": ing["metrics"],
+        "series": len(led["series"]),
+        "runs": len(led["runs"]),
+        "gate_trips_on_injected_regression": True,
+        "clean_findings": len(clean),
+    }
+
+    res["verdict"] = "smoke-pass"
+    with open(os.path.join(_ROOT, "REPLAY_BENCH.json"), "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+# ------------------------------------------------------------------- main
+def main():
+    """Fuller (still CPU-sized) run: bigger session traffic, paced vs
+    compressed replay walls, the full backtest — REPLAY_BENCH.json."""
+    import time
+
+    from bench_serving import make_multiturn_plan, run_multiturn
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.observability import perf_ledger as pl
+    from deepspeed_tpu.observability.replay import (ReplayClock,
+                                                    ReplayDriver,
+                                                    advisor_backtest)
+
+    max_len = 128
+    eng = build_engine(max_len)
+    base = {"slots": 4, "max_len": max_len, "prefill_chunk": 16,
+            "greedy": True, "page_size": 8, "workload": {"block": 8}}
+    clock = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(eng, {**base, "capture": True}, clock=clock)
+    plan = make_multiturn_plan(sessions=6, turns=4, seed=3, sys_tokens=32,
+                               user=(6, 12), max_new=(4, 8))
+    run_multiturn(srv, plan)
+    trace = srv.capture.trace()
+    cap_report = srv.capacity_report(census=False)
+    srv.close()
+
+    rows = {}
+    for mode, paced in (("compressed", 0.0), ("paced", 1e-3)):
+        rc = ReplayClock(dt=1e-3)
+        t0 = time.perf_counter()
+        rep = ReplayDriver(ds.ServingEngine(eng, base, clock=rc), trace,
+                           clock=rc, paced_dt=paced).run()
+        rows[mode] = {"wall_s": round(time.perf_counter() - t0, 3),
+                      "parity": rep.parity, "matched": rep.matched,
+                      "requests": rep.requests}
+    bt = advisor_backtest(trace, eng,
+                          {"slots": 4, "max_len": max_len,
+                           "prefill_chunk": 16, "greedy": True},
+                          capacity_report=cap_report, page_size=8)
+    led = pl.update_ledger(_ROOT, os.path.join(_ROOT, "PERF_LEDGER.json"))
+    res = {
+        "workload": {"sessions": 6, "turns": 4,
+                     "requests": len(trace.requests)},
+        "replay": rows,
+        "backtest": {k: {kk: v[kk] for kk in
+                         ("predicted", "achieved", "abs_error_pts")
+                         if kk in v}
+                     for k, v in bt["levers"].items()},
+        "perf_ledger": led["ingested"],
+    }
+    with open(os.path.join(_ROOT, "REPLAY_BENCH.json"), "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        main()

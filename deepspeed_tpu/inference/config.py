@@ -109,6 +109,16 @@ class ServingConfig:
     # host clock reads per iteration when on; False (default) builds no
     # ledger — zero clock reads, zero programs.
     goodput: bool = False
+    # Traffic capture (observability/replay.py): record every admitted
+    # submit (relative time, prompt ids, seed, session, deadline
+    # overrides), terminal result (the parity oracle's reference
+    # tokens), and fleet chaos event into a bounded host ring — the
+    # record half of record→replay. Flight/incident dumps bundle the
+    # ring's tail as traffic_trace.jsonl. False (default) builds no
+    # capture at all — one `is not None` per submit/retire, zero
+    # programs, zero syncs.
+    capture: bool = False
+    capture_ring: int = 4096
     # Live telemetry & control plane
     # (observability.server.TelemetryConfig | dict): an HTTP ops surface
     # (/metrics /healthz /readyz /requests /capacity /goodput /flight +
@@ -163,6 +173,9 @@ class ServingConfig:
         if self.spans_ring < 1:
             raise ValueError(f"spans_ring must be >= 1, "
                              f"got {self.spans_ring}")
+        if self.capture_ring < 1:
+            raise ValueError(f"capture_ring must be >= 1, "
+                             f"got {self.capture_ring}")
         if self.slo is not None:
             from ..observability.slo import SLOConfig
 

@@ -24,6 +24,13 @@ from .flight import (FlightRecorder, newest_flight_record,
 from .goodput import BADPUT_BUCKETS, GoodputLedger
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
                       get_registry)
+# perf_ledger is intentionally NOT imported here: like doctor.py it is a
+# `python -m` CLI, and importing it from the package __init__ makes the
+# -m runner warn about the double module object. Import it as
+# deepspeed_tpu.observability.perf_ledger.
+from .replay import (TRACE_SCHEMA, ReplayClock, ReplayDriver, ReplayReport,
+                     TrafficCapture, TrafficTrace, advisor_backtest,
+                     trace_from_request_log, write_backtest_report)
 from .sinks import (JsonlSink, PrometheusTextfileSink,
                     format_prometheus_value, parse_prometheus_textfile,
                     prometheus_name)
@@ -56,4 +63,7 @@ __all__ = [
     "ProgramCensus", "hbm_ledger", "kv_cache_bytes", "capacity_report",
     "validate_capacity_report", "write_capacity_report",
     "TraceWindow", "sample_memory",
+    "TrafficCapture", "TrafficTrace", "ReplayClock", "ReplayDriver",
+    "ReplayReport", "advisor_backtest", "trace_from_request_log",
+    "write_backtest_report", "TRACE_SCHEMA",
 ]

@@ -14,17 +14,26 @@ running engine, no device):
   Perfetto trace lives;
 - the newest capacity report (``CAPACITY_REPORT*.json``) — HBM ledger
   totals and the advisor's ranked levers (docs/OPERATIONS.md
-  capacity-planning runbook).
+  capacity-planning runbook);
+- ``[replay]`` — the newest traffic trace (``*traffic_trace*.jsonl``,
+  the record half of record→replay, bundled into flight/incident dumps)
+  schema-validated, plus the last replay parity verdict
+  (``REPLAY_REPORT*.json`` — ``observability/replay.py``);
+- ``[perf]`` — the cross-PR perf ledger (``PERF_LEDGER.json``,
+  ``observability/perf_ledger.py``): trajectory summary and the
+  regression gate vs each series' rolling best.
 
 Exit code is the CI/cron gate: **nonzero** when the newest flight record
 contains a why-marker (watchdog stall, SLO breach, anomaly, compile
 storm — something fired since the record was cut), when any
-``dstpu_*_burn`` SLO gauge in the latest .prom is above zero, or when
+``dstpu_*_burn`` SLO gauge in the latest .prom is above zero, when
 the newest incident dir is UNRECONCILED (per-replica dumps from fewer
-replicas than the fleet had live — the post-mortem is incomplete); 0 on
-a clean replica. ``--no-gate`` restores the always-0 report-only
-behavior. ``--targets`` combined with ``--flight-dir`` runs the
-incident gate alongside fleet triage.
+replicas than the fleet had live — the post-mortem is incomplete), when
+the newest traffic trace is invalid or the last replay verdict is a
+parity FAILURE, or when the perf ledger holds a series worse than its
+rolling best beyond the margin; 0 on a clean replica. ``--no-gate``
+restores the always-0 report-only behavior. ``--targets`` combined with
+``--flight-dir`` runs the incident gate alongside fleet triage.
 
 ``--url http://host:port`` switches to **live mode**: instead of files,
 the doctor scrapes a running engine's telemetry plane
@@ -264,6 +273,112 @@ def report_incidents(d: Path, events: int = 12) -> list:
     return findings
 
 
+def _newest_trace_file(dirs) -> Optional[Path]:
+    """Newest traffic-trace JSONL across the given dirs, searched
+    recursively — traces live beside the monitor artifacts AND inside
+    flight/incident dumps (the capture ring's tail)."""
+    cands: list[Path] = []
+    seen: set = set()
+    for d in dirs:
+        d = Path(d)
+        if not d.is_dir() or d in seen:
+            continue
+        seen.add(d)
+        cands += [p for p in d.rglob("*traffic_trace*.jsonl")
+                  if p.is_file()]
+    if not cands:
+        return None
+    return max(cands, key=lambda p: (p.stat().st_mtime, str(p)))
+
+
+def report_replay(dirs) -> list:
+    """Print the ``[replay]`` picture: the newest traffic trace
+    (present/valid, event counts) and the last replay parity verdict.
+    Gate findings: an INVALID trace (the incident is not replayable as
+    recorded) and a parity-FAILED replay report (same traffic, different
+    bits — the regression the replay loop exists to catch)."""
+    from .replay import TrafficTrace
+
+    findings: list = []
+    tr_path = _newest_trace_file(dirs)
+    if tr_path is None:
+        print(f"[replay] no traffic trace under {', '.join(map(str, dirs))}")
+    else:
+        tr = TrafficTrace.read(tr_path)
+        problems = tr.validate()
+        torn = f" {tr.torn_lines} torn line(s)" if tr.torn_lines else ""
+        print(f"[replay] {tr_path}")
+        print(f"  requests={len(tr.requests)} results={len(tr.results)} "
+              f"chaos={len(tr.chaos_events)}"
+              f" dropped={tr.meta.get('dropped_events', 0)}{torn}")
+        if problems:
+            for p in problems[:4]:
+                print(f"  INVALID: {p}")
+            findings.append(
+                f"traffic trace {tr_path.name} is invalid "
+                f"({len(problems)} schema problems)")
+    rep_path = None
+    for d in dirs:
+        cand = _newest(Path(d), "REPLAY_REPORT*.json") \
+            if Path(d).is_dir() else None
+        if cand is not None and (rep_path is None
+                                 or cand.stat().st_mtime
+                                 > rep_path.stat().st_mtime):
+            rep_path = cand
+    if rep_path is None:
+        print("[replay] no REPLAY_REPORT*.json (no replay run yet — see "
+              "docs/OPERATIONS.md incident-replay runbook)")
+        return findings
+    try:
+        rep = json.loads(rep_path.read_text(errors="replace"))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[replay] {rep_path} unreadable ({e!r})")
+        return findings
+    rep = rep if isinstance(rep, dict) else {}
+    parity = rep.get("parity")
+    verdict = {True: "PARITY", False: "DIVERGED",
+               None: "no oracle (trace carried no recorded outputs)"}
+    print(f"[replay] last replay {rep_path.name}: "
+          f"{verdict.get(parity, parity)} — "
+          f"matched {rep.get('matched')}/{rep.get('requests')}, "
+          f"{len(rep.get('diverged') or [])} diverged, "
+          f"chaos applied {rep.get('chaos_applied')}")
+    if parity is False:
+        div = rep.get("diverged") or []
+        rids = ", ".join(str(x.get("rid")) for x in div[:8]
+                         if isinstance(x, dict))
+        findings.append(
+            f"replay parity FAILED in {rep_path.name}: "
+            f"{len(div)} request(s) diverged"
+            + (f" (rids {rids})" if rids else ""))
+    return findings
+
+
+def report_perf(ledger_path: Path, margin: float = 0.2) -> list:
+    """Print the ``[perf]`` trajectory summary; gate findings are every
+    series whose newest point is worse than its rolling best beyond the
+    margin (``perf_ledger.check_regressions``)."""
+    from .perf_ledger import check_regressions, load_ledger, summarize
+
+    if not Path(ledger_path).is_file():
+        print(f"[perf] no ledger at {ledger_path} (run "
+              "python -m deepspeed_tpu.observability.perf_ledger)")
+        return []
+    led = load_ledger(ledger_path)
+    s = summarize(led)
+    print(f"[perf] {ledger_path}: {s['series']} series "
+          f"({s['directed_series']} directed, "
+          f"{s['series_with_history']} with history) over {s['runs']} "
+          f"run(s), last {s['last_run']}")
+    regs = check_regressions(led, margin=margin)
+    for r in regs[:8]:
+        print(f"  REGRESSION {r['series']} [{r['direction']}] "
+              f"best {r['best']:g} -> {r['last']:g} at {r['last_run']}")
+    return [f"perf regression: {r['series']} best {r['best']:g} -> "
+            f"{r['last']:g} ({r['direction']}, margin {margin:g})"
+            for r in regs]
+
+
 def report_capacity(d: Path, levers: int = 4) -> None:
     """Print the newest capacity report's ledger totals + ranked advisor
     levers (informational — the advisor ranks levers, it doesn't gate)."""
@@ -496,6 +611,12 @@ def main(argv=None) -> int:
                          "SLO gauge, or flight why-marker gates")
     ap.add_argument("--timeout", type=float, default=3.0,
                     help="per-endpoint timeout in live mode (default 3s)")
+    ap.add_argument("--ledger", default=None,
+                    help="perf ledger path for the [perf] section "
+                         "(default <dir>/PERF_LEDGER.json)")
+    ap.add_argument("--perf-margin", type=float, default=0.2,
+                    help="relative regression margin for the [perf] gate "
+                         "(default 0.2)")
     args = ap.parse_args(argv)
     if args.targets:
         findings = report_fleet(
@@ -516,6 +637,10 @@ def main(argv=None) -> int:
         findings += report_flight(fdir)
         findings += report_incidents(fdir)
         report_capacity(d)
+        findings += report_replay([d] if fdir == d else [d, fdir])
+        ledger = Path(args.ledger) if args.ledger \
+            else d / "PERF_LEDGER.json"
+        findings += report_perf(ledger, margin=args.perf_margin)
     if findings:
         print(f"[gate] {len(findings)} finding(s):")
         for f in findings:

@@ -39,6 +39,8 @@ import json
 from pathlib import Path
 from typing import Iterable, Optional
 
+import numpy as np
+
 from . import spans as S
 from .sinks import JsonlSink
 
@@ -472,6 +474,15 @@ def hop_trace(req) -> dict:
 
 
 # ------------------------------------------------------------- request log
+# v2 grew the fields deterministic replay needs (observability/replay.py
+# trace_from_request_log): prompt token ids, sampling seed, session id,
+# and the per-request deadline BUDGETS (relative seconds, recomputed from
+# the absolute stamps) — an existing request log upgrades cleanly into a
+# TrafficTrace. v1 rows (no schema key) still parse everywhere; they just
+# cannot replay.
+REQUEST_RECORD_SCHEMA = "dstpu.request_record.v2"
+
+
 def request_record(req, queue_wait_s: Optional[float] = None) -> dict:
     """One retired serving request → a flat JSON-able record (the
     per-request row of the request log and of flight dumps)."""
@@ -486,8 +497,28 @@ def request_record(req, queue_wait_s: Optional[float] = None) -> dict:
     if (req.finish_t is not None and req.first_token_t is not None
             and n > 1):
         tpot = (req.finish_t - req.first_token_t) / (n - 1)
+    dl_ttft = getattr(req, "deadline_ttft", None)
+    dl_total = getattr(req, "deadline_total", None)
+    prompt = getattr(req, "prompt", None)
+    # session ids are opaque hashables (fleet affinity); the record must
+    # stay json.dumps-able by every sink, so exotic types stringify
+    sid = getattr(req, "session_id", None)
+    if sid is not None and not isinstance(sid, (str, int, float, bool)):
+        sid = str(sid)
     return {
+        "schema": REQUEST_RECORD_SCHEMA,
         "rid": req.rid, "status": status, "prompt_len": req.prompt_len,
+        # replay fields: the (prompt, seed) pair IS the request's bit
+        # stream (per-request RNG folds from the seed), session_id keys
+        # fleet affinity, the deadline budgets are the submit overrides
+        "prompt": ([int(t) for t in np.asarray(prompt).reshape(-1)
+                    .tolist()] if prompt is not None else None),
+        "seed": int(getattr(req, "seed", 0)),
+        "session_id": sid,
+        "ttft_deadline_s": (dl_ttft - req.submit_t
+                            if dl_ttft is not None else None),
+        "total_deadline_s": (dl_total - req.submit_t
+                             if dl_total is not None else None),
         "max_new": req.max_new, "tokens": n, "slot": req.slot,
         "submit_t": req.submit_t, "first_token_t": req.first_token_t,
         "finish_t": req.finish_t, "ttft_s": ttft, "tpot_s": tpot,

@@ -104,6 +104,12 @@ class FlightRecorder:
         # cross-replica capture. None (default) = dumps land under
         # ``dump_dir`` exactly as before.
         self.redirect: Optional[Callable[[str], Optional[Path]]] = None
+        # extra dump artifacts: name -> zero-arg callable returning the
+        # file's TEXT (e.g. the traffic capture's traffic_trace.jsonl
+        # tail — observability/replay.py — so every dump is replayable
+        # standing alone). Called at dump time only, under the same
+        # per-artifact write guards as the built-in artifacts.
+        self.artifacts: dict[str, Callable[[], str]] = {}
         self._markers = S.SpanRecorder(capacity=256, clock=self.clock)
         self._requests: deque[dict] = deque(maxlen=int(recent_requests))
         # RLock for the same reason as SpanRecorder: dump() runs inside
@@ -122,6 +128,12 @@ class FlightRecorder:
     def add_snapshot_provider(self, name: str,
                               fn: Callable[[], dict]) -> None:
         self.snapshots[name] = fn
+
+    def add_artifact_provider(self, name: str,
+                              fn: Callable[[], str]) -> None:
+        """Register an extra dump artifact: ``fn()`` returns the text
+        written as ``<dump_dir>/<name>`` on every dump."""
+        self.artifacts[name] = fn
 
     def note(self, name: str, t: Optional[float] = None,
              **meta) -> S.SpanEvent:
@@ -246,6 +258,9 @@ class FlightRecorder:
         _write("metrics.json", _w_metrics)
         _write("requests.jsonl", _w_requests)
         _write("trace.json", _w_trace)
+        for name, fn in list(self.artifacts.items()):
+            _write(name, lambda name=name, fn=fn:
+                   (d / name).write_text(fn(), encoding="utf-8"))
         log_dist(f"flight recorder: dumped {len(events)} events to {d} "
                  f"(reason: {reason})", ranks=[0], level="WARNING")
         return d

@@ -158,6 +158,19 @@ class ServingEngine:
             self.workload = WorkloadAnalyzer(
                 self.cfg.workload, registry=self.stats.registry,
                 clock=self.stats.clock)
+        # traffic capture (observability/replay.py): every admitted
+        # submit + terminal result into a bounded host ring — the record
+        # half of record→replay; flight dumps bundle the ring's tail so
+        # an incident dir is replayable standing alone. None (default)
+        # builds nothing: one `is not None` per submit/retire, zero
+        # programs, zero syncs (compile-freeze gates stay the oracle).
+        self.capture = None
+        if self.cfg.capture:
+            from ..observability.replay import TrafficCapture
+
+            self.attach_capture(TrafficCapture(
+                clock=self.stats.clock, ring=self.cfg.capture_ring,
+                meta=self._capture_meta()))
         self._build_slo(self.cfg.slo)
         # goodput/badput wall-time ledger (observability/goodput.py):
         # None (default) = zero clock reads added to the loop; enabled =
@@ -289,6 +302,25 @@ class ServingEngine:
         return {"reloaded": True, "enabled": self.slo is not None,
                 "slo": _dc.asdict(slo) if slo is not None else None}
 
+    def _capture_meta(self) -> dict:
+        """Trace-header meta via the ONE shared builder
+        (:func:`~..observability.replay.capture_meta`) — the recorded
+        config a faithful replay must match."""
+        from ..observability.replay import capture_meta
+
+        return capture_meta(self.cfg, engine=self.name or "serving")
+
+    def attach_capture(self, capture) -> None:
+        """Adopt a :class:`~..observability.replay.TrafficCapture` (the
+        config path builds one automatically when ``serving.capture`` is
+        set; tests and benches may attach their own). When a flight
+        recorder exists, the capture ring's tail rides every dump as
+        ``traffic_trace.jsonl``."""
+        self.capture = capture
+        if self.flight is not None and capture is not None:
+            self.flight.add_artifact_provider("traffic_trace.jsonl",
+                                              capture.tail_text)
+
     def _flush_table(self) -> None:
         """Mirror the host page tables into the decode carry when they
         changed (a row seated at insert, or cleared at retirement before
@@ -378,6 +410,11 @@ class ServingEngine:
                                 total_deadline_s=total_deadline_s)
         if req.deadline_ttft is not None or req.deadline_total is not None:
             self._any_deadlines = True
+        if self.capture is not None:
+            # record the OVERRIDES as passed (None = config default), so
+            # replay under the same config reproduces deadline semantics
+            self.capture.on_submit(req, ttft_deadline_s=ttft_deadline_s,
+                                   total_deadline_s=total_deadline_s)
         return req.rid
 
     def requeue(self, req: Request) -> Request:
@@ -590,6 +627,8 @@ class ServingEngine:
             self._table_dirty = True
         if self.workload is not None:
             self.workload.on_retire(req)
+        if self.capture is not None:
+            self.capture.on_result(req)
         if self._request_logs or self.flight is not None:
             rec = request_record(req)
             for sink in self._request_logs:

@@ -197,6 +197,21 @@ class FleetEngine:
             self.spans = _spans.SpanRecorder(cfg0.spans_ring,
                                              clock=self._clock)
             self._audit = deque(maxlen=_AUDIT_RING)
+        # ---- traffic capture (observability/replay.py): the FLEET owns
+        # the trace — one stream recording routed submits (with session
+        # ids), terminal results, and chaos events (kills/joins/drains),
+        # replayable against any topology. Replicas are built with
+        # capture stripped (_replica_cfg) so nothing double-records.
+        # Off (default) builds nothing.
+        self.capture = None
+        if cfg0.capture:
+            from ..observability.replay import TrafficCapture, capture_meta
+
+            self.capture = TrafficCapture(
+                clock=self._clock, ring=cfg0.capture_ring,
+                meta=capture_meta(cfg0, engine="fleet",
+                                  replicas=replicas,
+                                  prefill_replicas=prefill_replicas))
         # ---- correlated incident capture: when the replicas carry
         # flight recorders (serving.flight_dir), any one replica's dump
         # trigger (watchdog stall, nonfinite halt, SIGTERM, manual) is
@@ -260,9 +275,16 @@ class FleetEngine:
     # ------------------------------------------------------------ replicas
     def _replica_cfg(self) -> ServingConfig | dict | None:
         """A FRESH config per replica (``reload_slo`` mutates in place —
-        replicas must not share one instance)."""
+        replicas must not share one instance). Traffic capture is
+        STRIPPED: the fleet records the trace at its own surface (one
+        stream, session ids, chaos events); a per-replica capture would
+        double-record every request."""
         if isinstance(self._spec, ServingConfig):
-            return dataclasses.replace(self._spec)
+            cfg = dataclasses.replace(self._spec)
+            cfg.capture = False
+            return cfg
+        if isinstance(self._spec, dict) and self._spec.get("capture"):
+            return {**self._spec, "capture": False}
         return self._spec
 
     def _build_replica(self, name: str, role: str) -> ServingEngine:
@@ -315,6 +337,8 @@ class FleetEngine:
                 name = f"{stem}{self._joined}"
         self._build_replica(name, role)
         self.registry.counter("Fleet/replica_joins").inc()
+        if self.capture is not None:
+            self.capture.on_chaos("add_replica", name)
         return name
 
     def remove_replica(self, name: str) -> list:
@@ -322,7 +346,10 @@ class FleetEngine:
         queued and in-flight requests requeue onto survivors (typed
         ``REQUEUED``, ``attempts`` bumped, original deadlines kept).
         Returns the requeued rids."""
-        return self._remove(name)
+        out = self._remove(name)
+        if self.capture is not None:
+            self.capture.on_chaos("remove_replica", name)
+        return out
 
     def kill_replica(self, name: str) -> list:
         """Abrupt replica loss (the chaos fault): mechanically identical
@@ -333,6 +360,10 @@ class FleetEngine:
         without counting: dashboards never show a phantom incident."""
         out = self._remove(name)
         self.registry.counter("Fleet/replica_kills").inc()
+        if self.capture is not None:
+            # the chaos script half of the trace: replay re-kills this
+            # replica at the same position in the stream
+            self.capture.on_chaos("kill_replica", name)
         return out
 
     def _remove(self, name: str) -> list:
@@ -629,6 +660,10 @@ class FleetEngine:
             # replica's own ring continues from its queue span.
             self.spans.emit(_spans.ROUTE, req.submit_t, rid=rid,
                             replica=name)
+        if self.capture is not None:
+            self.capture.on_submit(req, session_id=session_id,
+                                   ttft_deadline_s=ttft_deadline_s,
+                                   total_deadline_s=total_deadline_s)
         return rid
 
     def cancel(self, rid: int) -> Optional[Request]:
@@ -805,6 +840,10 @@ class FleetEngine:
             len(self._handoffs))
 
     def _adopt_result(self, req: Request, name: str) -> None:
+        if self.capture is not None:
+            # every terminal path funnels through adoption; the capture
+            # dedupes by rid, so late re-visits (loss harvest) are safe
+            self.capture.on_result(req)
         self.results[req.rid] = req
         if name:
             self._owner[req.rid] = name
@@ -843,11 +882,15 @@ class FleetEngine:
         self._draining = True
         for eng in self.replicas.values():
             eng.begin_drain()
+        if self.capture is not None:
+            self.capture.on_chaos("begin_drain")
 
     def end_drain(self) -> None:
         self._draining = False
         for eng in self.replicas.values():
             eng.end_drain()
+        if self.capture is not None:
+            self.capture.on_chaos("end_drain")
 
     @property
     def draining(self) -> bool:
@@ -1182,11 +1225,20 @@ class FleetEngine:
                 json.dumps(self.merge_trace(), default=_json_default),
                 encoding="utf-8")
 
+        def _w_capture():
+            fd.mkdir(exist_ok=True)
+            (fd / "traffic_trace.jsonl").write_text(
+                self.capture.tail_text(), encoding="utf-8")
+
         _w("incident.json", _w_manifest)
         if self.spans is not None:
             _w("events.jsonl", _w_fleet_events)
             _w("route_audit.jsonl", _w_audit)
             _w("trace_merged.json", _w_trace)
+        if self.capture is not None:
+            # the capture ring's tail: the incident is replayable
+            # standing alone (docs/OPERATIONS.md incident-replay runbook)
+            _w("traffic_trace.jsonl", _w_capture)
 
     def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
         """Push ``Fleet/*`` (health rollup + goodput refreshed first)

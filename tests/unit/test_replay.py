@@ -1,0 +1,601 @@
+"""Traffic capture & deterministic replay (observability/replay.py) +
+the cross-PR perf ledger (observability/perf_ledger.py).
+
+Oracles:
+- trace schema: round-trips through JSONL byte-stable, the validator
+  catches every malformed shape, torn lines degrade (never raise);
+- capture: engine hooks record admitted submits + terminal results
+  (deduped), the ring bounds memory and counts drops, flight dumps
+  carry the ring's tail as a standalone-replayable artifact;
+- replay: fake-clock replay of a captured run is bit-identical to the
+  recorded outputs; a replay under a different sampling config reports
+  per-request divergence + a config-drift note instead of crashing; the
+  recorded chaos script co-replays (kill applied at its position);
+- request-log upgrade: v2 records (prompt/seed/session/deadline
+  budgets) lift into a replayable trace; incomplete rows are skipped
+  and counted;
+- backtest: the advisor's prefix-sharing prediction on synthetic
+  80%-overlap traffic scores within ±10 points of achieved savings;
+- perf ledger: bench JSONs normalize into directed series, the
+  regression gate trips on an injected regression and passes clean,
+  the CLI and the doctor's [perf]/[replay] sections gate the same way;
+- bench_replay.py --smoke: the tier-1 capture/replay/backtest gate.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.observability import doctor
+from deepspeed_tpu.observability import perf_ledger as pl
+from deepspeed_tpu.observability.export import request_record
+from deepspeed_tpu.observability.replay import (ReplayClock, ReplayDriver,
+                                                TrafficCapture,
+                                                TrafficTrace,
+                                                advisor_backtest,
+                                                resolve_prompt,
+                                                trace_from_request_log)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+M = 48
+EOS = 510
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_test(max_seq=M, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ds.init_inference(model, params,
+                            {"dtype": "float32", "eos_token_id": EOS})
+    return cfg, model, params, eng
+
+
+def _serving(extra=None):
+    return {"slots": 2, "max_len": M, "prefill_chunk": 16,
+            "temperature": 0.8, "top_k": 20, **(extra or {})}
+
+
+def _reqs(n, seed=0, lengths=(5, 16, 20, 9)):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, 256, (lengths[i % len(lengths)],))
+             .astype(np.int32), 4, 700 + i) for i in range(n)]
+
+
+# ------------------------------------------------------------ trace schema
+def _synthetic_trace():
+    tr = TrafficTrace(meta={"note": "synthetic"})
+    tr.add_request(rid=0, t_rel=0.0, prompt=[1, 2, 3], max_new=4, seed=9,
+                   session_id="s0", ttft_deadline_s=1.5)
+    tr.add_request(rid=1, t_rel=0.5, gen={"seed": 3, "len": 8,
+                                          "vocab": 32}, max_new=2, seed=10)
+    tr.add_chaos("kill_replica", t_rel=0.7, replica="r1")
+    tr.add_result(rid=0, t_rel=1.0, status="ok", tokens=[5, 6, 7, 8])
+    tr.add_result(rid=1, t_rel=1.2, status="timeout", tokens=[3])
+    return tr
+
+
+def test_trace_roundtrip(tmp_path):
+    tr = _synthetic_trace()
+    assert tr.validate() == []
+    p = tr.write(tmp_path / "t.jsonl")
+    back = TrafficTrace.read(p)
+    assert back.events == tr.events
+    assert back.meta["schema"] == "dstpu.traffic_trace.v1"
+    assert back.meta["note"] == "synthetic"
+    assert back.torn_lines == 0
+    # writing what was read is byte-stable (modulo the header carrying
+    # the schema explicitly both times)
+    assert back.as_lines() == tr.as_lines()
+
+
+def test_trace_read_tolerates_torn_lines(tmp_path):
+    p = _synthetic_trace().write(tmp_path / "t.jsonl")
+    with open(p, "a", encoding="utf-8") as f:
+        f.write('{"kind": "result", "rid": 0, "t_re')   # torn mid-crash
+    back = TrafficTrace.read(p)
+    assert back.torn_lines == 1
+    assert len(back.events) == 5
+
+
+def test_trace_validator_negatives():
+    tr = _synthetic_trace()
+    tr.events[0]["max_new"] = 0
+    tr.add_result(rid=99, t_rel=2.0)                   # unknown rid
+    tr.events.append({"kind": "alien", "t_rel": 3.0})  # unknown kind
+    tr.add_chaos("meteor", t_rel=4.0)                  # unknown chaos
+    tr.events.append({"kind": "request", "t_rel": 0.1, "rid": 7,
+                      "max_new": 1, "seed": 0})        # no prompt, no gen
+    problems = tr.validate()
+    for frag in ("max_new >= 1", "unknown rid 99", "unknown kind 'alien'",
+                 "unknown chaos event 'meteor'",
+                 "prompt ids or a gen{seed,len} spec",
+                 "t_rel"):                             # out-of-order tail
+        assert any(frag in p for p in problems), (frag, problems)
+    dup = _synthetic_trace()
+    dup.add_request(rid=0, t_rel=2.0, prompt=[1], max_new=1, seed=0)
+    assert any("duplicate request rid 0" in p for p in dup.validate())
+    alien_schema = TrafficTrace(meta={"schema": "dstpu.traffic_trace.v9"})
+    assert any("unknown trace schema" in p
+               for p in alien_schema.validate())
+
+
+def test_gen_prompt_resolves_deterministically():
+    e = {"gen": {"seed": 3, "len": 8, "vocab": 32}}
+    a, b = resolve_prompt(e), resolve_prompt(e)
+    assert np.array_equal(a, b) and a.dtype == np.int32 and len(a) == 8
+    assert a.max() < 32
+    with pytest.raises(ValueError):
+        resolve_prompt({"rid": 1})
+
+
+# ---------------------------------------------------------------- capture
+class _Tick:
+    def __init__(self, dt=0.001):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+class _Req:
+    def __init__(self, rid, prompt, max_new=4, seed=0, status="ok",
+                 tokens=()):
+        import types
+
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new = max_new
+        self.seed = seed
+        self.status = types.SimpleNamespace(value=status)
+        self.tokens = list(tokens)
+        self.attempts = 0
+        self.session_id = None
+
+
+def test_capture_dedupes_results_and_bounds_ring():
+    cap = TrafficCapture(clock=_Tick(), ring=4)
+    r = _Req(0, [1, 2], tokens=[5, 6])
+    cap.on_submit(r, ttft_deadline_s=2.0)
+    cap.on_result(r)
+    cap.on_result(r)                       # fleet double-adoption path
+    tr = cap.trace()
+    assert len(tr.requests) == 1 and len(tr.results) == 1
+    assert tr.requests[0]["ttft_deadline_s"] == 2.0
+    assert tr.results[0]["tokens"] == [5, 6]
+    for i in range(1, 8):                  # overflow the 4-event ring
+        cap.on_submit(_Req(i, [1]))
+    assert cap.dropped > 0
+    tr2 = cap.trace()
+    assert len(tr2.events) == 4
+    assert tr2.meta["dropped_events"] == cap.dropped
+    # the tail text is a standalone parseable trace (header + events)
+    lines = cap.tail_text().strip().splitlines()
+    assert json.loads(lines[0])["schema"] == "dstpu.traffic_trace.v1"
+    assert len(lines) == 5
+
+
+def test_overflowed_ring_tail_stays_valid():
+    """Results whose request events were evicted from the ring must not
+    poison the tail trace: validate() stays clean (the doctor gates on
+    it) and the orphans count as dropped."""
+    cap = TrafficCapture(clock=_Tick(), ring=5)
+    reqs = [_Req(i, [1, 2], tokens=[4]) for i in range(4)]
+    for r in reqs:
+        cap.on_submit(r)
+    for r in reqs:
+        cap.on_result(r)     # ring tail: submit 3 + results 0..3
+    tr = cap.trace()
+    assert tr.validate() == []
+    rids = {q["rid"] for q in tr.requests}
+    assert rids == {3}
+    assert all(e["rid"] in rids for e in tr.events
+               if e["kind"] == "result")    # every kept result resolves
+    assert tr.meta["dropped_events"] == 6   # 3 evicted + 3 orphans
+    assert len(tr.events) == 2
+
+
+def test_replay_reports_unhostable_request_as_failed_submit(setup):
+    """A what-if replay under a SMALLER max_len cannot host a long
+    recorded request — that is data (failed_submits), never a crash."""
+    _, _, _, eng = setup
+    tr = TrafficTrace(meta={"max_len": M})
+    tr.add_request(rid=0, t_rel=0.0, prompt=list(range(1, 40)),
+                   max_new=4, seed=1)
+    tr.add_request(rid=1, t_rel=0.1, prompt=[1, 2, 3], max_new=4, seed=2)
+    rc = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(eng, _serving({"max_len": 32}), clock=rc)
+    rep = ReplayDriver(srv, tr, clock=rc).run()
+    assert [f["rid"] for f in rep.failed_submits] == [0]
+    assert rep.replayed == 1
+    assert any("config_drift" in n for n in rep.notes)  # max_len drift
+
+    # a recorded-OK request that never replayed must FAIL parity, not
+    # silently drop out of the verdict (the gate would report PARITY
+    # over requests that never ran)
+    tr2 = TrafficTrace(meta={"max_len": M})
+    tr2.add_request(rid=0, t_rel=0.0, prompt=list(range(1, 40)),
+                    max_new=2, seed=1)
+    tr2.add_request(rid=1, t_rel=0.1, prompt=[1, 2, 3], max_new=2, seed=2)
+    tr2.add_result(rid=0, t_rel=1.0, status="ok", tokens=[9, 9])
+    tr2.add_result(rid=1, t_rel=1.1, status="ok", tokens=[7, 7])
+    rc2 = ReplayClock(dt=1e-3)
+    srv2 = ds.ServingEngine(eng, _serving({"max_len": 32}), clock=rc2)
+    rep2 = ReplayDriver(srv2, tr2, clock=rc2).run()
+    assert rep2.parity is False
+    assert any(d["rid"] == 0 and d["replayed_status"] == "not_replayed"
+               for d in rep2.diverged)
+
+
+def test_capture_ring_validates():
+    with pytest.raises(ValueError):
+        TrafficCapture(ring=0)
+    from deepspeed_tpu.inference.config import ServingConfig
+
+    with pytest.raises(ValueError):
+        ServingConfig.from_any({"capture_ring": 0})
+
+
+# ------------------------------------------------- engine capture + replay
+def test_engine_capture_replay_parity_and_divergence(setup):
+    _, _, _, eng = setup
+    clock = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(eng, _serving({"capture": True}), clock=clock)
+    reqs = _reqs(6, seed=1)
+    srv.serve_batch([p for p, _, _ in reqs], [mn for _, mn, _ in reqs],
+                    [sd for _, _, sd in reqs])
+    trace = srv.capture.trace()
+    assert trace.validate() == []
+    assert len(trace.requests) == 6 and len(trace.results) == 6
+    # deadline overrides recorded as passed (none here)
+    assert all("ttft_deadline_s" not in e for e in trace.requests)
+    srv.close()
+
+    # bit-identical replay on the recorded config (fake clock)
+    rc = ReplayClock(dt=1e-3)
+    rep = ReplayDriver(ds.ServingEngine(eng, _serving(), clock=rc),
+                       trace, clock=rc).run()
+    assert rep.parity is True and rep.matched == 6
+    assert rep.diverged == [] and rep.failed_submits == []
+
+    # a different sampling config diverges PER REQUEST, with the drift
+    # note explaining why — and run() returns instead of raising
+    rc2 = ReplayClock(dt=1e-3)
+    bad = ReplayDriver(
+        ds.ServingEngine(eng, _serving({"greedy": True}), clock=rc2),
+        trace, clock=rc2).run()
+    assert bad.parity is False and len(bad.diverged) >= 1
+    assert {"rid", "first_diff", "recorded_tokens", "replayed_tokens"} \
+        <= set(bad.diverged[0])
+    assert any("config_drift" in n for n in bad.notes)
+
+
+def test_flight_dump_carries_traffic_trace(setup, tmp_path):
+    _, _, _, eng = setup
+    clock = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(
+        eng, _serving({"capture": True, "spans": True,
+                       "flight_dir": str(tmp_path)}), clock=clock)
+    reqs = _reqs(2, seed=2)
+    srv.serve_batch([p for p, _, _ in reqs], [mn for _, mn, _ in reqs],
+                    [sd for _, _, sd in reqs])
+    d = srv.dump_flight("manual")
+    assert d is not None
+    tr = TrafficTrace.read(d / "traffic_trace.jsonl")
+    assert tr.validate() == []
+    assert len(tr.requests) == 2 and len(tr.results) == 2
+    # the artifact replays standing alone — the incident-runbook path
+    rc = ReplayClock(dt=1e-3)
+    rep = ReplayDriver(ds.ServingEngine(eng, _serving(), clock=rc), tr,
+                       clock=rc).run()
+    assert rep.parity is True and rep.matched == 2
+    srv.close()
+
+
+def test_fleet_capture_records_and_coreplays_kill(setup):
+    from deepspeed_tpu.serving import FleetEngine
+
+    _, _, _, eng = setup
+    clock = ReplayClock(dt=1e-3)
+    fleet = FleetEngine(eng, _serving({"capture": True}), replicas=2,
+                        clock=clock)
+    reqs = _reqs(5, seed=3)
+    rids = [fleet.submit(p, mn, seed=sd, session_id="sess")
+            for p, mn, sd in reqs]
+    # run a bit, then kill r1 mid-traffic: the capture records the
+    # chaos event at its position in the stream
+    done = {}
+    for _ in range(3):
+        for req in fleet.step():
+            done[req.rid] = req
+    fleet.kill_replica("r1")
+    it = 0
+    while len(done) < len(rids):
+        for req in fleet.step():
+            done[req.rid] = req
+        it += 1
+        assert it < 100_000
+    trace = fleet.capture.trace()
+    assert trace.validate() == []
+    assert [e["event"] for e in trace.chaos_events] == ["kill_replica"]
+    assert trace.requests[0]["session_id"] == "sess"
+    # replicas do NOT double-record: one request entry per submit
+    assert len(trace.requests) == len(rids)
+    assert all(e.capture is None for e in fleet.replicas.values())
+    fleet.close()
+
+    rc = ReplayClock(dt=1e-3)
+    f2 = FleetEngine(eng, _serving(), replicas=2, clock=rc)
+    rep = ReplayDriver(f2, trace, clock=rc).run()
+    assert "r1" not in f2.replicas
+    assert rep.chaos_applied == 1 and rep.chaos_skipped == []
+    assert rep.parity is True and rep.matched == len(rids)
+    f2.close()
+
+    # the same trace against a SINGLE engine: the kill cannot co-replay
+    # — counted as skipped, the run still completes with parity
+    rc2 = ReplayClock(dt=1e-3)
+    rep2 = ReplayDriver(ds.ServingEngine(eng, _serving(), clock=rc2),
+                        trace, clock=rc2).run()
+    assert rep2.chaos_applied == 0 and len(rep2.chaos_skipped) == 1
+    assert rep2.parity is True
+
+    # fleet replay under drifted sampling: the config_drift note must
+    # come from the REPLICA config (the fleet holds no .cfg of its own)
+    rc3 = ReplayClock(dt=1e-3)
+    f3 = FleetEngine(eng, _serving({"greedy": True}), replicas=2,
+                     clock=rc3)
+    rep3 = ReplayDriver(f3, trace, clock=rc3).run()
+    assert rep3.parity is False
+    assert any("config_drift" in n for n in rep3.notes)
+    f3.close()
+
+
+def test_incident_dir_carries_fleet_traffic_trace(setup, tmp_path):
+    from deepspeed_tpu.serving import FleetEngine
+
+    _, _, _, eng = setup
+    clock = ReplayClock(dt=1e-3)
+    fleet = FleetEngine(
+        eng, _serving({"capture": True, "spans": True,
+                       "flight_dir": str(tmp_path)}),
+        replicas=2, clock=clock)
+    reqs = _reqs(2, seed=5)
+    rids = [fleet.submit(p, mn, seed=sd) for p, mn, sd in reqs]
+    done = set()
+    it = 0
+    while len(done) < len(rids):
+        done |= {r.rid for r in fleet.step()}
+        it += 1
+        assert it < 100_000
+    inc = fleet.dump_incident("drill")
+    assert inc is not None
+    tr = TrafficTrace.read(inc / "fleet" / "traffic_trace.jsonl")
+    assert tr.validate() == []
+    assert len(tr.requests) == 2 and len(tr.results) == 2
+    fleet.close()
+
+
+# ------------------------------------------------------ request-log upgrade
+def test_request_record_v2_upgrades_to_trace(setup):
+    _, _, _, eng = setup
+    clock = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(eng, _serving(), clock=clock)
+    reqs = _reqs(3, seed=4)
+    rids = [srv.submit(p, mn, seed=sd, total_deadline_s=60.0)
+            for p, mn, sd in reqs]
+    done = {}
+    it = 0
+    while len(done) < len(rids):
+        for req in srv.step():
+            done[req.rid] = req
+        it += 1
+        assert it < 100_000
+    rows = [request_record(done[r]) for r in rids]
+    rec = rows[0]
+    assert rec["schema"] == "dstpu.request_record.v2"
+    assert isinstance(rec["prompt"], list) and rec["seed"] >= 700
+    assert rec["total_deadline_s"] == pytest.approx(60.0)
+    assert rec["ttft_deadline_s"] is None
+    # v2 rows + one v1-ish row lacking replay fields → upgrade skips it
+    legacy = {"rid": 99, "status": "ok", "tokens": 4}
+    tr, skipped = trace_from_request_log(rows + [legacy])
+    assert skipped == 1
+    assert len(tr.requests) == len(rows)
+    assert tr.validate() == []
+    assert tr.requests[0]["total_deadline_s"] == pytest.approx(60.0)
+    # no recorded outputs in a request log → the oracle degrades to None
+    rc = ReplayClock(dt=1e-3)
+    rep = ReplayDriver(ds.ServingEngine(eng, _serving(), clock=rc), tr,
+                       clock=rc).run()
+    assert rep.parity is None and rep.replayed == len(tr.requests)
+    srv.close()
+
+
+# ----------------------------------------------------------------- backtest
+def test_advisor_backtest_scores_synthetic_overlap(setup):
+    _, _, _, eng = setup
+    rng = np.random.default_rng(7)
+    sys_p = rng.integers(0, 256, (16,)).astype(np.int32)
+    tr = TrafficTrace()
+    for i in range(8):
+        tail = rng.integers(0, 256, (4,)).astype(np.int32)
+        tr.add_request(rid=i, t_rel=0.01 * i,
+                       prompt=np.concatenate([sys_p, tail]),
+                       max_new=3, seed=800 + i)
+    # 16 shared of 20 tokens, first prompt cold: predicted overlap
+    # (7 * 16) / (8 * 20) = 0.7 exactly on block-aligned prompts
+    bt = advisor_backtest(tr, eng,
+                          {"slots": 2, "max_len": M, "prefill_chunk": 16,
+                           "greedy": True}, page_size=8)
+    ps = bt["levers"]["prefix_sharing"]
+    assert ps["source"] == "workload_estimator"
+    assert ps["predicted"] == pytest.approx(0.7)
+    assert ps["abs_error_pts"] <= 10.0
+    assert bt["baseline"]["prefill_tokens_saved"] == 0
+    assert ps["what_if"]["prefill_tokens_saved"] > 0
+    kv = bt["levers"]["kv_quantization"]
+    assert kv["predicted"] is not None and kv["predicted"] <= 0.5
+    assert kv["achieved"] == pytest.approx(kv["predicted"], rel=0.01)
+    assert bt["trace"]["requests"] == 8
+
+
+# -------------------------------------------------------------- perf ledger
+def _bench_dir(tmp_path, n=5, scale=1.0):
+    d = tmp_path / "benches"
+    d.mkdir(exist_ok=True)
+    for i in range(n):
+        (d / f"FAKE{i}_BENCH.json").write_text(json.dumps({
+            "workload": {"requests": 8},
+            "run": {"wall_s": (2.0 + i) / scale,
+                    "tokens_per_s": 100.0 * (i + 1) * scale,
+                    "ttft_s": {"count": 8, "p50": 0.5 / scale,
+                               "p99": 1.0 / scale},
+                    "verdict": "smoke-pass"},
+        }))
+    return d
+
+
+def test_ledger_direction_inference():
+    assert pl.direction_of("continuous.tokens_per_s") == "up"
+    assert pl.direction_of("run.wall_s") == "down"
+    assert pl.direction_of("continuous.ttft_s.p99") == "down"
+    assert pl.direction_of("continuous.ttft_s.count") is None
+    assert pl.direction_of("workload.requests") is None
+    assert pl.direction_of("paged.prefill_tokens_saved") == "up"
+    assert pl.direction_of("paged.prefill_tokens_paid") == "down"
+    assert pl.direction_of("kv_per_token_bytes") == "down"
+    assert pl.direction_of("goodput_speedup_wall") == "up"
+    assert pl.direction_of("failover.requeued") is None
+
+
+def test_ledger_normalize_skips_non_numeric(tmp_path):
+    d = _bench_dir(tmp_path, n=1)
+    rows = pl.normalize_bench(d / "FAKE0_BENCH.json")
+    assert "run.wall_s" in rows and rows["run.wall_s"][1] == "down"
+    assert "run.verdict" not in rows          # strings skipped
+    torn = d / "TORN_BENCH.json"
+    torn.write_text('{"a": ')
+    assert pl.normalize_bench(torn) == {}     # degrade, never raise
+
+
+def test_ledger_update_and_regression_gate(tmp_path):
+    d = _bench_dir(tmp_path, n=5)
+    out = tmp_path / "PERF_LEDGER.json"
+    led = pl.update_ledger(d, out)
+    assert led["ingested"]["benches"] == 5
+    assert pl.check_regressions(led) == []            # one point: clean
+    led = pl.update_ledger(d, out)                    # same values again
+    assert len(led["runs"]) == 2
+    assert pl.check_regressions(led) == []            # flat: clean
+    # worsen the benches 2x and ingest run 3: the gate trips on every
+    # directed series, worst first
+    _bench_dir(tmp_path, n=5, scale=0.5)
+    led = pl.update_ledger(d, out)
+    regs = pl.check_regressions(led, margin=0.2)
+    assert regs, "2x regression did not trip"
+    assert any(r["series"].endswith("run.tokens_per_s") for r in regs)
+    assert any(r["series"].endswith("run.wall_s") for r in regs)
+    assert all(r["rel_excess"] > 0 for r in regs)
+    # a wide margin swallows it; the margin is the knob
+    assert pl.check_regressions(led, margin=2.0) == []
+    # history bounded — and default run labels stay UNIQUE past the
+    # bound (the label derives from a monotonic counter, not the
+    # trimmed runs list)
+    for _ in range(3):
+        led = pl.update_ledger(d, out, max_points=4)
+    assert all(len(s["points"]) <= 4 for s in led["series"].values())
+    assert len(led["runs"]) <= 4
+    labels = [r["run"] for r in led["runs"]]
+    assert len(set(labels)) == len(labels)
+    assert led["runs"][-1]["run"] == f"r{led['run_seq']}"
+
+
+def test_ledger_cli_gates(tmp_path, capsys):
+    d = _bench_dir(tmp_path, n=5)
+    out = tmp_path / "PERF_LEDGER.json"
+    assert pl.main(["--root", str(d), "--out", str(out)]) == 0
+    _bench_dir(tmp_path, n=5, scale=0.5)              # 2x worse
+    assert pl.main(["--root", str(d), "--out", str(out)]) == 1
+    cap = capsys.readouterr().out
+    assert "regression(s) vs rolling best" in cap
+    # --no-gate reports but exits 0; --check-only does not add a run
+    assert pl.main(["--root", str(d), "--out", str(out),
+                    "--check-only", "--no-gate"]) == 0
+    runs = json.loads(out.read_text())["runs"]
+    assert len(runs) == 2
+
+
+# ------------------------------------------------------------------ doctor
+def test_doctor_replay_and_perf_sections(tmp_path, capsys):
+    d = tmp_path / "monitor"
+    d.mkdir()
+    # clean dir: notes only, no findings from the new sections
+    assert doctor.main(["--dir", str(d)]) == 0
+    # a valid trace + a parity-true report: still clean
+    _synthetic_trace().write(d / "traffic_trace.jsonl")
+    (d / "REPLAY_REPORT.json").write_text(json.dumps(
+        {"parity": True, "requests": 2, "matched": 2, "diverged": [],
+         "chaos_applied": 1}))
+    assert doctor.main(["--dir", str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "[replay]" in out and "PARITY" in out
+    # parity FAILED gates; --no-gate restores report-only
+    (d / "REPLAY_REPORT.json").write_text(json.dumps(
+        {"parity": False, "requests": 2, "matched": 1,
+         "diverged": [{"rid": 1, "first_diff": 0}], "chaos_applied": 0}))
+    assert doctor.main(["--dir", str(d)]) == 1
+    assert doctor.main(["--dir", str(d), "--no-gate"]) == 0
+    capsys.readouterr()
+    # an INVALID trace gates too
+    (d / "REPLAY_REPORT.json").unlink()
+    (d / "traffic_trace.jsonl").write_text(
+        '{"kind": "header", "schema": "dstpu.traffic_trace.v1"}\n'
+        '{"kind": "request", "t_rel": 0.0, "rid": 0, "max_new": 1, '
+        '"seed": 0}\n')                       # no prompt and no gen
+    assert doctor.main(["--dir", str(d)]) == 1
+    (d / "traffic_trace.jsonl").unlink()
+    capsys.readouterr()
+    # [perf]: a ledger with an injected regression gates; clean passes
+    bench = _bench_dir(tmp_path, n=5)
+    out_ledger = d / "PERF_LEDGER.json"
+    led = pl.update_ledger(bench, out_ledger)
+    assert doctor.main(["--dir", str(d)]) == 0
+    sick = copy.deepcopy(led)
+    key = next(k for k, s in sick["series"].items()
+               if s["direction"] == "down")
+    sick["series"][key]["points"].append(
+        ["bad", sick["series"][key]["points"][-1][1] * 3])
+    out_ledger.write_text(json.dumps(sick))
+    assert doctor.main(["--dir", str(d)]) == 1
+    cap = capsys.readouterr().out
+    assert "[perf]" in cap and "REGRESSION" in cap
+    assert doctor.main(["--dir", str(d), "--no-gate"]) == 0
+
+
+# ------------------------------------------------------------- CI smoke
+def test_replay_bench_smoke_gate():
+    """Tier-1 wiring of ``bench_replay.py --smoke``: capture→replay
+    parity (engine + fleet with a recorded kill), divergence-as-data,
+    backtest within ±10 pts, ledger gate trip/clean — deterministic on
+    CPU."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench_replay.py"),
+         "--smoke"], capture_output=True, text=True, timeout=420, env=env,
+        cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "smoke-pass" in out.stdout, out.stdout
