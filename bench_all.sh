@@ -23,6 +23,11 @@ sleep 20
 python bench_decompose.py || { echo "[bench_all] decompose failed"; fails=$((fails+1)); }
 sleep 20
 python bench_act_offload.py || { echo "[bench_all] act-offload failed"; fails=$((fails+1)); }
+sleep 20
+# Communication observatory: exposed-collective anatomy + achieved
+# bus-bandwidth rows into COMMSCOPE_BENCH.json and the newest
+# MULTICHIP_r0*.json (perf_ledger tracks them across PRs).
+python bench_commscope.py || { echo "[bench_all] commscope failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

@@ -95,7 +95,49 @@ def main():
     res["head_xent_fwd_ms"] = round(res["fwd_ms"] - res["trunk_fwd_ms"], 1)
     res["bwd_ms"] = round(res["grad_ms"] - res["fwd_ms"], 1)
     res["opt_ms"] = round(res["step_ms"] - res["grad_ms"], 1)
+    res.update(commscope_columns(engine, batch))
     print(json.dumps(res))
+
+
+def commscope_columns(engine, batch, n_steps=3):
+    """Exposed/overlap collective columns + per-kind achieved GB/s from
+    a short profiler window over the engine's own train step
+    (observability/commscope.py — the T3 decomposition the plain wall
+    deltas above cannot see). Nulls, never a crash, when the backend's
+    profiler yields no device op timeline."""
+    import tempfile
+
+    from deepspeed_tpu.comm.hlo_analysis import collective_summary
+    from deepspeed_tpu.observability.commscope import (CommScope,
+                                                       CommScopeConfig)
+
+    out = {"exposed_comm_frac": None, "overlap_frac": None}
+    try:
+        tdir = tempfile.mkdtemp(prefix="decompose_commscope_")
+        jax.profiler.start_trace(tdir)
+        try:
+            for _ in range(n_steps):
+                engine.train_batch(batch)
+            jax.block_until_ready(engine.state.step)
+        finally:
+            # a failed traced step must not leave the process-wide
+            # profiler session open (the next start_trace would raise)
+            jax.profiler.stop_trace()
+        cs = CommScope(CommScopeConfig(enabled=True),
+                       n_devices=len(jax.devices()))
+        cs.set_collective_bytes(
+            collective_summary(engine._compiled_step(batch)))
+        rep = cs.analyze(tdir, n_steps=n_steps)
+        an = rep["anatomy"]
+        out["exposed_comm_frac"] = an["exposed_comm_frac"]
+        out["overlap_frac"] = an["overlap_frac"]
+        for kind, row in rep["ledger"]["by_kind"].items():
+            if row["busbw_gbps"] is not None:
+                out[f"comm_{kind}_busbw_gbps"] = round(
+                    row["busbw_gbps"], 1)
+    except Exception as e:     # diagnostics must not cost the artifact
+        out["commscope_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+    return out
 
 
 if __name__ == "__main__":

@@ -23,16 +23,27 @@ _DTYPE_BYTES = {
 }
 
 _COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
-                "collective-permute", "collective-broadcast")
+                "collective-permute", "collective-broadcast",
+                "ragged-all-to-all")
 
 # e.g. "s8[8,16,2048]{3,2,1,0}" or "f32[]"
 _SHAPE_RE = re.compile(r"\b([a-z0-9]+)\[([0-9,]*)\]")
 
 
-def _shape_bytes(type_str: str) -> int:
-    """Payload bytes of a result type. Tuple types (async -start ops carry
-    '(operand, result)') count only their largest member to avoid
-    double-counting the aliased operand."""
+def _shape_bytes(type_str: str, variadic: bool = False) -> int:
+    """Payload bytes of a result type.
+
+    Tuple types appear in two distinct spellings and must be counted
+    differently:
+
+    - async ``-start`` ops carry ``(operand, result, ...contexts)`` with
+      the operand aliased into the result — counting only the LARGEST
+      member avoids double-counting the alias (``variadic=False``);
+    - variadic sync collectives (tuple-form ``all-to-all`` over n
+      per-peer arrays, multi-operand ``all-reduce``) return one tuple of
+      n INDEPENDENT payloads — the wire volume is their SUM
+      (``variadic=True``; counting the max here silently undercounted an
+      n-way tuple all-to-all n-fold)."""
     sizes = []
     for dtype, dims in _SHAPE_RE.findall(type_str):
         if dtype not in _DTYPE_BYTES:
@@ -44,7 +55,9 @@ def _shape_bytes(type_str: str) -> int:
         sizes.append(n * _DTYPE_BYTES[dtype])
     if not sizes:
         return 0
-    return max(sizes) if type_str.lstrip().startswith("(") else sum(sizes)
+    if type_str.lstrip().startswith("(") and not variadic:
+        return max(sizes)
+    return sum(sizes)
 
 
 def collective_summary(compiled_or_text: Any) -> dict[str, dict[str, float]]:
@@ -67,12 +80,15 @@ def collective_summary(compiled_or_text: Any) -> dict[str, dict[str, float]]:
         op = m.group(2)
         if op.endswith("-done"):   # async pair: count the -start only
             continue
-        kind = op[:-6] if op.endswith("-start") else op
+        is_start = op.endswith("-start")
+        kind = op[:-6] if is_start else op
         if kind not in _COLLECTIVES:
             continue
         d = out.setdefault(kind, {"count": 0, "mbytes": 0.0})
         d["count"] += 1
-        d["mbytes"] += _shape_bytes(m.group(1)) / 1e6
+        # sync tuple results are variadic payloads (sum); -start tuples
+        # alias the operand into the result (max) — see _shape_bytes
+        d["mbytes"] += _shape_bytes(m.group(1), variadic=not is_start) / 1e6
     return out
 
 

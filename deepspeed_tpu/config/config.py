@@ -211,6 +211,14 @@ class ObservabilityConfig(ConfigModel):
     # Off/absent = zero threads. Engines can also start it explicitly
     # via engine.serve_telemetry(port=0).
     telemetry: dict[str, Any] = Field(default_factory=dict)
+    # Communication observatory (observability/commscope.py —
+    # CommScopeConfig dict): per-step exposed-collective anatomy +
+    # achieved bus-bandwidth ledger over the windowed profiler capture
+    # (trace_steps above), plus cross-host/device straggler detection on
+    # per-step stamps. {"enabled": true, "straggler_mad_k": 4.0, ...}.
+    # Off/absent = engine.commscope is None: zero new programs, zero
+    # added syncs, one `is not None` per step.
+    commscope: dict[str, Any] = Field(default_factory=dict)
 
 
 class CommsLoggerConfig(ConfigModel):

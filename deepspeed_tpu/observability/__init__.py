@@ -2,7 +2,9 @@
 lifecycle spans, flight recorder, Perfetto export, SLO/anomaly
 detection, workload/capacity attribution (traffic analytics, HBM
 ledger, per-program cost census, capacity advisor), machine-readable
-sinks, XLA profiler integration, and the live telemetry plane
+sinks, XLA profiler integration, the communication observatory
+(exposed-collective step anatomy, achieved bus-bandwidth ledger,
+straggler detection — ``commscope.py``), and the live telemetry plane
 (per-engine HTTP ops surface, goodput/badput wall-time ledger, fleet
 scrape aggregator).
 
@@ -14,6 +16,9 @@ See ``docs/OBSERVABILITY.md`` for the metric namespace and runbook, and
 from .capacity import (ProgramCensus, capacity_report, hbm_ledger,
                        kv_cache_bytes, validate_capacity_report,
                        write_capacity_report)
+from .commscope import (CommScope, CommScopeConfig, StragglerDetector,
+                        bandwidth_ledger, classify_op, decompose,
+                        step_anatomy)
 from .expfmt import exposition_from_events, render_exposition
 from .export import (HOP_NAMES, RequestLogSink, hop_trace,
                      merge_fleet_trace, request_record, to_chrome_trace,
@@ -62,6 +67,8 @@ __all__ = [
     "WorkloadAnalyzer", "WorkloadConfig",
     "ProgramCensus", "hbm_ledger", "kv_cache_bytes", "capacity_report",
     "validate_capacity_report", "write_capacity_report",
+    "CommScope", "CommScopeConfig", "StragglerDetector",
+    "bandwidth_ledger", "classify_op", "decompose", "step_anatomy",
     "TraceWindow", "sample_memory",
     "TrafficCapture", "TrafficTrace", "ReplayClock", "ReplayDriver",
     "ReplayReport", "advisor_backtest", "trace_from_request_log",

@@ -369,7 +369,8 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     workload: Optional[dict] = None,
                     occupancy_avg: Optional[float] = None,
                     meta: Optional[dict] = None,
-                    pages: Optional[dict] = None) -> dict:
+                    pages: Optional[dict] = None,
+                    commscope: Optional[dict] = None) -> dict:
     """Compose ledger + census + workload into the ranked what-if advisor.
 
     Every lever's score is the estimated fraction of its bounding
@@ -453,8 +454,12 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
     levers.append({"name": LEVER_KV_QUANT, "score": float(kv_score),
                    "estimate": kv_est, "why": why_kv})
 
-    # Quantized collectives: the step's wire bytes as a share of its HBM
-    # bytes bounds what halving them can buy (EQuARX-style int8 wires).
+    # Quantized/overlapped collectives: projected from the step's wire
+    # bytes as a share of its HBM bytes (EQuARX-style int8 wires) — and
+    # UPGRADED to the measured exposed-collective fraction when the
+    # commscope observatory ran (observability/commscope.py): exposed
+    # time is exactly the wall a T3-style overlap or a quantized wire
+    # can reclaim, so the lever ranks on measured cost, not a proxy.
     coll_score = 0.0
     coll_est: dict[str, Any] = {"collective_byte_share": None}
     step_row = ((census or {}).get("programs") or {}).get("step") or {}
@@ -467,6 +472,24 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     "collective_mbytes_per_step": cb}
         why_coll = ("measured collective bytes as a share of the decode "
                     "step's HBM bytes, halved by int8 wire quantization")
+    cs_an = (commscope or {}).get("anatomy") or {}
+    if cs_an.get("exposed_comm_frac") is not None:
+        coll_score = float(cs_an["exposed_comm_frac"])
+        cs_led = ((commscope or {}).get("ledger") or {}).get("by_kind") \
+            or {}
+        coll_est["measured"] = {
+            "exposed_comm_frac": cs_an.get("exposed_comm_frac"),
+            "overlap_frac": cs_an.get("overlap_frac"),
+            "exposed_collective_s": cs_an.get("exposed_collective_s"),
+            "achieved_busbw_gbps": {k: r.get("busbw_gbps")
+                                    for k, r in cs_led.items()},
+            "roofline_ratio": {k: r.get("roofline_ratio")
+                               for k, r in cs_led.items()},
+        }
+        why_coll = ("MEASURED exposed-collective fraction of the step "
+                    "wall (commscope trace anatomy) — the time "
+                    "overlapping/quantizing collectives can reclaim; "
+                    "achieved bus bandwidth per kind attached")
     levers.append({"name": LEVER_COLLECTIVES, "score": float(coll_score),
                    "estimate": coll_est, "why": why_coll})
 
@@ -492,6 +515,11 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         "ledger": ledger,
         "census": census,
         "pages": pages,
+        # the communication observatory's measured rows (None when it
+        # didn't run — older reports simply lack the key, which the
+        # validator accepts: nulls are the degradation contract, absence
+        # is a pre-commscope artifact)
+        "commscope": commscope,
         "advisor": {"levers": levers,
                     "ranked": [d["name"] for d in levers]},
     }

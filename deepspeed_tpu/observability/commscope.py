@@ -1,0 +1,832 @@
+"""Communication observatory: multichip step anatomy from profiler traces.
+
+The census layer (``observability/capacity.py`` + ``comm/hlo_analysis``)
+counts *static* collective bytes — what a step's program promises to move.
+This module measures what those collectives actually *cost*:
+
+- **Step anatomy** — parse the windowed ``jax.profiler`` capture (the
+  PR-2 :class:`~.xla.TraceWindow` target) into per-device op timelines,
+  classify collective vs compute ops, and tile each step's wall into
+  ``compute + exposed_collective + other`` (T3's headline decomposition:
+  exposed-collective time is the collective interval union MINUS its
+  overlap with concurrent compute — the only part worth optimizing away).
+  The tiling is exact by construction:
+  ``|compute| + |collective \\ compute| + (wall - |compute ∪ collective|)
+  == wall``.
+- **Achieved bus-bandwidth ledger** — join measured per-kind collective
+  wall time against the static per-step bytes from
+  :func:`~..comm.hlo_analysis.collective_totals` into per-kind algorithm
+  bandwidth (bytes moved / time), bus bandwidth (the ring-scaled figure
+  NCCL-style benchmarks report — The Big Send-off's comparison axis), and
+  a roofline ratio against the chip's ICI peak — the collective analog of
+  the decode MBU.
+- **Straggler detection** — per-device step stamps feed a rolling
+  median+MAD skew detector (the ``slo.py`` discipline: relative skew
+  within a step, so a UNIFORM slowdown — bigger batch, thermal throttle
+  on every chip — never flags). Episodes are edge-triggered: one flight
+  why-marker per episode, gauges while it burns, recovery after
+  ``straggler_clear`` clean steps.
+
+Degradation contract (same as ``capacity.py``, pinned by tier-1 tests):
+a backend whose profiler emits no device op timeline (CPU) degrades
+every anatomy/ledger field to ``None`` with ONE warning — never a raise.
+Disabled (the default) builds nothing: the engine holds ``commscope =
+None`` and the hot path pays one ``is not None`` per step; zero new
+programs, zero added syncs (the compile-freeze gates stay green).
+
+Clock discipline: all timestamps flow through the injectable ``clock``
+seam (fake-clock tests), except the profiler's own trace timestamps,
+which live on the profiler clock and are re-based onto the host clock
+only for the merged Perfetto export (affine shift from the recorded
+host-side step windows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import math
+import os
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Optional
+
+from ..utils.logging import warning_once
+from . import spans as S
+
+# Collective kinds the anatomy/ledger report, in a stable row order (the
+# HLO census kinds plus the decode-path psum spelling, which XLA lowers
+# to all-reduce — classify_op folds it in).
+COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
+                    "all-to-all", "ragged-all-to-all",
+                    "collective-permute", "collective-broadcast")
+
+# op-name substring → kind; ordered, first match wins ("reduce-scatter"
+# before "all-reduce": a fused name can mention both, and the scatter is
+# the op doing the moving; "ragged-all-to-all" before "all-to-all" so
+# the ragged MoE op keeps its own kind — the ledger joins trace kinds
+# against the HLO census kinds BY KEY, and the census counts ragged
+# separately).
+_KIND_PATTERNS = (
+    ("reduce-scatter", "reduce-scatter"), ("reduce_scatter", "reduce-scatter"),
+    ("all-reduce", "all-reduce"), ("all_reduce", "all-reduce"),
+    ("allreduce", "all-reduce"), ("psum", "all-reduce"),
+    ("all-gather", "all-gather"), ("all_gather", "all-gather"),
+    ("allgather", "all-gather"),
+    ("ragged-all-to-all", "ragged-all-to-all"),
+    ("ragged_all_to_all", "ragged-all-to-all"),
+    ("all-to-all", "all-to-all"), ("all_to_all", "all-to-all"),
+    ("alltoall", "all-to-all"),
+    ("collective-permute", "collective-permute"),
+    ("collective_permute", "collective-permute"),
+    ("ppermute", "collective-permute"),
+    ("collective-broadcast", "collective-broadcast"),
+)
+
+# Bus-bandwidth scaling per kind: busbw = algbw * factor(n). The NCCL
+# convention (The Big Send-off reports on this axis): an n-way all-reduce
+# moves 2(n-1)/n of the payload per link, gather/scatter/a2a (n-1)/n, a
+# permute is a point-to-point send (factor 1).
+def busbw_factor(kind: str, n: int) -> float:
+    if n <= 1:
+        return 1.0
+    if kind == "all-reduce":
+        return 2.0 * (n - 1) / n
+    if kind in ("reduce-scatter", "all-gather", "all-to-all",
+                "ragged-all-to-all"):
+        return (n - 1) / n
+    return 1.0
+
+
+def classify_op(name: str) -> Optional[str]:
+    """Collective kind of a trace/HLO op name, or None for compute.
+
+    Trace op names carry HLO instruction names (``all-reduce.3``,
+    ``fusion.12``) and sometimes jax primitive spellings (``psum``,
+    ``ppermute``); both vocabularies are mapped. ``-done`` halves of an
+    async pair classify like their ``-start`` (the interval between them
+    IS the collective in flight — the pair renders as two ops but the
+    parser keeps both so overlapped windows stay visible)."""
+    low = name.lower()
+    for pat, kind in _KIND_PATTERNS:
+        if pat in low:
+            return kind
+    return None
+
+
+# ------------------------------------------------------------ interval math
+def merge_intervals(iv: Iterable[tuple]) -> list:
+    """Sorted union of (t0, t1) intervals (degenerate/inverted dropped)."""
+    ivs = sorted((float(a), float(b)) for a, b in iv if b > a)
+    out: list = []
+    for a, b in ivs:
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def total_length(iv: Iterable[tuple]) -> float:
+    return sum(b - a for a, b in iv)
+
+
+def subtract_intervals(a: Iterable[tuple], b: Iterable[tuple]) -> list:
+    """``a - b`` for MERGED interval lists (the exposed-time primitive:
+    collective intervals minus their overlap with concurrent compute)."""
+    a = merge_intervals(a)
+    b = merge_intervals(b)
+    out: list = []
+    j = 0
+    for a0, a1 in a:
+        cur = a0
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < a1:
+            b0, b1 = b[k]
+            if b0 > cur:
+                out.append((cur, b0))
+            cur = max(cur, b1)
+            if cur >= a1:
+                break
+            k += 1
+        if cur < a1:
+            out.append((cur, a1))
+    return out
+
+
+def clip_intervals(iv: Iterable[tuple], t0: float, t1: float) -> list:
+    return [(max(a, t0), min(b, t1)) for a, b in iv
+            if min(b, t1) > max(a, t0)]
+
+
+# ------------------------------------------------------------- trace parsing
+@dataclasses.dataclass
+class OpSpan:
+    """One device op occurrence from the profiler timeline (seconds on
+    the profiler clock). ``kind`` is a collective kind or None
+    (compute)."""
+
+    name: str
+    t0: float
+    t1: float
+    device: str
+    kind: Optional[str] = None
+
+
+def _is_device_pid(process_name: str) -> bool:
+    # jax's trace names accelerator processes "/device:TPU:0" (host
+    # python threads land under "/host:CPU") — only device timelines
+    # carry the XLA op spans the anatomy needs
+    return "/device:" in process_name
+
+
+def parse_trace_events(trace: dict) -> dict[str, list[OpSpan]]:
+    """Chrome-trace JSON (the profiler's ``*.trace.json.gz`` payload, or
+    a hand-built fake) → per-device op timelines in SECONDS.
+
+    Only complete (``X``) events under device-named pids count; host
+    python/runtime tracks are not step work. Returns ``{}`` when the
+    capture holds no device timeline (CPU backend) — the caller's
+    degradation path."""
+    evs = trace.get("traceEvents") or []
+    names: dict = {}
+    for e in evs:
+        if isinstance(e, dict) and e.get("ph") == "M" \
+                and e.get("name") == "process_name":
+            names[e.get("pid")] = str((e.get("args") or {}).get("name", ""))
+    out: dict[str, list[OpSpan]] = {}
+    for e in evs:
+        if not isinstance(e, dict) or e.get("ph") != "X":
+            continue
+        pname = names.get(e.get("pid"), "")
+        if not _is_device_pid(pname):
+            continue
+        try:
+            ts = float(e["ts"]) * 1e-6
+            dur = float(e.get("dur", 0.0)) * 1e-6
+        except (KeyError, TypeError, ValueError):
+            continue
+        if dur <= 0:
+            continue
+        name = str(e.get("name", ""))
+        out.setdefault(pname, []).append(
+            OpSpan(name=name, t0=ts, t1=ts + dur, device=pname,
+                   kind=classify_op(name)))
+    for ops in out.values():
+        ops.sort(key=lambda o: o.t0)
+    return out
+
+
+def find_trace_file(trace_dir) -> Optional[Path]:
+    """Newest ``*.trace.json.gz`` under a ``jax.profiler`` log dir (the
+    TraceWindow target), or None."""
+    pats = (os.path.join(str(trace_dir), "plugins", "profile", "*",
+                         "*.trace.json.gz"),
+            os.path.join(str(trace_dir), "**", "*.trace.json.gz"))
+    cands: list[str] = []
+    for pat in pats:
+        cands = glob.glob(pat, recursive="**" in pat)
+        if cands:
+            break
+    if not cands:
+        return None
+    return Path(max(cands, key=lambda p: (os.path.getmtime(p), p)))
+
+
+def load_trace(source) -> Optional[dict]:
+    """A Chrome-trace dict from a dict / .json / .json.gz / profiler log
+    dir; None when nothing parseable is there."""
+    if isinstance(source, dict):
+        return source
+    p = Path(source)
+    if p.is_dir():
+        f = find_trace_file(p)
+        if f is None:
+            return None
+        p = f
+    try:
+        raw = p.read_bytes()
+        if p.name.endswith(".gz"):
+            raw = gzip.decompress(raw)
+        obj = json.loads(raw.decode("utf-8", errors="replace"))
+    except (OSError, json.JSONDecodeError, gzip.BadGzipFile):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+# ------------------------------------------------------------- step anatomy
+# the per-window row fields, always present (None = unmeasured)
+_ANATOMY_FIELDS = ("wall_s", "compute_s", "collective_s",
+                   "exposed_collective_s", "overlapped_collective_s",
+                   "other_s", "exposed_comm_frac", "overlap_frac")
+
+
+def step_anatomy(ops: Iterable[OpSpan], t0: float, t1: float) -> dict:
+    """Tile ONE device's window ``[t0, t1]`` into compute + exposed
+    collective + other (seconds), plus per-kind rows.
+
+    The invariant callers (and the smoke gate) pin:
+    ``compute_s + exposed_collective_s + other_s == wall_s`` exactly —
+    compute is the compute-interval union, exposed collective is the
+    collective union minus compute, and other is the wall not covered by
+    either union."""
+    wall = t1 - t0
+    comp_iv = merge_intervals(clip_intervals(
+        [(o.t0, o.t1) for o in ops if o.kind is None], t0, t1))
+    by_kind_iv = {k: [] for k in COLLECTIVE_KINDS}
+    for o in ops:
+        if o.kind is not None:
+            by_kind_iv.setdefault(o.kind, []).append((o.t0, o.t1))
+    coll_all = merge_intervals(clip_intervals(
+        [iv for k in by_kind_iv for iv in by_kind_iv[k]], t0, t1))
+    compute_s = total_length(comp_iv)
+    collective_s = total_length(coll_all)
+    exposed_iv = subtract_intervals(coll_all, comp_iv)
+    exposed_s = total_length(exposed_iv)
+    busy = total_length(merge_intervals(comp_iv + coll_all))
+    other_s = max(0.0, wall - busy)
+    kinds = {}
+    for k in COLLECTIVE_KINDS:
+        iv = merge_intervals(clip_intervals(by_kind_iv.get(k, []), t0, t1))
+        if not iv:
+            continue
+        kinds[k] = {
+            "time_s": total_length(iv),
+            "exposed_s": total_length(subtract_intervals(iv, comp_iv)),
+            "count": sum(1 for o in ops if o.kind == k
+                         and min(o.t1, t1) > max(o.t0, t0)),
+        }
+    return {
+        "wall_s": wall, "compute_s": compute_s,
+        "collective_s": collective_s,
+        "exposed_collective_s": exposed_s,
+        "overlapped_collective_s": collective_s - exposed_s,
+        "other_s": other_s,
+        "exposed_comm_frac": (exposed_s / wall) if wall > 0 else None,
+        "overlap_frac": (1.0 - exposed_s / collective_s)
+        if collective_s > 0 else None,
+        "by_kind": kinds,
+        "exposed_intervals": exposed_iv,
+    }
+
+
+def decompose(timelines: dict[str, list[OpSpan]],
+              windows: Optional[list] = None) -> dict:
+    """Anatomy over every device, averaged into one aggregate row.
+
+    ``windows`` is the step-window list (profiler-clock seconds); None =
+    the whole captured extent as one window. Each device's per-window
+    anatomies are summed (a 5-step window reports 5 steps' worth of
+    seconds), then fracs are re-derived from the sums; the aggregate is
+    the device mean — the fleet-of-chips view, with ``per_device``
+    retained for the skew table."""
+    out = {k: None for k in _ANATOMY_FIELDS}
+    out.update({"n_devices": 0, "n_windows": 0, "by_kind": {},
+                "per_device": {}})
+    if not timelines:
+        return out
+    per_dev: dict[str, dict] = {}
+    for dev, ops in timelines.items():
+        if windows is None:
+            w = [(min(o.t0 for o in ops), max(o.t1 for o in ops))] \
+                if ops else []
+        else:
+            w = [(float(a), float(b)) for a, b in windows]
+        rows = [step_anatomy(ops, a, b) for a, b in w]
+        if not rows:
+            continue
+        agg = {f: sum(r[f] for r in rows) for f in _ANATOMY_FIELDS
+               if f not in ("exposed_comm_frac", "overlap_frac")}
+        agg["exposed_comm_frac"] = (agg["exposed_collective_s"]
+                                    / agg["wall_s"]) if agg["wall_s"] else None
+        agg["overlap_frac"] = (1.0 - agg["exposed_collective_s"]
+                               / agg["collective_s"]) \
+            if agg["collective_s"] else None
+        kinds: dict = {}
+        for r in rows:
+            for k, v in r["by_kind"].items():
+                d = kinds.setdefault(k, {"time_s": 0.0, "exposed_s": 0.0,
+                                         "count": 0})
+                for f in d:
+                    d[f] += v[f]
+        agg["by_kind"] = kinds
+        agg["n_windows"] = len(rows)
+        per_dev[dev] = agg
+    if not per_dev:
+        return out
+    n = len(per_dev)
+    for f in _ANATOMY_FIELDS:
+        vals = [d[f] for d in per_dev.values() if d.get(f) is not None]
+        out[f] = (sum(vals) / len(vals)) if vals else None
+    kinds = {}
+    for d in per_dev.values():
+        for k, v in d["by_kind"].items():
+            row = kinds.setdefault(k, {"time_s": 0.0, "exposed_s": 0.0,
+                                       "count": 0})
+            for f in row:
+                row[f] += v[f]
+    # device-mean per kind (each device saw its own copy of the step)
+    for v in kinds.values():
+        v["time_s"] /= n
+        v["exposed_s"] /= n
+        v["count"] = int(round(v["count"] / n))
+    out["by_kind"] = kinds
+    out["n_devices"] = n
+    out["n_windows"] = max(d["n_windows"] for d in per_dev.values())
+    out["per_device"] = per_dev
+    return out
+
+
+# -------------------------------------------------------- bandwidth ledger
+_LEDGER_FIELDS = ("mbytes_per_step", "count_per_step", "time_s_per_step",
+                  "exposed_s_per_step", "algbw_gbps", "busbw_gbps",
+                  "roofline_ratio")
+
+
+def bandwidth_ledger(by_kind_bytes: Optional[dict],
+                     anatomy: Optional[dict], *, n_steps: int = 1,
+                     n_devices: int = 1,
+                     peak_ici_gbps: Optional[float] = None) -> dict:
+    """Per-collective-kind achieved-bandwidth rows.
+
+    ``by_kind_bytes`` is ``collective_totals(...)["by_kind"]`` — the
+    static per-STEP payload ({kind: {count, mbytes}}); ``anatomy`` is a
+    :func:`decompose` aggregate whose ``by_kind`` times cover
+    ``n_steps`` steps. Rows keep the census bytes EXACTLY (the smoke
+    gate pins ledger bytes == ``collective_totals``) and derive:
+
+    - ``algbw_gbps`` — payload bytes / measured wall (algorithm bw);
+    - ``busbw_gbps`` — algbw × the NCCL-convention ring factor for
+      ``n_devices`` participants (the cross-topology comparable);
+    - ``roofline_ratio`` — busbw / the chip's ICI peak (the collective
+      MBU analog), None when the peak is unknown.
+
+    Every field is PRESENT; anything unmeasured is None."""
+    rows: dict[str, dict] = {}
+    n_steps = max(1, int(n_steps))
+    meas = (anatomy or {}).get("by_kind") or {}
+    kinds = sorted(set(by_kind_bytes or {}) | set(meas))
+    for k in kinds:
+        row: dict[str, Any] = {f: None for f in _LEDGER_FIELDS}
+        st = (by_kind_bytes or {}).get(k)
+        if st is not None:
+            row["mbytes_per_step"] = float(st.get("mbytes", 0.0))
+            row["count_per_step"] = int(st.get("count", 0))
+        m = meas.get(k)
+        if m is not None:
+            row["time_s_per_step"] = m["time_s"] / n_steps
+            row["exposed_s_per_step"] = m["exposed_s"] / n_steps
+        if row["mbytes_per_step"] and row["time_s_per_step"]:
+            algbw = row["mbytes_per_step"] * 1e6 / row["time_s_per_step"]
+            row["algbw_gbps"] = algbw / 1e9
+            row["busbw_gbps"] = row["algbw_gbps"] * busbw_factor(
+                k, n_devices)
+            if peak_ici_gbps:
+                row["roofline_ratio"] = row["busbw_gbps"] / peak_ici_gbps
+        rows[k] = row
+    return {"by_kind": rows, "n_devices": n_devices, "n_steps": n_steps,
+            "peak_ici_gbps": peak_ici_gbps}
+
+
+def peak_ici_gbps_for(device=None) -> Optional[float]:
+    """Per-chip aggregate ICI bandwidth (GB/s) for the collective
+    roofline, None when unknown — ledger rows then keep a null ratio
+    (same degradation stance as :func:`~.capacity.roofline_peaks`)."""
+    from ..utils.timer import peak_ici_bw_for
+
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    try:
+        return peak_ici_bw_for(device) / 1e9
+    except ValueError:
+        return None
+
+
+# --------------------------------------------------------------- straggler
+@dataclasses.dataclass
+class CommScopeConfig:
+    """Observatory knobs (``observability.commscope`` config dict).
+
+    All decoding/analysis is host-side; ``enabled`` only controls whether
+    the engine builds the observatory at all (one ``is not None`` per
+    step when off)."""
+
+    enabled: bool = False
+    # straggler detector: a device whose within-step skew exceeds
+    # k * MAD of the cross-device skews (floored at min_skew_s) for
+    # `confirm` consecutive steps opens an episode; `clear` consecutive
+    # clean steps closes it. k = 0 disables detection.
+    straggler_mad_k: float = 4.0
+    straggler_confirm: int = 3
+    straggler_clear: int = 3
+    min_skew_s: float = 1e-3
+    # rolling per-step history kept for the doctor's skew table
+    skew_window: int = 64
+
+    def __post_init__(self):
+        if self.straggler_mad_k < 0:
+            raise ValueError(f"straggler_mad_k must be >= 0, "
+                             f"got {self.straggler_mad_k}")
+        for knob in ("straggler_confirm", "straggler_clear", "skew_window"):
+            if getattr(self, knob) < 1:
+                raise ValueError(f"{knob} must be >= 1, "
+                                 f"got {getattr(self, knob)}")
+        if self.min_skew_s < 0:
+            raise ValueError(f"min_skew_s must be >= 0, "
+                             f"got {self.min_skew_s}")
+
+    @classmethod
+    def from_any(cls, cfg) -> "Optional[CommScopeConfig]":
+        if cfg is None or isinstance(cfg, cls):
+            return cfg
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown commscope config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return math.nan
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class StragglerDetector:
+    """Cross-device step-skew detector (median + MAD, episode-scoped).
+
+    ``observe(step, stamps)`` takes one step's per-device completion
+    stamps ``{device_id: t}`` (any clock — only differences within the
+    step matter, which is exactly why a UNIFORM slowdown can never
+    flag). Skew is each device's stamp minus the step median; a device
+    whose skew exceeds ``k * max(MAD, min_skew_s)`` for ``confirm``
+    consecutive steps opens an episode (returned as ``("open", dev)``),
+    which closes after ``clear`` consecutive clean steps
+    (``("close", dev)``). One episode = one flight marker, however many
+    steps it burns."""
+
+    def __init__(self, k: float = 4.0, confirm: int = 3, clear: int = 3,
+                 min_skew_s: float = 1e-3, window: int = 64):
+        self.k = float(k)
+        self.confirm = int(confirm)
+        self.clear = int(clear)
+        self.min_skew_s = float(min_skew_s)
+        self._suspect: dict[Any, int] = {}    # device -> consecutive hits
+        self._clean: dict[Any, int] = {}      # burning device -> clean run
+        self.burning: set = set()
+        self.episodes = 0
+        self.last_skew: dict[Any, float] = {}
+        self.window = int(window)
+        self._hist: list[dict] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.k > 0
+
+    def observe(self, step: int, stamps: dict) -> list:
+        """One step's stamps → list of ``("open"|"close", device_id,
+        skew_s)`` episode edges (usually empty)."""
+        if not self.enabled or len(stamps) < 3:
+            # skew needs a quorum: with <3 stamps the median IS one of
+            # the samples and MAD is degenerate — single-host training
+            # feeds 1 stamp and detection stays honestly inert
+            return []
+        med = _median(list(stamps.values()))
+        skews = {d: float(t) - med for d, t in stamps.items()}
+        self.last_skew = dict(skews)
+        self._hist.append({"step": int(step), "skew": dict(skews)})
+        if len(self._hist) > self.window:
+            self._hist = self._hist[-self.window:]
+        mad = _median([abs(v) for v in skews.values()])
+        thresh = self.k * max(mad, self.min_skew_s)
+        edges: list = []
+        for dev, skew in skews.items():
+            hit = skew > thresh
+            if hit:
+                self._suspect[dev] = self._suspect.get(dev, 0) + 1
+                self._clean.pop(dev, None)
+                if dev not in self.burning \
+                        and self._suspect[dev] >= self.confirm:
+                    self.burning.add(dev)
+                    self.episodes += 1
+                    edges.append(("open", dev, skew))
+            else:
+                self._suspect.pop(dev, None)
+                if dev in self.burning:
+                    self._clean[dev] = self._clean.get(dev, 0) + 1
+                    if self._clean[dev] >= self.clear:
+                        self.burning.discard(dev)
+                        self._clean.pop(dev, None)
+                        edges.append(("close", dev, skew))
+        return edges
+
+    def skew_table(self) -> dict:
+        """Per-device skew summary for the doctor: last skew plus the
+        rolling mean/max over the window."""
+        devs: dict[Any, dict] = {}
+        for row in self._hist:
+            for d, v in row["skew"].items():
+                e = devs.setdefault(d, {"n": 0, "sum": 0.0, "max": -1e30})
+                e["n"] += 1
+                e["sum"] += v
+                e["max"] = max(e["max"], v)
+        return {str(d): {"last_skew_s": self.last_skew.get(d),
+                         "mean_skew_s": (e["sum"] / e["n"]) if e["n"] else None,
+                         "max_skew_s": e["max"] if e["n"] else None,
+                         "burning": d in self.burning}
+                for d, e in sorted(devs.items(), key=lambda kv: str(kv[0]))}
+
+
+# -------------------------------------------------------------- observatory
+class CommScope:
+    """The per-engine communication observatory.
+
+    Wires the three measurements above to the engine's registry / span
+    ring / flight recorder. All methods are host-side float work; the
+    engine calls:
+
+    - :meth:`on_step` once per train step (host window + this process's
+      stamp; one clock read when the engine didn't already take one);
+    - :meth:`observe_stamps` with cross-host/device stamps when a
+      launcher gathers them (single-process training feeds one stamp and
+      the detector stays inert — the seam is what ships);
+    - :meth:`analyze` after the TraceWindow closes, to parse the capture
+      and produce the anatomy + ledger report.
+    """
+
+    def __init__(self, cfg: Optional[CommScopeConfig] = None, *,
+                 registry=None, spans: Optional[S.SpanRecorder] = None,
+                 flight=None, n_devices: int = 1,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.cfg = cfg if cfg is not None else CommScopeConfig(enabled=True)
+        self.registry = registry
+        self.spans = spans
+        self.flight = flight
+        self.n_devices = int(n_devices)
+        self.clock = clock
+        self.detector = StragglerDetector(
+            self.cfg.straggler_mad_k, self.cfg.straggler_confirm,
+            self.cfg.straggler_clear, self.cfg.min_skew_s,
+            self.cfg.skew_window)
+        # host-clock step windows, kept bounded: the affine rebase for
+        # the merged Perfetto export + per-step normalization (traced =
+        # the subset that ran inside the profiler TraceWindow)
+        self._step_windows: list[tuple[int, float, float]] = []
+        self._traced_windows: list[tuple[int, float, float]] = []
+        self._by_kind_bytes: Optional[dict] = None
+        self._last_report: Optional[dict] = None
+
+    # ------------------------------------------------------------- recording
+    def on_step(self, step: int, t0: float, t1: float,
+                traced: bool = False) -> None:
+        """One train step's host-clock window. ``traced=True`` marks a
+        step that ran INSIDE the profiler TraceWindow — the Perfetto
+        rebase anchors the capture's first op to the first TRACED
+        window's start (anchoring to the first recorded window of any
+        kind would shift comm spans earlier by however many pre-window
+        steps were stamped)."""
+        self._step_windows.append((int(step), float(t0), float(t1)))
+        if len(self._step_windows) > 4096:
+            self._step_windows = self._step_windows[-4096:]
+        if traced:
+            self._traced_windows.append((int(step), float(t0), float(t1)))
+            if len(self._traced_windows) > 4096:
+                self._traced_windows = self._traced_windows[-4096:]
+
+    def observe_stamps(self, step: int, stamps: dict) -> list:
+        """Cross-host/device per-step stamps → straggler detection.
+        Returns the episode edges; emits gauges, counters, and ONE
+        flight why-marker per opened episode."""
+        edges = self.detector.observe(step, stamps)
+        r = self.registry
+        if r is not None and self.detector.last_skew:
+            worst = max(self.detector.last_skew.values())
+            r.set_gauges({
+                "Train/straggler_active":
+                    1.0 if self.detector.burning else 0.0,
+                "Train/straggler_skew_s": worst,
+            })
+            for d, v in self.detector.last_skew.items():
+                r.gauge(f"Train/straggler_skew_s_d{d}").set(v)
+        for kind, dev, skew in edges:
+            if kind == "open":
+                if r is not None:
+                    r.counter("Train/straggler_episodes").inc()
+                    r.gauge("Train/straggler_device").set(
+                        float(dev) if isinstance(dev, (int, float))
+                        else -1.0)
+                if self.flight is not None:
+                    # once per EPISODE by construction: edges only fire
+                    # on the open transition
+                    self.flight.note("straggler", device=str(dev),
+                                     skew_s=round(float(skew), 6),
+                                     step=int(step))
+            elif kind == "close" and r is not None:
+                r.gauge("Train/straggler_device").set(-1.0)
+        return edges
+
+    def set_collective_bytes(self, by_kind: Optional[dict]) -> None:
+        """Static per-step collective payload
+        (``collective_totals(...)["by_kind"]``) for the ledger join."""
+        self._by_kind_bytes = dict(by_kind) if by_kind else None
+
+    # -------------------------------------------------------------- analysis
+    def analyze(self, trace_source, *, n_steps: Optional[int] = None,
+                windows: Optional[list] = None,
+                peak_ici_gbps: Optional[float] = None,
+                emit_spans: bool = True) -> dict:
+        """Parse a profiler capture and produce the observatory report:
+        ``{anatomy, ledger, straggler, trace}``.
+
+        ``trace_source`` is a trace dict / file / profiler log dir;
+        ``windows`` optionally lists per-step (t0, t1) windows on the
+        PROFILER clock (None = the captured extent as one window;
+        ``n_steps`` then normalizes the ledger's per-step figures). A
+        missing or device-less capture (CPU backend) degrades every
+        anatomy/ledger value to None with one warning — never a
+        raise."""
+        trace = load_trace(trace_source)
+        timelines = parse_trace_events(trace) if trace is not None else {}
+        if not timelines:
+            warning_once(
+                "commscope: no device op timeline in the profiler capture "
+                "(CPU backend, or no trace taken) — anatomy and "
+                "achieved-bandwidth rows degrade to null values")
+        anatomy = decompose(timelines, windows=windows)
+        steps = n_steps if n_steps is not None else \
+            (len(windows) if windows else
+             (anatomy.get("n_windows") or 1))
+        if peak_ici_gbps is None:
+            peak_ici_gbps = self._peak_ici()
+        ledger = bandwidth_ledger(
+            self._by_kind_bytes, anatomy if timelines else None,
+            n_steps=steps, n_devices=max(self.n_devices,
+                                         anatomy.get("n_devices") or 1),
+            peak_ici_gbps=peak_ici_gbps)
+        report = {
+            "anatomy": {k: anatomy.get(k) for k in
+                        _ANATOMY_FIELDS + ("n_devices", "n_windows",
+                                           "by_kind")},
+            "ledger": ledger,
+            "straggler": {
+                "episodes": self.detector.episodes,
+                "burning": sorted(str(d) for d in self.detector.burning),
+                "skew_table": self.detector.skew_table(),
+            },
+            "trace": {"devices": sorted(timelines),
+                      "ops": sum(len(v) for v in timelines.values())},
+        }
+        self._last_report = report
+        self._emit_gauges(report)
+        if emit_spans and timelines:
+            self._emit_comm_spans(timelines, anatomy)
+        return report
+
+    def _peak_ici(self) -> Optional[float]:
+        try:
+            return peak_ici_gbps_for()
+        except Exception:  # no jax/device in pure-host tests
+            return None
+
+    def _emit_gauges(self, report: dict) -> None:
+        r = self.registry
+        if r is None:
+            return
+        an = report["anatomy"]
+        gauges: dict[str, float] = {}
+        for key, name in (("exposed_comm_frac", "Comm/exposed_frac"),
+                          ("overlap_frac", "Comm/overlap_frac"),
+                          ("exposed_collective_s", "Comm/exposed_s"),
+                          ("collective_s", "Comm/collective_s")):
+            v = an.get(key)
+            if v is not None:
+                gauges[name] = float(v)
+        for k, row in report["ledger"]["by_kind"].items():
+            for f, suffix in (("algbw_gbps", "algbw_gbps"),
+                              ("busbw_gbps", "busbw_gbps"),
+                              ("roofline_ratio", "roofline")):
+                if row.get(f) is not None:
+                    gauges[f"Comm/{k}/{suffix}"] = float(row[f])
+        if gauges:
+            r.set_gauges(gauges)
+
+    # ------------------------------------------------------- perfetto export
+    def _rebase(self) -> Optional[tuple]:
+        """Affine profiler→host clock map: the capture's first op lands
+        at the first TRACED step window's start (falling back to the
+        first recorded window when no step was marked traced — ad-hoc
+        captures outside a TraceWindow). None when no windows were
+        recorded (offline parse — spans then keep the profiler
+        clock)."""
+        windows = self._traced_windows or self._step_windows
+        if not windows:
+            return None
+        h0 = min(t0 for _, t0, _ in windows)
+        return (1.0, h0)
+
+    def _emit_comm_spans(self, timelines: dict,
+                         anatomy: dict) -> None:
+        """Collective ops + exposed gaps → ``comm_op``/``comm_exposed``
+        spans in the engine ring, re-based onto the host clock so the
+        merged Perfetto trace shows them beside the train_step track."""
+        if self.spans is None:
+            return
+        rebase = self._rebase()
+        dev0 = sorted(timelines)[0]
+        ops = timelines[dev0]
+        if not ops:
+            return
+        p0 = min(o.t0 for o in ops)
+
+        def to_host(t: float) -> float:
+            if rebase is None:
+                return t
+            scale, h0 = rebase
+            return h0 + scale * (t - p0)
+
+        for o in ops:
+            if o.kind is None:
+                continue
+            # meta key is "collective", not "kind" — emit()'s first
+            # positional is the span kind and **meta must not collide
+            self.spans.emit(S.COMM_OP, to_host(o.t0), to_host(o.t1),
+                            collective=o.kind, op=o.name,
+                            device=o.device)
+        per_dev = anatomy.get("per_device") or {}
+        # exposed gaps for the rendered device (re-derive on its merged
+        # timeline: decompose() keeps sums, not intervals, per device)
+        if dev0 in per_dev:
+            w0 = min(o.t0 for o in ops)
+            w1 = max(o.t1 for o in ops)
+            row = step_anatomy(ops, w0, w1)
+            for a, b in row["exposed_intervals"]:
+                self.spans.emit(S.COMM_EXPOSED, to_host(a), to_host(b),
+                                device=dev0)
+
+    # --------------------------------------------------------------- readout
+    def report(self) -> Optional[dict]:
+        """The last :meth:`analyze` result (None before the first)."""
+        return self._last_report
+
+    def snapshot(self) -> dict:
+        """Flight-recorder snapshot provider: the straggler state plus
+        the last analysis (if any)."""
+        return {
+            "straggler": {
+                "episodes": self.detector.episodes,
+                "burning": sorted(str(d) for d in self.detector.burning),
+                "skew_table": self.detector.skew_table(),
+            },
+            "last_report": self._last_report,
+            "step_windows": len(self._step_windows),
+        }

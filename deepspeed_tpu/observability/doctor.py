@@ -21,7 +21,11 @@ running engine, no device):
   (``REPLAY_REPORT*.json`` — ``observability/replay.py``);
 - ``[perf]`` — the cross-PR perf ledger (``PERF_LEDGER.json``,
   ``observability/perf_ledger.py``): trajectory summary and the
-  regression gate vs each series' rolling best.
+  regression gate vs each series' rolling best;
+- ``[comm]`` — the communication observatory
+  (``observability/commscope.py``): exposed/overlap collective
+  fractions, per-kind achieved bus bandwidth, and the per-device skew
+  table, from the latest .prom; a BURNING straggler gauge gates.
 
 Exit code is the CI/cron gate: **nonzero** when the newest flight record
 contains a why-marker (watchdog stall, SLO breach, anomaly, compile
@@ -30,8 +34,9 @@ storm — something fired since the record was cut), when any
 the newest incident dir is UNRECONCILED (per-replica dumps from fewer
 replicas than the fleet had live — the post-mortem is incomplete), when
 the newest traffic trace is invalid or the last replay verdict is a
-parity FAILURE, or when the perf ledger holds a series worse than its
-rolling best beyond the margin; 0 on a clean replica. ``--no-gate``
+parity FAILURE, when the perf ledger holds a series worse than its
+rolling best beyond the margin, or when a straggler gauge is burning
+(``dstpu_train_straggler_active`` > 0); 0 on a clean replica. ``--no-gate``
 restores the always-0 report-only behavior. ``--targets`` combined with
 ``--flight-dir`` runs the incident gate alongside fleet triage.
 
@@ -424,6 +429,59 @@ def report_capacity(d: Path, levers: int = 4) -> None:
               f"score={score}  {lv.get('why') or ''}")
 
 
+def report_comm(d: Path) -> list:
+    """Print the ``[comm]`` picture from the latest .prom — the
+    communication observatory's gauges (``observability/commscope.py``):
+    exposed/overlap fractions, per-kind achieved bus bandwidth, and the
+    per-device skew table. Gate finding: a BURNING straggler gauge
+    (``dstpu_train_straggler_active`` > 0 — a device is currently
+    dragging every step; docs/OPERATIONS.md "diagnosing a slow multichip
+    step")."""
+    from .sinks import parse_prometheus_textfile
+
+    prom = _newest(d, "*.prom")
+    if prom is None:
+        return []
+    vals = parse_prometheus_textfile(prom.read_text())
+    comm = {k: v for k, v in vals.items() if k.startswith("dstpu_comm_")}
+    strag = {k: v for k, v in vals.items()
+             if k.startswith("dstpu_train_straggler_")}
+    if not comm and not strag:
+        return []          # no observatory ran: no section, no gate
+    print(f"[comm] {prom.name}")
+    for key, label in (("dstpu_comm_exposed_frac", "exposed_comm_frac"),
+                       ("dstpu_comm_overlap_frac", "overlap_frac"),
+                       ("dstpu_comm_exposed_s", "exposed_s"),
+                       ("dstpu_comm_collective_s", "collective_s")):
+        if key in comm:
+            print(f"  {label:<24s} {_fmt(comm[key])}")
+    for k in sorted(comm):
+        if k.endswith(("_busbw_gbps", "_algbw_gbps", "_roofline")):
+            print(f"  {k.replace('dstpu_comm_', ''):<34s} {_fmt(comm[k])}")
+    findings: list = []
+    active = strag.get("dstpu_train_straggler_active")
+    skews = sorted((k, v) for k, v in strag.items()
+                   if "_skew_s_d" in k)
+    if skews:
+        print("  per-device skew (s):")
+        for k, v in skews:
+            dev = k.rsplit("_d", 1)[-1]
+            print(f"    device {dev:<6s} {_fmt(v)}")
+    if isinstance(active, float) and active > 0:
+        dev = strag.get("dstpu_train_straggler_device")
+        worst = strag.get("dstpu_train_straggler_skew_s")
+        print(f"  STRAGGLER burning: device={_fmt(dev) if dev is not None else '?'} "
+              f"skew={_fmt(worst) if worst is not None else '?'}s")
+        findings.append(
+            "straggler gauge burning in " + prom.name
+            + (f": device {_fmt(dev)}" if dev is not None else "")
+            + (f" skew {_fmt(worst)}s" if worst is not None else ""))
+    eps = strag.get("dstpu_train_straggler_episodes")
+    if eps:
+        print(f"  straggler episodes (lifetime): {_fmt(eps)}")
+    return findings
+
+
 # ----------------------------------------------------------- live (--url)
 def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
     """(status, body) for a GET; (None, error-repr) when the target is
@@ -637,6 +695,7 @@ def main(argv=None) -> int:
         findings += report_flight(fdir)
         findings += report_incidents(fdir)
         report_capacity(d)
+        findings += report_comm(d)
         findings += report_replay([d] if fdir == d else [d, fdir])
         ledger = Path(args.ledger) if args.ledger \
             else d / "PERF_LEDGER.json"

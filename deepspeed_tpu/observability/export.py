@@ -65,6 +65,13 @@ _TID_SLOT0 = 10
 
 _TRAIN_TIDS = {"train_step": 1}   # phases allocate 2.. in first-seen order
 
+# the communication observatory's tracks (observability/commscope.py),
+# fixed high so dynamically-allocated phase tids can never collide:
+# collective ops in flight, and the exposed gaps (collective time NOT
+# hidden behind compute — the T3 number, visible as a track)
+_TID_COMM = 98
+_TID_COMM_EXPOSED = 99
+
 
 def _sec_to_us(t: float, origin: float) -> float:
     return max(0.0, (t - origin) * 1e6)
@@ -154,6 +161,13 @@ def to_chrome_trace(events: Iterable[S.SpanEvent],
             phase = e.meta.get("phase", "phase")
             tid = train_tids.setdefault(phase, len(train_tids) + 1)
             add(PID_TRAIN, tid, "X", phase, ts, dur or 0.0, args)
+        elif e.kind == S.COMM_OP:
+            add(PID_TRAIN, _TID_COMM, "X",
+                str(e.meta.get("collective", "collective")), ts,
+                dur or 0.0, args)
+        elif e.kind == S.COMM_EXPOSED:
+            add(PID_TRAIN, _TID_COMM_EXPOSED, "X", "exposed", ts,
+                dur or 0.0, args)
         else:   # unknown kind: keep it visible rather than dropping it
             add(PID_SERVING, _TID_MARKERS, "i", f"event:{e.kind}", ts,
                 None, args)
@@ -185,6 +199,10 @@ def to_chrome_trace(events: Iterable[S.SpanEvent],
         for phase, tid in train_tids.items():
             if tid in used_tids[PID_TRAIN]:
                 thread_meta(PID_TRAIN, tid, phase)
+        for tid, nm in ((_TID_COMM, "comm"),
+                        (_TID_COMM_EXPOSED, "comm-exposed")):
+            if tid in used_tids[PID_TRAIN]:
+                thread_meta(PID_TRAIN, tid, nm)
     return {"traceEvents": meta + out, "displayTimeUnit": "ms",
             "otherData": {"job": job_name}}
 

@@ -56,6 +56,12 @@ HANDOFF_EXPORT = "handoff_export"  # span: prefill pages gathered to host
 HANDOFF_PENDING = "handoff_pending"  # span: payload host-held, waiting
                                    # for a decode slot/pool
 HANDOFF_IMPORT = "handoff_import"  # span: scatter into the decode replica
+# Communication observatory (observability/commscope.py — rendered as a
+# `comm` track beside the train pid in the Perfetto export):
+COMM_OP = "comm_op"                # span: one collective op in flight
+                                   # (meta: kind, op, device)
+COMM_EXPOSED = "comm_exposed"      # span: an exposed gap — collective
+                                   # time NOT hidden behind compute
 # Cross-cutting:
 MARKER = "marker"                  # instant: SLO burn, anomaly, watchdog,
                                    # compile storm — the "why" of a dump

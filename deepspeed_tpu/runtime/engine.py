@@ -192,7 +192,13 @@ class Engine:
 
             _cl.enabled = True
             _cl.verbose = self.config.comms_logger.verbose
-        self._comms_logged = not self.config.comms_logger.enabled
+        # the one-shot HLO collective census runs for the comms logger's
+        # summary AND for the commscope observatory's static-bytes side
+        # of the achieved-bandwidth ledger (observability/commscope.py)
+        _cs_cfg = self.config.observability.commscope
+        self._comms_logged = not (self.config.comms_logger.enabled
+                                  or bool(_cs_cfg
+                                          and _cs_cfg.get("enabled")))
         if self._ltd is not None:
             from ..data_pipeline.random_ltd import convert_to_random_ltd
 
@@ -556,6 +562,24 @@ class Engine:
                     "knobs — the training engine only wires "
                     "step_time_mad_k; set them under the serving "
                     "config's `slo` block instead", level="WARNING")
+        # communication observatory (observability/commscope.py):
+        # per-step exposed-collective anatomy + achieved-bandwidth
+        # ledger over the TraceWindow capture, plus straggler detection
+        # on per-step stamps. None (default) = one `is not None` per
+        # step, zero new programs/syncs.
+        self.commscope = None
+        self._hlo_by_kind = None
+        if obs.commscope and obs.commscope.get("enabled"):
+            from ..observability.commscope import (CommScope,
+                                                   CommScopeConfig)
+
+            self.commscope = CommScope(
+                CommScopeConfig.from_any(obs.commscope),
+                registry=self.metrics, spans=self.spans,
+                flight=self.flight, n_devices=len(jax.devices()))
+            if self.flight is not None:
+                self.flight.add_snapshot_provider(
+                    "commscope", self.commscope.snapshot)
         # goodput/badput wall-time ledger (observability/goodput.py):
         # Train/goodput_* decomposition of step dispatch vs compile /
         # inter-step idle / checkpoint / preemption. None (default) =
@@ -894,6 +918,14 @@ class Engine:
                             step=self.global_steps, phase="bwd")
             self.spans.emit(TRAIN_PHASE, t1, t2, step=self.global_steps,
                             phase="host_step")
+        if self.commscope is not None:
+            t2 = t1 + t_host
+            self.commscope.on_step(
+                self.global_steps, t0, t2,
+                traced=(self._trace_window is not None
+                        and self._trace_window.active))
+            self.commscope.observe_stamps(self.global_steps,
+                                          {jax.process_index(): t2})
         out = {"loss": float(metrics["loss"]), "grad_norm": gnorm, "lr": lr,
                "loss_scale": float(scale), "skipped": 0 if finite else 1,
                "bwd_s": t_bwd, "host_step_s": t_host}
@@ -1358,6 +1390,57 @@ class Engine:
             census.attach_spans(self.spans.events())
         return census.report()
 
+    def observe_device_stamps(self, step: int, stamps: dict) -> list:
+        """Cross-host/device per-step completion stamps → the commscope
+        straggler detector (observability/commscope.py). The seam a
+        multi-host launcher feeds after gathering each process's stamp;
+        single-process training feeds its own automatically. No-op
+        (returns []) when the observatory is off."""
+        if self.commscope is None:
+            return []
+        return self.commscope.observe_stamps(step, stamps)
+
+    def comm_observatory(self, trace_source=None,
+                         n_steps: Optional[int] = None,
+                         path: Optional[str] = None) -> dict:
+        """The communication observatory report: step anatomy (exposed
+        vs overlapped collective time), the per-kind achieved
+        bus-bandwidth ledger (static HLO bytes / measured trace wall),
+        and the straggler snapshot — docs/OBSERVABILITY.md
+        "Communication observatory".
+
+        ``trace_source`` defaults to ``observability.trace_dir`` (the
+        TraceWindow target); ``n_steps`` defaults to the configured
+        ``trace_steps`` window length. On a backend whose profiler
+        emits no device op timeline (CPU) every anatomy/ledger row
+        degrades to nulls with one warning — never a raise."""
+        if self.commscope is None:
+            raise RuntimeError(
+                "observability.commscope is not enabled — set "
+                'observability.commscope={"enabled": true} (and '
+                "trace_steps for the profiler window) to build the "
+                "observatory")
+        obs = self.config.observability
+        if trace_source is None:
+            trace_source = obs.trace_dir
+        if self._hlo_by_kind is not None:
+            self.commscope.set_collective_bytes(self._hlo_by_kind)
+        if n_steps is None and obs.trace_steps:
+            a, b = (int(s) for s in obs.trace_steps)
+            n_steps = b - a + 1
+        report = self.commscope.analyze(trace_source, n_steps=n_steps)
+        if path:
+            import json
+            from pathlib import Path as _Path
+
+            p = _Path(path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            tmp = p.with_name(p.name + ".tmp")
+            tmp.write_text(json.dumps(report, indent=2, default=str),
+                           encoding="utf-8")
+            os.replace(tmp, p)
+        return report
+
     # ----------------------------------------------------------- resilience
     def _note_bad_steps(self, bad: bool, window: int, last_loss: float) -> None:
         """Non-finite sentinel: ``bad`` covers ``window`` consecutive
@@ -1593,7 +1676,13 @@ class Engine:
         if self.offload:
             return self._train_batch_offload(batch)
         wcb = self.config.wall_clock_breakdown
-        t_step0 = self.spans.clock() if self.spans is not None else 0.0
+        # one shared step-window clock for spans AND the comm
+        # observatory (commscope reuses the spans clock when both are
+        # on, so their windows agree to the exact float)
+        _step_clk = (self.spans.clock if self.spans is not None else
+                     (self.commscope.clock if self.commscope is not None
+                      else None))
+        t_step0 = _step_clk() if _step_clk is not None else 0.0
         self.throughput.start()
         if wcb:
             self.timers.start("batch_prep")
@@ -1682,18 +1771,37 @@ class Engine:
                 self._emit_monitor_events(extra)
         else:
             self.throughput.stop(report=False)
-        if self.spans is not None:
-            self.spans.emit(TRAIN_STEP, t_step0, self.spans.clock(),
-                            step=self.global_steps)
-            if wcb:
-                # re-emit the wall-clock-breakdown timer windows as phase
-                # spans (last completed interval per timer; no new clocks)
-                for name in ("batch_prep", "step_dispatch", "step_sync"):
-                    tm = self.timers(name)
-                    if tm.last_stop > 0:
-                        self.spans.emit(TRAIN_PHASE, tm.last_start,
-                                        tm.last_stop,
-                                        step=self.global_steps, phase=name)
+        if _step_clk is not None:
+            t_step1 = _step_clk()
+            if self.spans is not None:
+                self.spans.emit(TRAIN_STEP, t_step0, t_step1,
+                                step=self.global_steps)
+                if wcb:
+                    # re-emit the wall-clock-breakdown timer windows as
+                    # phase spans (last completed interval per timer; no
+                    # new clocks)
+                    for name in ("batch_prep", "step_dispatch",
+                                 "step_sync"):
+                        tm = self.timers(name)
+                        if tm.last_stop > 0:
+                            self.spans.emit(TRAIN_PHASE, tm.last_start,
+                                            tm.last_stop,
+                                            step=self.global_steps,
+                                            phase=name)
+            if self.commscope is not None:
+                # per-step host window + this process's completion stamp
+                # (multi-host launchers gather and feed cross-host stamps
+                # through observe_device_stamps; a lone process's single
+                # stamp leaves the straggler detector honestly inert).
+                # traced= marks steps inside the TraceWindow so the
+                # Perfetto rebase anchors the capture to THEM, not to
+                # whatever pre-window steps were also stamped
+                self.commscope.on_step(
+                    self.global_steps, t_step0, t_step1,
+                    traced=(self._trace_window is not None
+                            and self._trace_window.active))
+                self.commscope.observe_stamps(
+                    self.global_steps, {jax.process_index(): t_step1})
         # Profiler fires OUTSIDE the throughput window (its extra timed step
         # + one-time AOT compile must not pollute samples/s accounting).
         if self.flops_profiler and self.flops_profiler.should_fire():
@@ -1707,14 +1815,21 @@ class Engine:
             # the only place the inserted collectives exist).
             self._comms_logged = True
             try:
-                from ..comm.comm import comms_logger as _cl
                 from ..comm.hlo_analysis import collective_summary
 
                 with self.mesh:
                     compiled = self._train_step.lower(
                         self.state, batch, max(0, self._ltd_tokens),
                         comp_active, warm).compile()
-                for key, d in sorted(collective_summary(compiled).items()):
+                summ = collective_summary(compiled)
+                # static per-step wire bytes by kind: kept for the
+                # commscope ledger join (comm_observatory) — the
+                # achieved-bandwidth denominator comes from the trace,
+                # the numerator from here
+                self._hlo_by_kind = summ
+                if self.commscope is not None:
+                    self.commscope.set_collective_bytes(summ)
+                for key, d in sorted(summ.items()):
                     log_dist(f"comms | HLO {key}: n={int(d['count'])} "
                              f"vol={d['mbytes']:.1f} MB", ranks=[0])
                     # collective census → Comm/* gauges: per-step wire
@@ -1722,10 +1837,13 @@ class Engine:
                     self.metrics.set_gauges({
                         f"Comm/hlo/{key}/count": d["count"],
                         f"Comm/hlo/{key}/mbytes": d["mbytes"]})
-                for name, value, _ in _cl.as_monitor_events(
-                        self.global_steps):
-                    self.metrics.gauge(name).set(value)
-                _cl.log_summary()
+                if self.config.comms_logger.enabled:
+                    from ..comm.comm import comms_logger as _cl
+
+                    for name, value, _ in _cl.as_monitor_events(
+                            self.global_steps):
+                        self.metrics.gauge(name).set(value)
+                    _cl.log_summary()
                 # no emit here: the Comm/* gauges ride the next report
                 # boundary's flush (an emit now would duplicate this
                 # step's Train/* rows in every sink)

@@ -1076,13 +1076,18 @@ class ServingEngine:
             cache_dtype=self.engine.compute_dtype, temp_bytes=temp_bytes,
             registry=self.stats.registry, **paged_kw)
 
-    def capacity_report(self, path=None, census: bool = True) -> dict:
+    def capacity_report(self, path=None, census: bool = True,
+                        commscope=None) -> dict:
         """The capacity advisor: workload analytics + HBM ledger + program
         census composed into ranked what-if estimates on the observed
         traffic (``CAPACITY_REPORT.json`` when ``path`` is given; see
         docs/OPERATIONS.md capacity-planning runbook). ``census=False``
         skips the AOT lowering pass (cheaper; advisor loses the
-        collective-byte lever's input)."""
+        collective-byte lever's input). ``commscope`` optionally carries
+        a communication-observatory report (``Engine.comm_observatory``
+        / ``observability/commscope.py``) — the quantize/overlap
+        collectives lever then ranks on MEASURED exposed time instead of
+        the byte-share projection."""
         import math as _math
 
         from ..observability.capacity import (capacity_report,
@@ -1103,6 +1108,7 @@ class ServingEngine:
         wl = self.workload.snapshot() if self.workload is not None else None
         rep = capacity_report(
             ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
+            commscope=commscope,
             pages=self.pool.snapshot() if self._paged else None,
             meta={"job": "serving", "slots": self.cfg.slots,
                   "max_len": self.cfg.max_len,

@@ -209,3 +209,30 @@ def peak_flops_for(device) -> float:
     Override: ``DSTPU_PEAK_FLOPS``."""
     return _peak_lookup(device, PEAK_FLOPS_BY_PLATFORM,
                         "DSTPU_PEAK_FLOPS", "peak FLOPs")
+
+
+# Aggregate per-chip interconnect (ICI) bandwidth (bytes/s, all links),
+# for the collective bus-bandwidth roofline (observability/commscope.py:
+# achieved busbw / this peak is the collective analog of the decode MBU).
+# Published aggregates: v4 six 50 GB/s links, v5e four 50 GB/s (1600
+# Gbps), v5p 600 GB/s (4800 Gbps), Trillium ~448 GB/s (3584 Gbps).
+PEAK_ICI_BW_BY_PLATFORM = {
+    "tpu": {
+        "v4": 300e9,
+        "v5 lite": 200e9,   # v5e
+        "v5": 600e9,        # v5p
+        "v6 lite": 448e9,   # trillium
+    },
+    # CPU "interconnect" is host memory; GPU default is NVLink-class.
+    "cpu": {"default": 10e9},
+    "gpu": {"default": 900e9},
+}
+
+
+def peak_ici_bw_for(device) -> float:
+    """Per-chip aggregate ICI bandwidth (bytes/s) for the collective
+    roofline. Override: ``DSTPU_PEAK_ICI_BW``. Raises ValueError on an
+    unknown TPU generation like the other peaks — commscope catches it
+    and degrades the roofline ratio to null."""
+    return _peak_lookup(device, PEAK_ICI_BW_BY_PLATFORM,
+                        "DSTPU_PEAK_ICI_BW", "ICI bandwidth")
