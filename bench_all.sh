@@ -28,6 +28,12 @@ sleep 20
 # bus-bandwidth rows into COMMSCOPE_BENCH.json and the newest
 # MULTICHIP_r0*.json (perf_ledger tracks them across PRs).
 python bench_commscope.py || { echo "[bench_all] commscope failed"; fails=$((fails+1)); }
+sleep 20
+# KV residency observatory: forced-eviction regret exactness, session
+# heat, and the measured tiered_kv advisor row into
+# KV_RESIDENCY_BENCH.json (perf_ledger tracks regret/resume-TTFT
+# trajectories across PRs — the host-tier PR lands against them).
+python bench_kv_residency.py || { echo "[bench_all] kv residency failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

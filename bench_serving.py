@@ -69,12 +69,17 @@ def make_multiturn_plan(sessions=4, turns=3, seed=0, vocab=256,
             "users": users, "max_new": new}
 
 
-def run_multiturn(srv, plan, max_iterations=200_000):
+def run_multiturn(srv, plan, max_iterations=200_000, ttfts=None):
     """Drive a session plan through a ServingEngine: turn t+1 submits
     only after turn t retires (its reply is part of the next prompt).
     Returns (prompts in admission order, outputs keyed (session, turn))
     — the prompt list feeds the PR-6 workload estimator for the
-    predicted-vs-achieved savings comparison."""
+    predicted-vs-achieved savings comparison. Each submit carries its
+    session id, so the kvscope residency observatory (and fleet
+    affinity) see the session structure. Pass a dict as ``ttfts`` to
+    additionally collect per-(session, turn) TTFT — turn 0 is the cold
+    prefill, turns >= 1 are RESUMES: the per-turn resume-TTFT series the
+    perf ledger tracks against the coming host-tier PR."""
     sessions, turns = plan["sessions"], plan["turns"]
     hist = {s: plan["sys"] for s in range(sessions)}
     turn = {s: 0 for s in range(sessions)}
@@ -84,7 +89,7 @@ def run_multiturn(srv, plan, max_iterations=200_000):
         p = np.concatenate([hist[s], plan["users"][(s, turn[s])]])
         prompts.append(p)
         rid = srv.submit(p, plan["max_new"][(s, turn[s])],
-                         seed=1000 + 97 * s + turn[s])
+                         seed=1000 + 97 * s + turn[s], session_id=s)
         pending[rid] = s
 
     for s in range(sessions):
@@ -97,6 +102,8 @@ def run_multiturn(srv, plan, max_iterations=200_000):
                 continue
             out = np.asarray(req.tokens, np.int32)
             outs[(s, turn[s])] = out
+            if ttfts is not None and req.first_token_t is not None:
+                ttfts[(s, turn[s])] = req.first_token_t - req.submit_t
             hist[s] = np.concatenate(
                 [hist[s], plan["users"][(s, turn[s])], out])
             turn[s] += 1
@@ -106,6 +113,18 @@ def run_multiturn(srv, plan, max_iterations=200_000):
         if it > max_iterations:
             raise RuntimeError("multi-turn driver wedged")
     return prompts, outs
+
+
+def ttft_by_turn(ttfts, turns):
+    """Per-turn mean TTFT rows (``turn<k>_ttft_s``) from a
+    ``run_multiturn(ttfts=...)`` collection — turn 0 cold, later turns
+    the resume series the perf ledger gates on (down is good)."""
+    out = {}
+    for t in range(turns):
+        vals = [v for (s, tt), v in ttfts.items() if tt == t]
+        if vals:
+            out[f"turn{t}_ttft_s"] = round(sum(vals) / len(vals), 6)
+    return out
 
 
 def build(slots, max_len, chunk, temperature=0.8, top_k=20,
@@ -243,8 +262,9 @@ def bench_multiturn(slots=4, max_len=128, chunk=16, page_size=16,
                                      "temperature": 0.8, "top_k": 20,
                                      **extra})
         pre = srv.pool.snapshot() if srv.pool is not None else None
+        ttfts = {}
         t0 = time.perf_counter()
-        prompts, outs = run_multiturn(srv, plan)
+        prompts, outs = run_multiturn(srv, plan, ttfts=ttfts)
         wall = time.perf_counter() - t0
         snap = srv.stats.snapshot()
         total_prompt = int(sum(len(p) for p in prompts))
@@ -257,6 +277,10 @@ def bench_multiturn(slots=4, max_len=128, chunk=16, page_size=16,
             "prefill_tokens_paid": total_prompt - saved,
             "prefill_tokens_saved": saved,
             "ttft_s": snap["ttft_s"],
+            # per-turn resume TTFT: turn 0 is the cold prefill; later
+            # turns replay the conversation — the series the host-tier
+            # PR must move (perf ledger direction: down)
+            "resume_ttft": ttft_by_turn(ttfts, turns),
         }
         if srv.pool is not None:
             ps = srv.pool.snapshot()

@@ -102,6 +102,17 @@ class ServingConfig:
     # (observability/capacity.py). Host-side only — zero new compiled
     # programs, zero device syncs. None = no analyzer built.
     workload: "object | None" = None
+    # KV residency observatory (observability/kvscope.py |
+    # observability.kvscope.KVScopeConfig | dict): ghost-tree
+    # eviction-regret ledger on the page pool (every prefill token
+    # re-paid because of a past eviction counted and attributed),
+    # per-session lifecycle heat tracking (idle/resume histograms, HBM
+    # byte-seconds-held-while-idle), and the measured inputs of the
+    # tiered_kv capacity-advisor lever. Host-side only — zero new
+    # compiled programs, zero device syncs (the copy-bandwidth probe
+    # runs only when a capacity report asks). None (default) builds
+    # nothing: one `is not None` per admission/retirement/eviction.
+    kvscope: "object | None" = None
     # Goodput/badput wall-time attribution (observability/goodput.py):
     # decomposes elapsed wall time into productive decode/prefill vs
     # badput buckets (compile, queue-empty idle, watchdog stall, drain,
@@ -184,6 +195,10 @@ class ServingConfig:
             from ..observability.workload import WorkloadConfig
 
             self.workload = WorkloadConfig.from_any(self.workload)
+        if self.kvscope is not None:
+            from ..observability.kvscope import KVScopeConfig
+
+            self.kvscope = KVScopeConfig.from_any(self.kvscope)
         if self.telemetry is not None:
             from ..observability.server import TelemetryConfig
 

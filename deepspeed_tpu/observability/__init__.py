@@ -27,6 +27,7 @@ from .fleet_scrape import FleetScraper
 from .flight import (FlightRecorder, newest_flight_record,
                      read_flight_record)
 from .goodput import BADPUT_BUCKETS, GoodputLedger
+from .kvscope import KVScope, KVScopeConfig, measure_copy_bandwidth
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
                       get_registry)
 # perf_ledger is intentionally NOT imported here: like doctor.py it is a
@@ -65,6 +66,7 @@ __all__ = [
     "merge_fleet_trace", "hop_trace", "HOP_NAMES",
     "SLOConfig", "SLOScorer", "MedianMADDetector", "CompileStormDetector",
     "WorkloadAnalyzer", "WorkloadConfig",
+    "KVScope", "KVScopeConfig", "measure_copy_bandwidth",
     "ProgramCensus", "hbm_ledger", "kv_cache_bytes", "capacity_report",
     "validate_capacity_report", "write_capacity_report",
     "CommScope", "CommScopeConfig", "StragglerDetector",

@@ -48,6 +48,7 @@ LEVER_PREFIX = "prefix_sharing"
 LEVER_KV_QUANT = "kv_quantization"
 LEVER_COLLECTIVES = "quantized_collectives"
 LEVER_SPECULATION = "speculative_decoding"
+LEVER_TIERED_KV = "tiered_kv"
 
 
 def roofline_peaks(device=None) -> tuple:
@@ -128,7 +129,8 @@ def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
                page_size: int = 0, pool_pages: int = 0,
                kv_quant_bits: int = 0,
                pages_used: Optional[int] = None,
-               pages_free: Optional[int] = None) -> dict:
+               pages_free: Optional[int] = None,
+               idle_kv_bytes: Optional[int] = None) -> dict:
     """Decompose the HBM budget of a serving config into its components.
 
     ``params`` is the engine's (possibly WOQ-quantized) tree — weights
@@ -182,6 +184,12 @@ def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
                                if pages_used is not None else None),
         "kv_pool_free_bytes": (pages_free * kv["page_bytes"]
                                if pages_free is not None else None),
+        # the host-tier row (kvscope): HBM currently held by IDLE
+        # sessions' tree-retained pages — what demoting them to pinned
+        # host memory would reclaim at the measured idle distribution.
+        # None when the residency observatory isn't running (older
+        # reports simply lack the figure; null is the contract).
+        "kv_idle_resident_bytes": idle_kv_bytes,
     }
     if limit_bytes:
         free_for_kv = limit_bytes - weights - (temp_bytes or 0)
@@ -370,7 +378,8 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     occupancy_avg: Optional[float] = None,
                     meta: Optional[dict] = None,
                     pages: Optional[dict] = None,
-                    commscope: Optional[dict] = None) -> dict:
+                    commscope: Optional[dict] = None,
+                    kvscope: Optional[dict] = None) -> dict:
     """Compose ledger + census + workload into the ranked what-if advisor.
 
     Every lever's score is the estimated fraction of its bounding
@@ -493,6 +502,71 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
     levers.append({"name": LEVER_COLLECTIVES, "score": float(coll_score),
                    "estimate": coll_est, "why": why_coll})
 
+    # Tiered (host-offloaded) KV: scored ENTIRELY from measurements —
+    # observed eviction-regret traffic (the prefill the tree silently
+    # re-pays today, kvscope's ghost ledger), the measured host↔device
+    # copy bandwidth (the restore path's cost), and the span ring's
+    # measured prefill throughput (the recompute path's cost). The score
+    # is the regretted share of prefill work times the fraction of it a
+    # host restore would win back (1 - restore/recompute, clipped at 0).
+    # ANY unmeasured input degrades the lever to score 0 with the reason
+    # stated — the advisor never invents a host-tier payoff.
+    ks = kvscope or {}
+    reg = ks.get("regret") or {}
+    sess = ks.get("sessions") or {}
+    tk_score = 0.0
+    tk_est: dict[str, Any] = {
+        "regret_tokens": reg.get("regret_tokens"),
+        "regret_frac": reg.get("regret_frac"),
+        "mean_regret_tokens_per_admission": reg.get("mean_regret_tokens"),
+        "projected_restore_s_per_resume": None,
+        "measured_recompute_s_per_resume": None,
+        "copy_h2d_gbps": ((ks.get("copy_bandwidth") or {})
+                          .get("h2d_gbps")),
+        "prefill_tokens_per_s": ((ks.get("prefill") or {})
+                                 .get("tokens_per_s")),
+        "hbm_reclaimable_bytes": sess.get("idle_kv_bytes_now"),
+        "idle_kv_byte_s": sess.get("idle_kv_byte_s"),
+        "resume_overlap": (workload or {}).get("resume_overlap"),
+    }
+    regret_tokens = reg.get("regret_tokens") or 0
+    regret_frac = reg.get("regret_frac")
+    mean_tok = reg.get("mean_regret_tokens")
+    cbw = tk_est["copy_h2d_gbps"]
+    pr = tk_est["prefill_tokens_per_s"]
+    ptb = ks.get("per_token_bytes") or ledger.get("kv_per_token_bytes")
+    if not ks:
+        why_tk = ("no KV residency observatory measured "
+                  "(serving.kvscope off)")
+    elif not regret_tokens:
+        why_tk = ("no eviction regret observed on this traffic — the "
+                  "tree covers the working set; a host tier would only "
+                  "add restore latency")
+    elif cbw is None:
+        why_tk = ("host-to-device copy bandwidth unmeasured on this "
+                  "backend — restore cost unknown, lever degraded")
+    elif pr is None:
+        why_tk = ("no measured prefill timings (serving.spans off) — "
+                  "recompute cost unknown, lever degraded")
+    elif not ptb:
+        why_tk = ("per-token KV byte cost unknown (no paged cache "
+                  "layout) — restore bytes unknown, lever degraded")
+    else:
+        restore_s = mean_tok * ptb / (cbw * 1e9)
+        recompute_s = mean_tok / pr
+        tk_est["projected_restore_s_per_resume"] = restore_s
+        tk_est["measured_recompute_s_per_resume"] = recompute_s
+        advantage = max(0.0, 1.0 - restore_s / recompute_s) \
+            if recompute_s > 0 else 0.0
+        tk_score = float(regret_frac or 0.0) * advantage
+        why_tk = ("measured eviction-regret share of prefill work, "
+                  "scaled by the measured restore-vs-recompute "
+                  f"advantage (host restore {restore_s:.3g}s vs prefill "
+                  f"recompute {recompute_s:.3g}s per mean regretted "
+                  "resume)")
+    levers.append({"name": LEVER_TIERED_KV, "score": float(tk_score),
+                   "estimate": tk_est, "why": why_tk})
+
     # Self-speculation: the prompt-lookup acceptance estimate bounds the
     # extra tokens per verify pass draft-free speculation gets for free.
     accept = ((workload or {}).get("selfspec_accept") or {}).get("mean")
@@ -520,6 +594,8 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         # validator accepts: nulls are the degradation contract, absence
         # is a pre-commscope artifact)
         "commscope": commscope,
+        # the KV residency observatory's measured rows (same contract)
+        "kvscope": kvscope,
         "advisor": {"levers": levers,
                     "ranked": [d["name"] for d in levers]},
     }

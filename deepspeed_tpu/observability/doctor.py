@@ -26,6 +26,11 @@ running engine, no device):
   (``observability/commscope.py``): exposed/overlap collective
   fractions, per-kind achieved bus bandwidth, and the per-device skew
   table, from the latest .prom; a BURNING straggler gauge gates.
+- ``[kv]`` — the KV residency observatory
+  (``observability/kvscope.py``): eviction-regret rate, session heat,
+  hottest evicted sessions, and the ``tiered_kv`` lever verdict from
+  the newest capacity report; RUNAWAY regret (regret_frac above
+  ``--kv-regret-max``) gates.
 
 Exit code is the CI/cron gate: **nonzero** when the newest flight record
 contains a why-marker (watchdog stall, SLO breach, anomaly, compile
@@ -482,6 +487,84 @@ def report_comm(d: Path) -> list:
     return findings
 
 
+def report_kv(d: Path, regret_max: float = 0.5) -> list:
+    """Print the ``[kv]`` picture — the KV residency observatory
+    (``observability/kvscope.py``): eviction-regret rate, session heat,
+    the hottest evicted sessions, and the ``tiered_kv`` lever verdict
+    from the newest capacity report. Gate finding: RUNAWAY REGRET — the
+    regretted share of prefill work (``dstpu_serve_eviction_regret_frac``
+    in the latest .prom) above ``regret_max``: the pool is thrashing and
+    every resume re-pays its prefill (docs/OPERATIONS.md "sizing the
+    host KV tier from the regret ledger")."""
+    from .sinks import parse_prometheus_textfile
+
+    prom = _newest(d, "*.prom")
+    if prom is None:
+        return []
+    vals = parse_prometheus_textfile(prom.read_text())
+    kv = {k: v for k, v in vals.items()
+          if k.startswith(("dstpu_serve_eviction_regret",
+                           "dstpu_serve_kv_", "dstpu_serve_session",
+                           "dstpu_fleet_affinity_regret",
+                           "dstpu_fleet_resume_regret"))}
+    if not kv:
+        return []          # no observatory ran: no section, no gate
+    print(f"[kv] {prom.name}")
+    for key, label in (
+            ("dstpu_serve_eviction_regret_tokens", "regret_tokens"),
+            ("dstpu_serve_eviction_regret_frac", "regret_frac"),
+            ("dstpu_serve_kv_ghost_entries", "ghost_entries"),
+            ("dstpu_serve_sessions_active", "sessions_active"),
+            ("dstpu_serve_sessions_idle", "sessions_idle"),
+            ("dstpu_serve_sessions_dead", "sessions_dead"),
+            ("dstpu_serve_session_resumed", "session_resumes"),
+            ("dstpu_serve_session_regret_resumes", "regret_resumes"),
+            ("dstpu_serve_session_idle_kv_byte_s", "idle_kv_byte_s"),
+            ("dstpu_fleet_affinity_regret", "fleet_affinity_regret")):
+        if key in kv:
+            print(f"  {label:<24s} {_fmt(kv[key])}")
+    # hottest evicted sessions + the lever verdict come from the newest
+    # capacity report's kvscope section (per-session data never lands in
+    # the scalar exposition)
+    rep_path = _newest(d, "CAPACITY_REPORT*.json")
+    if rep_path is not None:
+        try:
+            rep = json.loads(rep_path.read_text(errors="replace"))
+        except (OSError, json.JSONDecodeError):
+            rep = {}
+        rep = rep if isinstance(rep, dict) else {}
+        ks = rep.get("kvscope")
+        ks = ks if isinstance(ks, dict) else {}
+        hot = (ks.get("sessions") or {}).get("hottest") or []
+        if hot:
+            print("  hottest evicted sessions (regretted tokens):")
+            for h in hot[:5]:
+                h = h if isinstance(h, dict) else {}
+                print(f"    {str(h.get('session')):<16s} "
+                      f"regret={h.get('regret_tokens')} "
+                      f"resumes={h.get('resumes')} "
+                      f"state={h.get('state')}")
+        adv = rep.get("advisor")
+        lvs = adv.get("levers") if isinstance(adv, dict) else None
+        for lv in (lvs if isinstance(lvs, list) else []):
+            lv = lv if isinstance(lv, dict) else {}
+            if lv.get("name") == "tiered_kv":
+                score = lv.get("score")
+                print(f"  tiered_kv lever: score="
+                      f"{_fmt(float(score)) if isinstance(score, (int, float)) else score}"
+                      f"  {lv.get('why') or ''}")
+    findings: list = []
+    frac = kv.get("dstpu_serve_eviction_regret_frac")
+    if isinstance(frac, float) and frac > regret_max:
+        print(f"  RUNAWAY REGRET: {_fmt(frac)} of prefill work re-paid "
+              f"because of evictions (gate at {regret_max:g})")
+        findings.append(
+            f"runaway eviction regret in {prom.name}: regret_frac "
+            f"{_fmt(frac)} > {regret_max:g} — the KV pool is thrashing; "
+            "see the tiered_kv lever / host-tier sizing runbook")
+    return findings
+
+
 # ----------------------------------------------------------- live (--url)
 def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
     """(status, body) for a GET; (None, error-repr) when the target is
@@ -675,6 +758,9 @@ def main(argv=None) -> int:
     ap.add_argument("--perf-margin", type=float, default=0.2,
                     help="relative regression margin for the [perf] gate "
                          "(default 0.2)")
+    ap.add_argument("--kv-regret-max", type=float, default=0.5,
+                    help="[kv] gate: regretted share of prefill work "
+                         "above this trips (default 0.5)")
     args = ap.parse_args(argv)
     if args.targets:
         findings = report_fleet(
@@ -696,6 +782,7 @@ def main(argv=None) -> int:
         findings += report_incidents(fdir)
         report_capacity(d)
         findings += report_comm(d)
+        findings += report_kv(d, regret_max=args.kv_regret_max)
         findings += report_replay([d] if fdir == d else [d, fdir])
         ledger = Path(args.ledger) if args.ledger \
             else d / "PERF_LEDGER.json"

@@ -62,6 +62,9 @@ _TID_PREFILL = 2
 _TID_STEP = 3
 _TID_MARKERS = 4
 _TID_SLOT0 = 10
+# per-session residency tracks (kvscope lifecycle spans) allocate from
+# here in first-seen order — high enough that slot tids can never reach
+_TID_SESSION0 = 1000
 
 _TRAIN_TIDS = {"train_step": 1}   # phases allocate 2.. in first-seen order
 
@@ -100,6 +103,7 @@ def to_chrome_trace(events: Iterable[S.SpanEvent],
     out: list[dict] = []
     used_tids: dict[int, set] = {PID_SERVING: set(), PID_TRAIN: set()}
     train_tids = dict(_TRAIN_TIDS)
+    session_tids: dict[str, int] = {}    # residency tracks, first-seen
 
     def add(pid, tid, ph, name, ts, dur=None, args=None):
         ev = {"name": name, "ph": ph, "pid": pid, "tid": tid,
@@ -161,6 +165,16 @@ def to_chrome_trace(events: Iterable[S.SpanEvent],
             phase = e.meta.get("phase", "phase")
             tid = train_tids.setdefault(phase, len(train_tids) + 1)
             add(PID_TRAIN, tid, "X", phase, ts, dur or 0.0, args)
+        elif e.kind in (S.SESSION_ACTIVE, S.SESSION_IDLE):
+            # per-session residency track (kvscope): active bursts and
+            # the idle gaps between them on one line per session — the
+            # host-tier trade (idle HBM vs regretted recompute) readable
+            # straight off the timeline
+            sid = str(e.meta.get("session", "?"))
+            tid = session_tids.setdefault(
+                sid, _TID_SESSION0 + len(session_tids))
+            nm = "active" if e.kind == S.SESSION_ACTIVE else "idle"
+            add(PID_SERVING, tid, "X", nm, ts, dur or 0.0, args)
         elif e.kind == S.COMM_OP:
             add(PID_TRAIN, _TID_COMM, "X",
                 str(e.meta.get("collective", "collective")), ts,
@@ -192,8 +206,10 @@ def to_chrome_trace(events: Iterable[S.SpanEvent],
             if tid in used_tids[PID_SERVING]:
                 thread_meta(PID_SERVING, tid, nm)
         for tid in sorted(t for t in used_tids[PID_SERVING]
-                          if t >= _TID_SLOT0):
+                          if _TID_SLOT0 <= t < _TID_SESSION0):
             thread_meta(PID_SERVING, tid, f"slot {tid - _TID_SLOT0}")
+        for sid, tid in session_tids.items():
+            thread_meta(PID_SERVING, tid, f"session {sid}")
     if used_tids[PID_TRAIN]:
         name_meta(PID_TRAIN, f"{job_name}:train")
         for phase, tid in train_tids.items():

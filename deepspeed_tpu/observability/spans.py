@@ -56,6 +56,13 @@ HANDOFF_EXPORT = "handoff_export"  # span: prefill pages gathered to host
 HANDOFF_PENDING = "handoff_pending"  # span: payload host-held, waiting
                                    # for a decode slot/pool
 HANDOFF_IMPORT = "handoff_import"  # span: scatter into the decode replica
+# KV residency observatory (observability/kvscope.py — rendered as
+# per-session residency tracks in the Perfetto export; meta carries
+# ``session``):
+SESSION_ACTIVE = "session_active"  # span: first admit/resume → last retire
+SESSION_IDLE = "session_idle"      # span: idle gap closed by a resume
+                                   # (meta: regret_tokens the resume
+                                   # re-paid — 0 when the prefix survived)
 # Communication observatory (observability/commscope.py — rendered as a
 # `comm` track beside the train pid in the Perfetto export):
 COMM_OP = "comm_op"                # span: one collective op in flight

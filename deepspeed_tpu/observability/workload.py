@@ -14,6 +14,12 @@ admission stream, so every what-if in the capacity advisor
   NOT have to prefill again. Reported as the shared-prefix token fraction
   (``Serve/workload_prefix_overlap``) and the cumulative dedupable-token
   count — the prefill work prefix sharing saves at the current overlap.
+  The estimate is additionally SPLIT by attribution: same-session resume
+  overlap (``Serve/workload_resume_overlap`` — the share a host KV tier
+  could restore from demoted session pages; the input the ``tiered_kv``
+  capacity lever sizes on) vs cross-request overlap
+  (``Serve/workload_cross_overlap`` — shared system prompts that stay
+  HBM-hot regardless).
 - **self-speculation estimator** — an n-gram / prompt-lookup scan over
   each prompt: the fraction of positions where the preceding ``ngram``
   tokens have occurred before *and* correctly predict the next token is
@@ -70,6 +76,9 @@ class WorkloadConfig:
     max_prefixes: int = 65536
     # Context length for the prompt-lookup / self-speculation scan.
     ngram: int = 3
+    # Bounded LRU of per-session prefix sets: the resume-vs-cross
+    # overlap split (sessions beyond the cap fall back to cross-only).
+    max_sessions: int = 4096
 
     def __post_init__(self):
         if self.block < 1:
@@ -79,6 +88,9 @@ class WorkloadConfig:
                              f"got {self.max_prefixes}")
         if self.ngram < 1:
             raise ValueError(f"workload ngram must be >= 1, got {self.ngram}")
+        if self.max_sessions < 1:
+            raise ValueError(f"workload max_sessions must be >= 1, "
+                             f"got {self.max_sessions}")
 
     @classmethod
     def from_any(cls, cfg: "WorkloadConfig | dict | None") \
@@ -105,6 +117,16 @@ def prefix_hashes(tokens: np.ndarray, block: int) -> list:
         if (i + 1) % block == 0:
             out.append((i + 1, h))
     return out
+
+
+def token_hash(tokens) -> int:
+    """The same polynomial rolling hash over a WHOLE token sequence —
+    one shared spelling so the prefix sketch here and the ghost-tree
+    ledger (``kvscope.py``) key identical prefixes identically."""
+    h = 0
+    for t in np.asarray(tokens).reshape(-1).tolist():
+        h = (h * _HASH_P + (int(t) + 1)) % _HASH_M
+    return h
 
 
 def selfspec_acceptance(tokens: np.ndarray, ngram: int) -> Optional[float]:
@@ -148,15 +170,22 @@ class WorkloadAnalyzer:
         # dict is keyed by hash alone (not (len, hash)) so a longer
         # prefix with the same boundary hash refreshes recency.
         self._prefixes: OrderedDict = OrderedDict()
+        # per-session boundary sets (hash -> length of that session's own
+        # most recent prompt): the RESUME overlap — the share of a
+        # prompt a session replays from its OWN earlier turns, which is
+        # what a host KV tier can serve from demoted pages. The
+        # remainder of the total overlap is CROSS-request (shared system
+        # prompts), which stays hot in HBM regardless.
+        self._sessions: OrderedDict = OrderedDict()
         self.prompt_tokens = 0          # all admitted prompt tokens
         self.shared_tokens = 0          # tokens covered by a seen prefix
+        self.resume_tokens = 0          # covered by the SAME session
         self.requests = 0
 
     # ------------------------------------------------------------ admission
-    def _match_and_insert(self, tokens: np.ndarray) -> int:
-        """Longest block-aligned prefix of ``tokens`` already in the
-        sketch (tokens), then record this prompt's own boundaries."""
-        bounds = prefix_hashes(tokens, self.cfg.block)
+    def _match_and_insert(self, bounds: list) -> int:
+        """Longest block-aligned prefix already in the sketch (tokens),
+        then record this prompt's own boundaries."""
         shared = 0
         for length, h in bounds:
             if self._prefixes.get(h) == length:
@@ -175,32 +204,64 @@ class WorkloadAnalyzer:
             self._prefixes.popitem(last=False)
         return shared
 
-    def on_admit(self, prompt: np.ndarray) -> dict:
+    def _session_match(self, session_id, bounds: list) -> int:
+        """Longest boundary this SESSION itself registered before, then
+        replace its set with this prompt's boundaries (conversations
+        replay a growing prefix — the latest prompt's set covers every
+        earlier one)."""
+        if session_id is None:
+            return 0
+        prev = self._sessions.get(session_id)
+        shared = 0
+        if prev is not None:
+            for length, h in bounds:
+                if prev.get(h) == length:
+                    shared = length
+        self._sessions[session_id] = {h: length for length, h in bounds}
+        self._sessions.move_to_end(session_id)
+        while len(self._sessions) > self.cfg.max_sessions:
+            self._sessions.popitem(last=False)
+        return shared
+
+    def on_admit(self, prompt: np.ndarray, session_id=None) -> dict:
         """Score one admitted prompt; returns the per-request estimates
         (the scheduler ignores them — callers like benches may not)."""
         t0 = self.clock() if self.clock is not None else None
         prompt = np.asarray(prompt).reshape(-1)
         P = len(prompt)
-        shared = self._match_and_insert(prompt)
+        bounds = prefix_hashes(prompt, self.cfg.block)
+        shared = self._match_and_insert(bounds)
+        resume = min(self._session_match(session_id, bounds), shared)
         accept = selfspec_acceptance(prompt, self.cfg.ngram)
         self.requests += 1
         self.prompt_tokens += P
         self.shared_tokens += shared
+        self.resume_tokens += resume
         r = self.registry
         r.counter("Serve/workload_prompt_tokens").inc(P)
         r.counter("Serve/workload_shared_prefix_tokens").inc(shared)
+        r.counter("Serve/workload_resume_tokens").inc(resume)
         r.histogram("Serve/workload_prompt_len").observe(P)
         r.histogram("Serve/workload_prefix_share").observe(
             shared / P if P else 0.0)
         if self.prompt_tokens:
             r.gauge("Serve/workload_prefix_overlap").set(
                 self.shared_tokens / self.prompt_tokens)
+            # the split the host-tier advisor sizes on: resume overlap
+            # (same-session replay — host-restorable) vs cross-request
+            # overlap (shared system prompts — stays HBM-hot anyway)
+            r.gauge("Serve/workload_resume_overlap").set(
+                self.resume_tokens / self.prompt_tokens)
+            r.gauge("Serve/workload_cross_overlap").set(
+                (self.shared_tokens - self.resume_tokens)
+                / self.prompt_tokens)
         if accept is not None:
             r.histogram("Serve/workload_selfspec_accept").observe(accept)
         if t0 is not None:
             r.histogram("Serve/workload_analysis_s").observe(
                 self.clock() - t0)
         return {"prompt_len": P, "shared_prefix_tokens": shared,
+                "resume_prefix_tokens": resume,
                 "selfspec_accept": accept}
 
     # ----------------------------------------------------------- retirement
@@ -218,6 +279,13 @@ class WorkloadAnalyzer:
         return (self.shared_tokens / self.prompt_tokens
                 if self.prompt_tokens else 0.0)
 
+    @property
+    def resume_overlap(self) -> float:
+        """Same-session replayed-prefix fraction — the share of prefill
+        work a HOST KV tier could serve from demoted session pages."""
+        return (self.resume_tokens / self.prompt_tokens
+                if self.prompt_tokens else 0.0)
+
     def snapshot(self) -> dict:
         snap = self.registry.snapshot()
         h = snap["histograms"]
@@ -227,8 +295,12 @@ class WorkloadAnalyzer:
             "prompt_tokens": self.prompt_tokens,
             "shared_prefix_tokens": self.shared_tokens,
             "prefix_overlap": self.prefix_overlap,
+            "resume_prefix_tokens": self.resume_tokens,
+            "resume_overlap": self.resume_overlap,
+            "cross_overlap": self.prefix_overlap - self.resume_overlap,
             "dedupable_prefill_tokens": self.shared_tokens,
             "distinct_prefixes": len(self._prefixes),
+            "tracked_sessions": len(self._sessions),
             "block": self.cfg.block,
             "ngram": self.cfg.ngram,
             "selfspec_accept": accept,

@@ -195,11 +195,17 @@ class ServingEngine:
         self.pool: Optional[PagePool] = None
         self._table = None
         self._table_dirty = False
+        _kvs_on = self.cfg.kvscope is not None and self.cfg.kvscope.enabled
         if self._paged:
             self.pool = PagePool(self.cfg.pool_pages, self.cfg.page_size,
                                  self.cfg.max_len,
                                  registry=self.stats.registry,
-                                 prefix_sharing=self.cfg.prefix_sharing)
+                                 prefix_sharing=self.cfg.prefix_sharing,
+                                 # the eviction-pressure ages are the
+                                 # residency observatory's opt-in; the
+                                 # default pool stays clock-free
+                                 clock=self.stats.clock if _kvs_on
+                                 else None)
             # host-authoritative page tables, mirrored into the carry on
             # change (insert seats a row, retirement clears one): steady
             # full-slot decode uploads nothing
@@ -209,6 +215,41 @@ class ServingEngine:
                 # stall dumps show the pool at the moment of the stall
                 self.flight.add_snapshot_provider("pages",
                                                   self.pool.snapshot)
+        # KV residency observatory (observability/kvscope.py,
+        # docs/OBSERVABILITY.md): ghost-tree eviction-regret ledger on
+        # the page pool + per-session lifecycle heat tracking + the
+        # measured host-tier advisor inputs. None (default) builds
+        # nothing — one `is not None` per admission/retirement and one
+        # on the pool's eviction path; zero programs, zero syncs (the
+        # compile-freeze gates stay the acceptance tests).
+        self.kvscope = None
+        if _kvs_on:
+            from ..observability.capacity import kv_cache_bytes
+            from ..observability.kvscope import KVScope
+
+            ptb = None
+            if self._paged:
+                ptb = kv_cache_bytes(
+                    mcfg, self.cfg.slots, self.cfg.max_len,
+                    engine.compute_dtype, page_size=self.cfg.page_size,
+                    pool_pages=self.cfg.pool_pages,
+                    kv_quant_bits=self.cfg.kv_quant_bits,
+                )["per_token_bytes"]
+            pool = self.pool
+            self.kvscope = KVScope(
+                self.cfg.kvscope, registry=self.stats.registry,
+                clock=self.stats.clock, spans=self.spans,
+                page_size=self.cfg.page_size, per_token_bytes=ptb,
+                # pool truth for "reclaimable now": idle-session sums
+                # are capped at the tree's live residency
+                tree_held_tokens=(
+                    (lambda: pool.tree_held * self.cfg.page_size)
+                    if pool is not None else None))
+            if self.pool is not None:
+                self.pool.on_evict = self.kvscope.on_evictions
+            if self.flight is not None:
+                self.flight.add_snapshot_provider("kv_residency",
+                                                  self.kvscope.snapshot)
         self.sched = Scheduler(self.cfg.slots, self.cfg.max_len,
                                self.cfg.prefill_chunk,
                                max_queue=self.cfg.max_queue,
@@ -387,7 +428,8 @@ class ServingEngine:
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                seed: int = 0, ttft_deadline_s: Optional[float] = None,
-               total_deadline_s: Optional[float] = None) -> int:
+               total_deadline_s: Optional[float] = None,
+               session_id=None) -> int:
         """Queue one request; returns its request id. Tokens sample with
         a per-request RNG folded from ``seed`` — bit-identical (up to eos
         truncation) to ``engine.generate(prompt[None], max_new,
@@ -396,7 +438,9 @@ class ServingEngine:
         width is part of the sampled bit-stream.
 
         ``ttft_deadline_s`` / ``total_deadline_s`` override the config
-        defaults for this request (0 disables). Raises
+        defaults for this request (0 disables); ``session_id`` (opaque,
+        hashable) keys session-lifecycle tracking (kvscope / workload)
+        and fleet affinity. Raises
         :class:`~..resilience.guards.QueueFullError` (status ``SHED``)
         when the queue is at ``max_queue`` or the engine is draining."""
         if self._draining:
@@ -407,7 +451,8 @@ class ServingEngine:
         max_new = int(max_new_tokens or self.engine.config.max_out_tokens)
         req = self.sched.submit(prompt, max_new, seed,
                                 ttft_deadline_s=ttft_deadline_s,
-                                total_deadline_s=total_deadline_s)
+                                total_deadline_s=total_deadline_s,
+                                session_id=session_id)
         if req.deadline_ttft is not None or req.deadline_total is not None:
             self._any_deadlines = True
         if self.capture is not None:
@@ -488,7 +533,12 @@ class ServingEngine:
                     if self.workload is not None:
                         # admission hook: score the prompt's prefix overlap
                         # / self-speculation potential (host-side only)
-                        self.workload.on_admit(req.prompt)
+                        self.workload.on_admit(req.prompt,
+                                               session_id=req.session_id)
+                    if self.kvscope is not None:
+                        # residency probe beside it: ghost-tree regret
+                        # match + session resume edge (host-side only)
+                        self.kvscope.on_admit(req)
                     cache = self._prog("init_cache", lambda: jax.jit(
                         lambda: init_cache(self.model.cfg, 1,
                                            self.cfg.max_len,
@@ -627,6 +677,10 @@ class ServingEngine:
             self._table_dirty = True
         if self.workload is not None:
             self.workload.on_retire(req)
+        if self.kvscope is not None:
+            # session idle edge: the byte-seconds-held-while-idle meter
+            # starts when a session's LAST live request terminates
+            self.kvscope.on_retire(req)
         if self.capture is not None:
             self.capture.on_result(req)
         if self._request_logs or self.flight is not None:
@@ -801,6 +855,11 @@ class ServingEngine:
                 and self.sched.running.get(slot) is None:
             self._table[slot] = 0
             self._table_dirty = True
+        if self.kvscope is not None:
+            # the handoff ends the session's activity on THIS replica
+            # (its tree keeps the prompt blocks); without this edge a
+            # prefill replica's sessions would stay ACTIVE forever
+            self.kvscope.on_retire(req)
 
     def import_request(self, req: Request, payload: dict) -> bool:
         """Seat an exported request into THIS engine's pool and a free
@@ -840,20 +899,26 @@ class ServingEngine:
                               {k: jnp.asarray(v) for k, v in payload.items()},
                               jnp.asarray(alloc.row), jnp.int32(alloc.shared))
             self.pool.on_inserted(req.rid, req.prompt)
+        if self.kvscope is not None:
+            # decode-side session intake: residency moves here (no
+            # regret probe — this replica paid no prefill)
+            self.kvscope.on_import(req)
         req.import_t1 = self.stats.clock()
         return True
 
-    def serve_batch(self, prompts, max_new_tokens=None, seeds=None) -> list:
+    def serve_batch(self, prompts, max_new_tokens=None, seeds=None,
+                    session_ids=None) -> list:
         """Convenience: submit a list of (ragged) prompts, drain, return
         each request's tokens as an int32 array, in submission order.
-        ``max_new_tokens`` and ``seeds`` may be scalars or per-request
-        lists. Results are collected (popped) — repeated calls on one
-        engine don't accumulate host state."""
+        ``max_new_tokens``, ``seeds``, and ``session_ids`` may be
+        scalars or per-request lists. Results are collected (popped) —
+        repeated calls on one engine don't accumulate host state."""
         n = len(prompts)
         mn = expand_per_request(max_new_tokens, n, None, int)
         sd = expand_per_request(seeds, n, 0, int)
-        rids = [self.submit(p, mn[i], seed=sd[i]) for i, p in
-                enumerate(prompts)]
+        sid = expand_per_request(session_ids, n, None)
+        rids = [self.submit(p, mn[i], seed=sd[i], session_id=sid[i])
+                for i, p in enumerate(prompts)]
         want = set(rids)
         got: dict[int, Request] = {}
         it = 0
@@ -936,6 +1001,13 @@ class ServingEngine:
                 "used_pages": ps["used_pages"],
                 "usable_pages": ps["usable_pages"],
                 "tree_held_pages": ps["tree_held_pages"],
+                # eviction pressure through /readyz: what the next
+                # admission under pressure would reclaim, how often
+                # pressure has bitten, and how stale the coldest entry is
+                "evictable_pages": ps["evictable_pages"],
+                "eviction_events": ps["eviction_events"],
+                "pages_evicted": ps["pages_evicted"],
+                "oldest_tree_entry_age_s": ps["oldest_tree_entry_age_s"],
                 "pool_pressure": pressure,
             }
             out["pool_pressure"] = pressure
@@ -956,6 +1028,8 @@ class ServingEngine:
             out["workload"] = self.workload.snapshot()
         if self._paged:
             out["pages"] = self.pool.snapshot()
+        if self.kvscope is not None:
+            out["kv_residency"] = self.kvscope.snapshot()
         if self.goodput is not None:
             out["goodput"] = self.goodput.snapshot()
         return out
@@ -1052,6 +1126,39 @@ class ServingEngine:
             census.attach_spans(self.spans.events())
         return census.report()
 
+    def _prefill_rate(self) -> Optional[dict]:
+        """Measured prefill throughput from the span ring's
+        ``prefill_chunk`` spans (dispatch tokens / dispatch wall) — the
+        recompute-cost side of the tiered_kv lever. None when spans are
+        off or no chunk has run (the lever then degrades to score 0:
+        unmeasured, not guessed)."""
+        if self.spans is None:
+            return None
+        from ..observability import spans as _sp
+
+        toks = 0
+        wall = 0.0
+        for ev in self.spans.events():
+            if ev.kind == _sp.PREFILL_CHUNK and ev.t1 is not None:
+                toks += int(ev.meta.get("size") or 0)
+                wall += ev.duration
+        if toks <= 0 or wall <= 0:
+            return None
+        return {"tokens": toks, "wall_s": wall,
+                "tokens_per_s": toks / wall}
+
+    def kv_residency(self) -> Optional[dict]:
+        """The KV residency observatory's readout plus the two measured
+        host-tier inputs the capacity advisor joins it with: the (cached)
+        host↔device copy-bandwidth probe and the span ring's measured
+        prefill throughput. None when kvscope is off."""
+        if self.kvscope is None:
+            return None
+        snap = self.kvscope.snapshot()
+        snap["copy_bandwidth"] = self.kvscope.copy_bandwidth()
+        snap["prefill"] = self._prefill_rate()
+        return snap
+
     def hbm_ledger(self, temp_bytes: Optional[int] = None) -> dict:
         """The live HBM budget decomposed (weights / KV / temp) with
         projected headroom, as ``Memory/ledger_*`` gauges in the serving
@@ -1070,6 +1177,10 @@ class ServingEngine:
                         "kv_quant_bits": self.cfg.kv_quant_bits,
                         "pages_used": snap["used_pages"],
                         "pages_free": snap["free_pages"]}
+        if self.kvscope is not None:
+            # the host-tier row: bytes reclaimable by demoting idle
+            # sessions' tree-held pages at the measured idle distribution
+            paged_kw["idle_kv_bytes"] = self.kvscope.idle_kv_bytes()
         return hbm_ledger(
             params=self.engine.params, model_cfg=self.model.cfg,
             slots=self.cfg.slots, max_len=self.cfg.max_len,
@@ -1108,7 +1219,7 @@ class ServingEngine:
         wl = self.workload.snapshot() if self.workload is not None else None
         rep = capacity_report(
             ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
-            commscope=commscope,
+            commscope=commscope, kvscope=self.kv_residency(),
             pages=self.pool.snapshot() if self._paged else None,
             meta={"job": "serving", "slots": self.cfg.slots,
                   "max_len": self.cfg.max_len,

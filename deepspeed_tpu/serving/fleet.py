@@ -308,6 +308,14 @@ class FleetEngine:
             # into a shared fleet incident dir and fan out to siblings
             eng.flight.redirect = (lambda reason, _n=name:
                                    self._incident_redirect(_n, reason))
+        if eng.kvscope is not None:
+            # affinity-aware regret (observability/kvscope.py): a resume
+            # that re-pays ghost-covered prefill ON THE REPLICA the
+            # session was sticky to means affinity routed the session
+            # home only for home to have evicted its prefix
+            eng.kvscope.on_regret_resume = (
+                lambda sid, toks, _n=name:
+                self._on_regret_resume(_n, sid, toks))
         if self._draining:
             eng.begin_drain()
         self.replicas[name] = eng
@@ -634,7 +642,8 @@ class FleetEngine:
             try:
                 rid = eng.submit(prompt, max_new_tokens, seed=seed,
                                  ttft_deadline_s=ttft_deadline_s,
-                                 total_deadline_s=total_deadline_s)
+                                 total_deadline_s=total_deadline_s,
+                                 session_id=session_id)
                 break
             except QueueFullError as e:
                 # this replica flipped to full/draining between the
@@ -643,7 +652,6 @@ class FleetEngine:
                 last = e
                 tried.add(name)
         req = eng.sched.queue[-1]
-        req.session_id = session_id
         self._owner[rid] = name
         r = self.registry
         r.counter("Fleet/submitted").inc()
@@ -974,6 +982,59 @@ class FleetEngine:
             "Fleet/handoff_pending": float(len(self._handoffs)),
         })
         return out
+
+    def _on_regret_resume(self, name: str, session_id, tokens: int) \
+            -> None:
+        """A replica's kvscope reported a regretted resume (the session
+        came back and re-paid ghost-covered prefill there). Fleet-wide
+        it always counts; when the session was STICKY to that very
+        replica it is an affinity regret — the router sent the session
+        home for its prefix, and home had evicted it. That is the
+        failure a host KV tier (or smarter eviction) removes."""
+        r = self.registry
+        r.counter("Fleet/resume_regrets").inc()
+        r.counter("Fleet/resume_regret_tokens").inc(tokens)
+        role = ROLE_PREFILL if self._disagg else ROLE_SERVE
+        if self._session.get((role, session_id)) == name:
+            r.counter("Fleet/affinity_regret").inc()
+            r.counter("Fleet/affinity_regret_tokens").inc(tokens)
+
+    def kv_residency(self) -> Optional[dict]:
+        """Fleet-wide KV residency rollup: every replica's kvscope
+        snapshot plus the affinity-aware regret counters only the
+        router can attribute. None when no replica runs the observatory
+        (``serving.kvscope`` off)."""
+        per = {n: e.kvscope.snapshot()
+               for n, e in self.replicas.items()
+               if e.kvscope is not None}
+        if not per:
+            return None
+        c = self.registry.snapshot()["counters"]
+        totals = {
+            "regret_tokens": sum((s["regret"]["regret_tokens"])
+                                 for s in per.values()),
+            "prefill_tokens_paid": sum(s["regret"]["prefill_tokens_paid"]
+                                       for s in per.values()),
+            "sessions_resumed": sum(s["sessions"]["resumed"]
+                                    for s in per.values()),
+            "regret_resumes": sum(s["sessions"]["regret_resumes"]
+                                  for s in per.values()),
+        }
+        totals["regret_frac"] = (
+            totals["regret_tokens"] / totals["prefill_tokens_paid"]
+            if totals["prefill_tokens_paid"] else 0.0)
+        return {
+            "replicas": per,
+            "totals": totals,
+            "fleet": {
+                "resume_regrets": int(c.get("Fleet/resume_regrets", 0)),
+                "resume_regret_tokens": int(
+                    c.get("Fleet/resume_regret_tokens", 0)),
+                "affinity_regret": int(c.get("Fleet/affinity_regret", 0)),
+                "affinity_regret_tokens": int(
+                    c.get("Fleet/affinity_regret_tokens", 0)),
+            },
+        }
 
     def fleet_goodput(self) -> Optional[dict]:
         """The PR-8 rollup math over per-replica goodput ledgers

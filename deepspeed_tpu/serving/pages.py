@@ -256,13 +256,15 @@ class _Node:
     ``tails`` maps partially-filled trailing blocks (prompt length not
     page-aligned) to their pages — the copy-on-write sources."""
 
-    __slots__ = ("children", "tails", "page", "stamp", "parent", "key")
+    __slots__ = ("children", "tails", "page", "stamp", "tstamp", "parent",
+                 "key")
 
     def __init__(self, parent=None, key=None, page: int = -1):
         self.children: dict = {}
         self.tails: dict = {}          # tail tokens (tuple) -> page id
         self.page = page
         self.stamp = 0
+        self.tstamp: "float | None" = None   # clock time of the last touch
         self.parent = parent
         self.key = key
 
@@ -281,10 +283,17 @@ class RadixPrefixTree:
         self.page_size = page_size
         self.root = _Node()
         self._tick = 0
+        # eviction-pressure clock: the POOL stamps this before walking
+        # the tree (one clock read per match/register call, only when
+        # the pool was given a clock — the kvscope opt-in); None keeps
+        # entry ages unreported and the hot path clock-free.
+        self.now: "float | None" = None
 
     def _touch(self, node: _Node) -> None:
         self._tick += 1
         node.stamp = self._tick
+        if self.now is not None:
+            node.tstamp = self.now
 
     def match(self, prompt: np.ndarray) -> tuple:
         """(shared page ids, cow (src_page, tail_len) | None)."""
@@ -355,6 +364,47 @@ class RadixPrefixTree:
         else:
             parent.children.pop(key, None)
 
+    @staticmethod
+    def entry_tokens(node: _Node, key) -> tuple:
+        """The FULL token prefix an evictable entry caches, from the
+        root through ``key`` (a child-block tuple or a tail tuple under
+        ``node``) — the identity the ghost-tree regret ledger
+        (``observability/kvscope.py``) stamps at eviction so a later
+        admission of the same prefix is attributable to the eviction
+        that made it expensive."""
+        parts = []
+        while node is not None and node.key is not None:
+            parts.append(node.key)
+            node = node.parent
+        parts.reverse()
+        return tuple(t for k in parts for t in k) + tuple(key)
+
+    def oldest_entry_time(self) -> "float | None":
+        """Touch time of the oldest evictable entry (None without a
+        clock or an evictable entry) — ``now - this`` is the
+        eviction-pressure age ``PagePool.snapshot()`` surfaces. One
+        sort-free walk (snapshot runs on every health/readyz probe;
+        ``evictable()``'s sorted list would pay O(n log n) per probe)."""
+        best = None
+
+        def consider(n):
+            nonlocal best
+            if n.tstamp is not None and (best is None
+                                         or n.tstamp < best):
+                best = n.tstamp
+
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node.tails:
+                consider(node)
+            for child in node.children.values():
+                if not child.children and not child.tails:
+                    consider(child)
+                else:
+                    stack.append(child)
+        return best
+
     def __len__(self) -> int:
         n = 0
         stack = [self.root]
@@ -376,7 +426,7 @@ class PagePool:
     device syncs, zero compiled programs."""
 
     def __init__(self, pages: int, page_size: int, max_len: int,
-                 registry=None, prefix_sharing: bool = True):
+                 registry=None, prefix_sharing: bool = True, clock=None):
         if pages < 2:
             raise ValueError(f"page pool needs >= 2 pages (one is "
                              f"reserved scratch), got {pages}")
@@ -384,6 +434,11 @@ class PagePool:
         self.page_size = page_size
         self.pages_per_slot = max_len // page_size
         self.registry = registry
+        # injectable clock for eviction-pressure ages (oldest tree-entry
+        # age in snapshot()/health()); None (default) keeps the pool
+        # entirely clock-free — the engine passes one only when the
+        # kvscope residency observatory opted in
+        self.clock = clock
         self.free: list[int] = list(range(pages - 1, 0, -1))  # pop() -> 1..
         self.slot_refs = np.zeros(pages, np.int64)
         self.tree_refs = np.zeros(pages, bool)
@@ -395,13 +450,24 @@ class PagePool:
         # gate, so a deferred queue head re-runs the tree match/eviction
         # walk only when something actually changed
         self.generation = 0
-        # cumulative accounting (the capacity advisor's "achieved" side)
+        # eviction-stamp seam (observability/kvscope.py): called once
+        # per eviction EVENT with the evicted entries' token prefixes —
+        # the ghost-tree regret ledger's input. None (default) = one
+        # `is not None` per eviction pass, nothing else.
+        self.on_evict = None
+        # cumulative accounting (the capacity advisor's "achieved" side).
+        # `evictions` counts PAGES freed by tree eviction (the historical
+        # meaning, kept); `eviction_events` counts eviction PASSES — one
+        # admission under pressure is one event however many pages it
+        # reclaims. The two answer different questions (how much cache
+        # was lost vs how often pressure bites) and are reported apart.
         self.prefill_tokens_saved = 0
         self.prompt_tokens = 0
         self.shared_page_acquires = 0
         self.private_page_acquires = 0
         self.cow_copies = 0
-        self.evictions = 0
+        self.evictions = 0              # pages freed by eviction
+        self.eviction_events = 0        # eviction passes that freed > 0
         self.defers = 0
         self._publish()
 
@@ -457,6 +523,7 @@ class PagePool:
         if self.tree is None or need <= 0:
             return need <= 0
         freed = 0
+        ghosts = [] if self.on_evict is not None else None
         while freed < need:
             # leaf-first passes: dropping a leaf can expose its parent as
             # the next evictable entry, so re-snapshot until the need is
@@ -466,6 +533,13 @@ class PagePool:
                 if freed >= need:
                     break
                 if self.slot_refs[page] == 0 and self.tree_refs[page]:
+                    if ghosts is not None:
+                        # stamp the evicted block's identity BEFORE the
+                        # drop: the ghost ledger attributes the prefill
+                        # a later admission re-pays to THIS event
+                        ghosts.append({
+                            "tokens": self.tree.entry_tokens(parent, key),
+                            "block": len(key)})
                     self.tree.drop(kind, parent, key)
                     self.tree_refs[page] = False
                     self.free.append(page)
@@ -474,8 +548,16 @@ class PagePool:
                     progress = True
             if not progress:
                 break
-        if self.registry is not None and freed:
-            self.registry.counter("Serve/page_evictions").inc(freed)
+        if freed:
+            self.eviction_events += 1
+            if self.registry is not None:
+                # pages freed and eviction EVENTS are different signals:
+                # Serve/page_evictions keeps its historical pages-freed
+                # meaning, the event counter says how often pressure bit
+                self.registry.counter("Serve/page_evictions").inc(freed)
+                self.registry.counter("Serve/page_eviction_events").inc()
+            if ghosts:
+                self.on_evict(ghosts)
         return freed >= need
 
     def try_admit(self, prompt: np.ndarray, max_new: int,
@@ -494,6 +576,11 @@ class PagePool:
         saved would double-count the prefill replica's real savings."""
         prompt = np.asarray(prompt).reshape(-1)
         P, ps, n = len(prompt), self.page_size, self.pages_per_slot
+        if self.tree is not None and self.clock is not None:
+            # one clock read per admission: every node the walk touches
+            # gets this stamp, so entry AGES (eviction pressure) are
+            # reportable without a read per node
+            self.tree.now = self.clock()
         shared_ids, cow = (self.tree.match(prompt)
                            if self.tree is not None else ([], None))
         total_need = self.worst_case_pages(P, max_new)
@@ -581,6 +668,8 @@ class PagePool:
         alloc.registered = True
         self._release_cow(alloc)
         if self.tree is not None:
+            if self.clock is not None:
+                self.tree.now = self.clock()
             for page in self.tree.register(np.asarray(prompt), alloc.row):
                 self.tree_refs[page] = True
         self.generation += 1
@@ -614,8 +703,15 @@ class PagePool:
     # -------------------------------------------------------------- readout
     def snapshot(self) -> dict:
         """Flight-recorder provider + the capacity advisor's achieved
-        side: pool occupancy, sharing effectiveness, tree size."""
+        side: pool occupancy, sharing effectiveness, tree size, and the
+        eviction-pressure picture (evictable pages, oldest tree-entry
+        age — surfaced through health()/ /readyz)."""
         used = self.usable - len(self.free)
+        oldest_age = None
+        if self.tree is not None and self.clock is not None:
+            t = self.tree.oldest_entry_time()
+            if t is not None:
+                oldest_age = max(0.0, self.clock() - t)
         return {
             "pages": self.pages,
             "usable_pages": self.usable,
@@ -642,6 +738,13 @@ class PagePool:
             "prefix_hit_rate": self.prefix_hit_rate,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
+            # eviction pressure, disaggregated: how much cache was lost
+            # (pages) vs how often pressure bit (events), what could be
+            # reclaimed right now, and how stale the coldest entry is
+            "pages_evicted": self.evictions,
+            "eviction_events": self.eviction_events,
+            "evictable_pages": self.tree_held,
+            "oldest_tree_entry_age_s": oldest_age,
             "defers": self.defers,
             "prefix_sharing": self.tree is not None,
         }

@@ -215,7 +215,8 @@ class Scheduler:
     # -------------------------------------------------------------- intake
     def submit(self, prompt, max_new: int, seed: int = 0,
                ttft_deadline_s: Optional[float] = None,
-               total_deadline_s: Optional[float] = None) -> Request:
+               total_deadline_s: Optional[float] = None,
+               session_id=None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -246,7 +247,7 @@ class Scheduler:
             rid = self._next_rid
             self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new=int(max_new),
-                      seed=int(seed))
+                      seed=int(seed), session_id=session_id)
         self.queue.append(req)
         req.submit_t = self.stats.on_submit(len(self.queue))
         ttft = self.ttft_deadline_s if ttft_deadline_s is None \
