@@ -34,6 +34,13 @@ sleep 20
 # KV_RESIDENCY_BENCH.json (perf_ledger tracks regret/resume-TTFT
 # trajectories across PRs — the host-tier PR lands against them).
 python bench_kv_residency.py || { echo "[bench_all] kv residency failed"; fails=$((fails+1)); }
+sleep 20
+# Tiered host KV: demote-on-evict / restore-on-resume at 10x+ session
+# oversubscription — host-restore resume TTFT vs prefill recompute,
+# zero-regret A/B, achieved advisor rows merged into
+# KV_RESIDENCY_BENCH.json (must run AFTER bench_kv_residency: it
+# amends that artifact's host_tier section in place).
+python bench_host_kv.py || { echo "[bench_all] host kv failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

@@ -54,6 +54,18 @@ class ServingConfig:
     # point-of-use discipline applied to the cache). 0 = fp pool at the
     # engine compute dtype (the bit-parity path).
     kv_quant_bits: int = 0
+    # ---- tiered KV: pinned-host page store (serving/hostkv.py) ----
+    # host_pool_bytes > 0 (paged only) bounds a host-memory tier that
+    # keeps evicted tree-held pages instead of dropping them: eviction
+    # demotes full-block entries (data + int8 scale planes + the token
+    # prefix that keys them), admission consults the tier right after
+    # the radix-tree match, and matched cold prefixes restore by async
+    # H2D copy into the prefill cache — resume pays copy bandwidth, not
+    # recompute FLOPs. fp restore is bit-identical to recompute; lost/
+    # corrupt/pruned host copies degrade to recompute, never crash.
+    # 0 (default) builds no tier: one `is not None` per admission and
+    # per eviction pass, zero new programs (docs/SERVING.md).
+    host_pool_bytes: int = 0
     # engine-wide sampling policy (per-request RNG still makes every
     # request's draws independent of batch composition)
     temperature: float = 1.0
@@ -173,6 +185,13 @@ class ServingConfig:
         if self.kv_quant_bits and not self.page_size:
             raise ValueError("kv_quant_bits requires the paged KV cache "
                              "(set serving.page_size)")
+        if self.host_pool_bytes < 0:
+            raise ValueError(f"host_pool_bytes must be >= 0, "
+                             f"got {self.host_pool_bytes}")
+        if self.host_pool_bytes and not self.page_size:
+            raise ValueError("host_pool_bytes (the tiered host KV store) "
+                             "requires the paged KV cache (set "
+                             "serving.page_size)")
         for knob in ("ttft_deadline_s", "total_deadline_s", "watchdog_s"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"{knob} must be >= 0, "

@@ -199,6 +199,16 @@ def quantize_kv(x, axis: int = -1):
     return q, scale
 
 
+def dequantize_kv(q, scale, dtype, axis: int = -1):
+    """Inverse of :func:`quantize_kv` at the point of use — the ONE
+    spelling shared by the shared-prefix hydrate gather
+    (``serving/pages.py``) and the host-tier restore scatter
+    (``serving/hostkv.py``), so a page's bytes dequantize identically
+    whether they come from the live pool or from pinned host memory."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scale, axis)).astype(dtype)
+
+
 def _paged_append(ck, cv, ks, vs, k, v, page_table, new_len):
     """Append one decode token's K/V per slot into the page pool.
 

@@ -130,7 +130,8 @@ def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
                kv_quant_bits: int = 0,
                pages_used: Optional[int] = None,
                pages_free: Optional[int] = None,
-               idle_kv_bytes: Optional[int] = None) -> dict:
+               idle_kv_bytes: Optional[int] = None,
+               host_tier_bytes: Optional[int] = None) -> dict:
     """Decompose the HBM budget of a serving config into its components.
 
     ``params`` is the engine's (possibly WOQ-quantized) tree — weights
@@ -190,6 +191,11 @@ def hbm_ledger(*, params: Any, model_cfg, slots: int, max_len: int,
         # None when the residency observatory isn't running (older
         # reports simply lack the figure; null is the contract).
         "kv_idle_resident_bytes": idle_kv_bytes,
+        # ACHIEVED host tier (serving/hostkv.py): bytes of demoted KV
+        # the pinned-host store holds right now — the projected
+        # kv_idle_resident_bytes reclaim, realized. None when no tier
+        # is attached (serving.host_pool_bytes=0).
+        "kv_host_tier_bytes": host_tier_bytes,
     }
     if limit_bytes:
         free_for_kv = limit_bytes - weights - (temp_bytes or 0)
@@ -535,7 +541,7 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
     cbw = tk_est["copy_h2d_gbps"]
     pr = tk_est["prefill_tokens_per_s"]
     ptb = ks.get("per_token_bytes") or ledger.get("kv_per_token_bytes")
-    if not ks:
+    if not ks or not reg:
         why_tk = ("no KV residency observatory measured "
                   "(serving.kvscope off)")
     elif not regret_tokens:
@@ -564,6 +570,28 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                   f"advantage (host restore {restore_s:.3g}s vs prefill "
                   f"recompute {recompute_s:.3g}s per mean regretted "
                   "resume)")
+    ht = ks.get("host_tier") or {}
+    if ht.get("restores"):
+        # the tier is LIVE: report what it actually restored next to
+        # the projection. Remaining regret (the score's input) already
+        # excludes restored resumes — the lever demotes itself as the
+        # tier absorbs the traffic it was priced on.
+        tk_est["achieved"] = {
+            "host_tier_bytes": ht.get("bytes"),
+            "host_tier_pages": ht.get("pages"),
+            "restores": ht.get("restores"),
+            "restored_tokens": ht.get("restored_tokens"),
+            "restore_bytes": ht.get("restore_bytes"),
+            "restore_wait_s": ht.get("restore_wait_s"),
+            "restore_tokens_per_s": ht.get("restore_tokens_per_s"),
+            "hits": ht.get("hits"),
+            "misses": ht.get("misses"),
+            "prunes": ht.get("prunes"),
+            "fallbacks": ht.get("fallbacks"),
+        }
+        why_tk += ("; host tier ACTIVE — achieved restores reported "
+                   "alongside the projection (remaining regret scores "
+                   "what the tier still misses)")
     levers.append({"name": LEVER_TIERED_KV, "score": float(tk_score),
                    "estimate": tk_est, "why": why_tk})
 

@@ -505,6 +505,7 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
     kv = {k: v for k, v in vals.items()
           if k.startswith(("dstpu_serve_eviction_regret",
                            "dstpu_serve_kv_", "dstpu_serve_session",
+                           "dstpu_serve_host_tier",
                            "dstpu_fleet_affinity_regret",
                            "dstpu_fleet_resume_regret"))}
     if not kv:
@@ -520,9 +521,28 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
             ("dstpu_serve_session_resumed", "session_resumes"),
             ("dstpu_serve_session_regret_resumes", "regret_resumes"),
             ("dstpu_serve_session_idle_kv_byte_s", "idle_kv_byte_s"),
-            ("dstpu_fleet_affinity_regret", "fleet_affinity_regret")):
+            ("dstpu_fleet_affinity_regret", "fleet_affinity_regret"),
+            ("dstpu_serve_host_tier_pages", "host_tier_pages"),
+            ("dstpu_serve_host_tier_bytes", "host_tier_bytes"),
+            ("dstpu_serve_host_tier_occupancy", "host_tier_occupancy"),
+            ("dstpu_serve_host_tier_restores", "host_tier_restores"),
+            ("dstpu_serve_host_tier_restored_tokens",
+             "host_restored_tokens"),
+            ("dstpu_serve_host_tier_prunes", "host_tier_prunes"),
+            ("dstpu_serve_host_tier_fallbacks", "host_tier_fallbacks"),
+            ("dstpu_serve_session_host_restored_resumes",
+             "host_restored_resumes")):
         if key in kv:
             print(f"  {label:<24s} {_fmt(kv[key])}")
+    # host-tier verdict: restores without fallbacks is the tier working;
+    # pressure means the next demotion prunes cold history
+    if "dstpu_serve_host_tier_pages" in kv:
+        pressed = kv.get("dstpu_serve_host_tier_pressure")
+        fb = kv.get("dstpu_serve_host_tier_fallbacks") or 0
+        verdict = ("DEGRADED: lost/corrupt host copies" if fb
+                   else "under pressure (next demotion prunes)"
+                   if pressed else "clean")
+        print(f"  host tier verdict: {verdict}")
     # hottest evicted sessions + the lever verdict come from the newest
     # capacity report's kvscope section (per-session data never lands in
     # the scalar exposition)
@@ -562,6 +582,15 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
             f"runaway eviction regret in {prom.name}: regret_frac "
             f"{_fmt(frac)} > {regret_max:g} — the KV pool is thrashing; "
             "see the tiered_kv lever / host-tier sizing runbook")
+    fb = kv.get("dstpu_serve_host_tier_fallbacks")
+    if isinstance(fb, (int, float)) and fb > 0:
+        print(f"  HOST-TIER FALLBACKS: {_fmt(fb)} lost/corrupt host "
+              "copies degraded to recompute")
+        findings.append(
+            f"host-tier fallbacks in {prom.name}: {_fmt(fb)} demoted KV "
+            "copies failed verification and were recomputed — host "
+            "memory corruption or a torn demotion; serving degraded "
+            "safely but the tier is not trustworthy")
     return findings
 
 

@@ -213,6 +213,8 @@ class KVScope:
         self.ghost_overflow = 0
         self.ghost_hits = 0
         self.stale_ghost_hits = 0       # ghost for a block the tree re-holds
+        self.restored_ghost_hits = 0    # ghost popped by a host-tier restore
+        self.host_restored_resumes = 0  # resumes served from the host tier
         # per-eviction-event attribution, bounded
         self._events: OrderedDict = OrderedDict()
         self._event_seq = 0
@@ -267,17 +269,22 @@ class KVScope:
 
     # ------------------------------------------------------------ admission
     def _probe_ghosts(self, prompt: np.ndarray, shared: int, skip: int,
-                      now: float) -> int:
+                      now: float, restored: int = 0) -> int:
         """Match the prompt's block boundaries against the ghost list
         and return the regret: re-paid prefill tokens this admission
         owes to past evictions. A hit at block ``b < shared`` means the
         tree holds that block again (a later registration) — the ghost
-        is stale, dropped without regret. The total is capped at the
-        tokens the admission actually recomputes (``P - 1 - skip``: even
-        a fully live tree re-runs the final token's forward)."""
+        is stale, dropped without regret. A hit at ``shared <= b <
+        shared + restored`` is a block the host tier restored
+        (serving/hostkv.py): the resume paid copy bytes, not prefill —
+        the ghost pops WITHOUT booking regret tokens. The total is
+        capped at the tokens the admission actually recomputes
+        (``P - 1 - skip``: even a fully live tree re-runs the final
+        token's forward)."""
         P = len(prompt)
         cap = max(0, P - 1 - skip)
-        if not self.ghosts or not self.page_size or cap == 0:
+        if not self.ghosts or not self.page_size \
+                or (cap == 0 and not restored):
             return 0
         hits = []
         for b, (length, h) in enumerate(
@@ -288,7 +295,16 @@ class KVScope:
             if b < shared:
                 self.stale_ghost_hits += 1
                 continue
+            if b < shared + restored:
+                self.restored_ghost_hits += 1
+                self.registry.counter(
+                    "Serve/kv_restored_ghost_hits").inc()
+                continue
             hits.append(g)
+        if cap == 0:
+            self.registry.gauge("Serve/kv_ghost_entries").set(
+                float(len(self.ghosts)))
+            return 0
         if P % self.page_size:
             g = self.ghosts.pop((P, token_hash(prompt)), None)
             if g is not None:
@@ -322,8 +338,10 @@ class KVScope:
         alloc = getattr(req, "page_alloc", None)
         shared = alloc.shared if alloc is not None else 0
         skip = alloc.skip if alloc is not None else 0
+        restored = getattr(alloc, "restored", 0) if alloc is not None else 0
         self.prefill_tokens_paid += P - skip
-        regret = self._probe_ghosts(prompt, shared, skip, t)
+        regret = self._probe_ghosts(prompt, shared, skip, t,
+                                    restored=restored)
         r = self.registry
         if regret:
             self.regret_tokens += regret
@@ -333,11 +351,13 @@ class KVScope:
         if self.prefill_tokens_paid:
             r.gauge("Serve/eviction_regret_frac").set(
                 self.regret_tokens / self.prefill_tokens_paid)
-        resumed = self._session_admit(req, P, t, regret)
+        resumed = self._session_admit(req, P, t, regret,
+                                      restored=restored)
         return {"regret_tokens": regret, "resumed": resumed,
-                "prompt_len": P, "skip": skip}
+                "restored_blocks": restored, "prompt_len": P, "skip": skip}
 
-    def _session_admit(self, req, P: int, t: float, regret: int) -> bool:
+    def _session_admit(self, req, P: int, t: float, regret: int,
+                       restored: int = 0) -> bool:
         sid = getattr(req, "session_id", None)
         if sid is None:
             return False
@@ -365,6 +385,12 @@ class KVScope:
                 r.counter("Serve/session_regret_resumes").inc()
                 if self.on_regret_resume is not None:
                     self.on_regret_resume(sid, regret)
+            if restored:
+                # the resume the host tier SAVED: its evicted prefix
+                # came back at copy bandwidth — a hit, not a regret
+                # (the fleet's affinity-regret ledger must not count it)
+                self.host_restored_resumes += 1
+                r.counter("Serve/session_host_restored_resumes").inc()
             if self.spans is not None and s.idle_since is not None:
                 from . import spans as S
 
@@ -534,6 +560,7 @@ class KVScope:
                 "mean_regret_tokens": mean_regret,
                 "ghost_hits": self.ghost_hits,
                 "stale_ghost_hits": self.stale_ghost_hits,
+                "restored_ghost_hits": self.restored_ghost_hits,
             },
             "ghosts": {
                 "entries": len(self.ghosts),
@@ -554,6 +581,7 @@ class KVScope:
                 "started": self.sessions_started,
                 "resumed": self.sessions_resumed,
                 "regret_resumes": self.regret_resumes,
+                "host_restored_resumes": self.host_restored_resumes,
                 "finalized": self.sessions_finalized,
                 "idle_kv_tokens_now": idle_tokens_now,
                 "idle_kv_bytes_now": (idle_tokens_now * ptb

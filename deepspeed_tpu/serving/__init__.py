@@ -13,6 +13,7 @@ from ..resilience.guards import PagePoolExhausted, QueueFullError, \
     RequestStatus
 from .engine import ServingEngine
 from .fleet import FleetEngine
+from .hostkv import HostKVTier
 from .pages import (PagePool, RadixPrefixTree, export_slot, import_slot,
                     init_paged_slots)
 from .scheduler import ChunkPlan, Request, Scheduler, plan_chunks
@@ -21,5 +22,5 @@ from .slots import init_slots, insert_request
 __all__ = ["ServingEngine", "FleetEngine", "Scheduler", "Request",
            "ChunkPlan", "plan_chunks", "init_slots", "insert_request",
            "PagePool", "RadixPrefixTree", "init_paged_slots",
-           "export_slot", "import_slot",
+           "export_slot", "import_slot", "HostKVTier",
            "RequestStatus", "QueueFullError", "PagePoolExhausted"]

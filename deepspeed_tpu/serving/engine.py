@@ -215,6 +215,30 @@ class ServingEngine:
                 # stall dumps show the pool at the moment of the stall
                 self.flight.add_snapshot_provider("pages",
                                                   self.pool.snapshot)
+        # tiered host KV store (serving/hostkv.py, docs/SERVING.md):
+        # eviction demotes cold tree-held pages to bounded pinned host
+        # memory; admission restores matched cold prefixes by async H2D
+        # copy instead of recompute. None (default) builds nothing —
+        # one `is not None` per admission and per eviction pass, zero
+        # new programs (the compile-freeze gates stay the oracle); ON it
+        # adds exactly two fixed-shape programs (demote gather, restore
+        # scatter) to the bounded set.
+        self.hostkv = None
+        # demote gathers dispatched this iteration, materialized to the
+        # tier at the end of step() — see _demote_pages/_drain_demotes
+        self._pending_demotes: list = []
+        if self._paged and self.cfg.host_pool_bytes > 0:
+            from .hostkv import HostKVTier
+
+            self.hostkv = HostKVTier(self.cfg.host_pool_bytes,
+                                     self.cfg.page_size,
+                                     registry=self.stats.registry,
+                                     clock=self.stats.clock)
+            self.pool.host = self.hostkv
+            self.pool.on_demote = self._demote_pages
+            if self.flight is not None:
+                self.flight.add_snapshot_provider("host_kv",
+                                                  self.hostkv.snapshot)
         # KV residency observatory (observability/kvscope.py,
         # docs/OBSERVABILITY.md): ghost-tree eviction-regret ledger on
         # the page pool + per-session lifecycle heat tracking + the
@@ -553,6 +577,12 @@ class ServingEngine:
                         cache = hyd(self._state, cache,
                                     jnp.asarray(alloc.hydrate_row),
                                     jnp.int32(alloc.hydrate_pages))
+                    if alloc is not None and alloc.restored:
+                        # host-tier restore: the pending-restore lane
+                        # beside the prefill lane — scatter the cold
+                        # blocks' tiles into the prefill cache; the
+                        # suffix chunks dispatched next overlap the H2D
+                        cache = self._restore_dispatch(cache, alloc)
                     self._prefill = (req, self.sched.plan(req), 0, cache,
                                      per_request_keys([req.seed]))
             # prefill lane: one bucket-shaped chunk per iteration
@@ -633,6 +663,10 @@ class ServingEngine:
                     finished += self.sched.retire_nonfinite(bad)
                 finished += self.sched.on_step(toks, dones)
                 ran_decode = True
+        if self._pending_demotes:
+            # off the TTFT path: the gathers dispatched at admission
+            # land in the host tier after this iteration's device work
+            self._drain_demotes()
         self.stats.on_iteration(self.sched.queue_depth, self.sched.occupancy,
                                 self.cfg.slots, ran_chunk, ran_decode)
         if self.spans is not None:
@@ -782,6 +816,91 @@ class ServingEngine:
             # spends a decode step on a handed-off request
             self.on_placed(req, slot)
         return []
+
+    # ------------------------------------------------------ tiered host KV
+    def _demote_pages(self, entries: list) -> None:
+        """``PagePool.on_demote`` handler: DISPATCH a gather of the
+        evicted full-block pages' tiles (K, V, int8 scale planes) with
+        ONE fixed-shape program (row padded with the scratch page) and
+        queue the result for host materialization at the END of this
+        iteration (:meth:`_drain_demotes`). Dispatching here pins the
+        ordering — the gather reads the pages before any later-dispatched
+        insert can rewrite them (XLA executes in dispatch order and
+        honors pending reads across donation) — while the blocking
+        ``device_get``, the CRC stamp, and the host copies stay OFF the
+        admission path, so demotion never bills the resuming request's
+        TTFT."""
+        from .hostkv import demote_rows
+
+        n = self.pool.pages_per_slot
+        for off in range(0, len(entries), n):
+            batch = entries[off:off + n]
+            row = np.zeros(n, np.int32)
+            row[:len(batch)] = [e["page"] for e in batch]
+            prog = self._prog("demote", lambda: jax.jit(demote_rows))
+            with self.engine.mesh:
+                self._pending_demotes.append(
+                    (prog(self._state, jnp.asarray(row)), batch))
+
+    def _drain_demotes(self) -> None:
+        """Materialize this iteration's dispatched demote gathers into
+        the host tier (one blocking ``device_get`` per eviction event —
+        by now the gather has usually completed under the iteration's
+        other device work). Runs at the end of every ``step()``; the
+        transient device residency is bounded by one gather output per
+        eviction event of one iteration."""
+        pending, self._pending_demotes = self._pending_demotes, []
+        for out, batch in pending:
+            tiles = jax.device_get(out)
+            for i, e in enumerate(batch):
+                self.hostkv.put(e["tokens"],
+                                {k: np.ascontiguousarray(v[:, i])
+                                 for k, v in tiles.items()})
+
+    def _restore_dispatch(self, cache, alloc):
+        """Scatter one admission's host-restored tiles into its prefill
+        cache pages ``[shared, shared + restored)`` — in up to two
+        fixed-shape batches, so the second H2D upload overlaps the first
+        batch's device write (double-buffered), and the whole restore
+        overlaps the unshared-suffix chunk programs dispatched right
+        after (async dispatch, no host sync here). The cache then flows
+        through the SAME chunk-plan → ``insert_paged`` path as a tree
+        hit. The measured dispatch window is honest on CPU and a lower
+        bound where the scatter overlaps the async device queue."""
+        from .hostkv import restore_into_cache
+
+        t0 = self.stats.clock()
+        n = self.pool.pages_per_slot
+        R = max(1, (n + 1) // 2)          # batch size: <= 2 dispatches
+        tiles = alloc.restore_tiles
+        prog = self._prog("restore", lambda: jax.jit(
+            restore_into_cache, donate_argnums=(0,)))
+        off = 0
+        while off < alloc.restored:
+            cnt = min(R, alloc.restored - off)
+            batch = {}
+            for key, v in tiles.items():
+                pad = np.zeros(v.shape[:1] + (R,) + v.shape[2:], v.dtype)
+                pad[:, :cnt] = v[:, off:off + cnt]
+                batch[key] = jnp.asarray(pad)
+            cache = prog(cache, batch, jnp.int32(alloc.shared + off),
+                         jnp.int32(cnt))
+            off += cnt
+        self.hostkv.on_restore(self.stats.clock() - t0,
+                               pages=alloc.restored,
+                               tokens=alloc.restore_tokens,
+                               nbytes=alloc.restore_bytes)
+        alloc.restore_tiles = None        # the payload is on device now
+        return cache
+
+    def prefix_residency(self, prompt) -> tuple:
+        """``(tree_blocks, host_blocks)`` of ``prompt``'s leading full
+        blocks resident on THIS engine — the fleet router's affinity
+        input (tree hit ranks above host-tier hit ranks above miss).
+        ``(0, 0)`` on the contiguous engine. Read-only."""
+        if not self._paged:
+            return (0, 0)
+        return self.pool.residency(np.asarray(prompt, np.int32))
 
     def begin_drain(self) -> None:
         """Graceful drain mode: stop ADMITTING new submits (they shed with
@@ -1019,6 +1138,23 @@ class ServingEngine:
                 "Serve/page_pool_tree_held": float(ps["tree_held_pages"]),
                 "Serve/page_pool_pressure": float(pressure),
             })
+        if self.hostkv is not None:
+            # host-tier occupancy + pressure through /readyz, beside the
+            # device pool's eviction-pressure fields: a full tier means
+            # the next demotion starts pruning cold history (regret
+            # creeps back) — ops sees it before the regret ledger does
+            hs = self.hostkv.snapshot()
+            out["host_tier"] = {
+                "pages": hs["pages"],
+                "bytes": hs["bytes"],
+                "capacity_bytes": hs["capacity_bytes"],
+                "occupancy": hs["occupancy"],
+                "pressure": hs["pressure"],
+                "restores": hs["restores"],
+                "prunes": hs["prunes"],
+                "fallbacks": hs["fallbacks"],
+            }
+            # snapshot() already refreshed the Serve/host_tier_* gauges
         self.stats.registry.set_gauges(gauges)
         return out
 
@@ -1153,10 +1289,18 @@ class ServingEngine:
         host↔device copy-bandwidth probe and the span ring's measured
         prefill throughput. None when kvscope is off."""
         if self.kvscope is None:
-            return None
+            if self.hostkv is None:
+                return None
+            # no observatory, but the tier's achieved side still reports
+            return {"enabled": False, "host_tier": self.hostkv.snapshot()}
         snap = self.kvscope.snapshot()
         snap["copy_bandwidth"] = self.kvscope.copy_bandwidth()
         snap["prefill"] = self._prefill_rate()
+        if self.hostkv is not None:
+            # the ACHIEVED side of the tiered_kv lever: what the host
+            # tier actually restored, at what measured rate — reported
+            # next to the advisor's projection (observability/capacity.py)
+            snap["host_tier"] = self.hostkv.snapshot()
         return snap
 
     def hbm_ledger(self, temp_bytes: Optional[int] = None) -> dict:
@@ -1181,6 +1325,9 @@ class ServingEngine:
             # the host-tier row: bytes reclaimable by demoting idle
             # sessions' tree-held pages at the measured idle distribution
             paged_kw["idle_kv_bytes"] = self.kvscope.idle_kv_bytes()
+        if self.hostkv is not None:
+            # achieved: host bytes the tier holds right now
+            paged_kw["host_tier_bytes"] = self.hostkv.bytes_used
         return hbm_ledger(
             params=self.engine.params, model_cfg=self.model.cfg,
             slots=self.cfg.slots, max_len=self.cfg.max_len,

@@ -21,9 +21,15 @@ are per-replica). What the fleet adds:
   single engine's drain.
 - **Session affinity** — requests carrying a ``session_id`` stick to
   the replica whose radix tree already holds their prefix (that is
-  where their prefill is nearly free). When the sticky replica is
+  where their prefill is nearly free). Routing is residency-ranked:
+  tree hit > host-tier hit (the prefix was evicted but demoted to the
+  replica's pinned-host store, ``serving/hostkv.py``) > cold miss, so
+  a session falls back to the replica that can restore at copy
+  bandwidth before one that must recompute. When the sticky replica is
   unhealthy the router falls back to policy and records the move in
-  ``Fleet/affinity_misses``.
+  ``Fleet/affinity_misses``; a resume the sticky replica restores from
+  its host tier books NO ``Fleet/affinity_regret`` (it paid copy
+  bytes, not prefill — that is the host tier doing its job).
 - **Replica loss/join** — ``remove_replica`` / a chaos kill requeues
   the victim's queued and in-flight requests onto survivors with a
   typed ``REQUEUED`` transition and a bumped ``Request.attempts`` (zero
@@ -555,7 +561,11 @@ class FleetEngine:
                 {"name": i["name"], "healthy": i["healthy"],
                  "reasons": list(i["reasons"]),
                  "load": i["load"], "burn": i["burn"],
-                 "goodput": i["goodput"]}
+                 "goodput": i["goodput"],
+                 # residency class when the router probed it (session
+                 # routes): 0 tree hit / 1 host-tier hit / 2 cold
+                 **({"residency": i["residency"]}
+                    if "residency" in i else {})}
                 for i in candidates],
         }
         if lost_replica:
@@ -575,13 +585,21 @@ class FleetEngine:
             return entries
         return [e for e in entries if e.get("rid") == rid]
 
-    def _route(self, role: str, session_id=None, exclude=()) \
-            -> "tuple[str, dict]":
+    def _route(self, role: str, session_id=None, exclude=(),
+               prompt=None) -> "tuple[str, dict]":
         """Pick the admission target; raises a typed shed when no
         replica of ``role`` is accepting (all draining/removed).
         Returns ``(name, decision)`` — the decision dict carries the
         ranked candidates and the affinity outcome so :meth:`submit`
-        can write ONE audit entry once the rid exists."""
+        can write ONE audit entry once the rid exists.
+
+        Session routing is RESIDENCY-ranked: among healthy candidates a
+        replica whose radix tree holds the prompt's prefix ranks first,
+        one whose HOST TIER holds it (evicted but demoted —
+        serving/hostkv.py) ranks between tree hit and miss, policy
+        (least-loaded) breaks the ties. The sticky replica still wins
+        while healthy (it usually IS the tree hit); the ranking decides
+        fallbacks and first routes, via read-only residency probes."""
         infos = self._ranked(role, exclude=exclude, admission=False)
         eligible = [i for i in infos if not i["draining"]]
         if not eligible:
@@ -599,12 +617,31 @@ class FleetEngine:
         sticky = None
         if session_id is not None:
             sticky = self._session.get((role, session_id))
+            si = by_name.get(sticky) if sticky is not None else None
+            sticky_ok = si is not None and si["healthy"]
+            if not sticky_ok and prompt is not None:
+                # no usable sticky replica: residency-rank the healthy
+                # candidates (read-only probes — and ONLY on this
+                # fallback/first-route path; a healthy sticky replica
+                # wins below without paying the per-replica walks)
+                healthy = [i for i in eligible if i["healthy"]]
+                for i in healthy:
+                    tb, hb = self.replicas[i["name"]] \
+                        .prefix_residency(prompt)
+                    # 0 = tree hit, 1 = host-tier hit, 2 = cold miss
+                    i["residency"] = 0 if tb else (1 if hb else 2)
+                if healthy:
+                    best = min(healthy,
+                               key=lambda i: (i["residency"], i["load"],
+                                              i["burn"], -i["goodput"],
+                                              i["name"]))
+                    choice = best["name"]
             if sticky is not None:
                 # stick when the sticky replica is routable AND healthy;
                 # otherwise fall back to policy and record the miss (the
-                # prefix will be rebuilt at the new home)
-                si = by_name.get(sticky)
-                if si is not None and si["healthy"]:
+                # prefix will be rebuilt — or host-restored — at the new
+                # home the residency ranking above picked)
+                if sticky_ok:
                     choice = sticky
                 if choice == sticky:
                     affinity = "hit"
@@ -633,7 +670,7 @@ class FleetEngine:
         while True:
             try:
                 name, decision = self._route(role, session_id=session_id,
-                                             exclude=tried)
+                                             exclude=tried, prompt=prompt)
             except QueueFullError:
                 if last is not None:
                     raise last
@@ -1004,9 +1041,14 @@ class FleetEngine:
         snapshot plus the affinity-aware regret counters only the
         router can attribute. None when no replica runs the observatory
         (``serving.kvscope`` off)."""
-        per = {n: e.kvscope.snapshot()
-               for n, e in self.replicas.items()
-               if e.kvscope is not None}
+        per = {}
+        for n, e in self.replicas.items():
+            if e.kvscope is None:
+                continue
+            s = e.kvscope.snapshot()
+            if e.hostkv is not None:
+                s["host_tier"] = e.hostkv.snapshot()
+            per[n] = s
         if not per:
             return None
         c = self.registry.snapshot()["counters"]
@@ -1019,6 +1061,15 @@ class FleetEngine:
                                     for s in per.values()),
             "regret_resumes": sum(s["sessions"]["regret_resumes"]
                                   for s in per.values()),
+            "host_restored_resumes": sum(
+                s["sessions"].get("host_restored_resumes", 0)
+                for s in per.values()),
+            "host_tier_restores": sum(
+                (s.get("host_tier") or {}).get("restores", 0)
+                for s in per.values()),
+            "host_tier_bytes": sum(
+                (s.get("host_tier") or {}).get("bytes", 0)
+                for s in per.values()),
         }
         totals["regret_frac"] = (
             totals["regret_tokens"] / totals["prefill_tokens_paid"]

@@ -21,6 +21,14 @@ The host half lives here too:
   copy-on-write site — its source page is gathered into the request's
   prefill cache (``hydrate``) and written back to a FRESH private page
   at insert, so the donor's page is never mutated.
+- tiered host store (``serving/hostkv.py``, ``host`` / ``on_demote``
+  seams) — eviction demotes full-block tree entries to pinned host
+  memory instead of dropping them, and admission consults the tier
+  right after the radix-tree match: matched cold blocks restore at copy
+  bandwidth (their tokens join ``skip``) instead of recompute FLOPs.
+  ``prefill_tokens_saved`` counts restored tokens too — it is the
+  "tokens not recomputed" truth; the tier's own counters split out
+  what was paid in copy bytes.
 - admission math — a request's worst-case page need assumes zero
   sharing (shared pages can be evicted from under the queue), so a
   request the pool can NEVER hold sheds with a typed
@@ -46,7 +54,7 @@ import numpy as np
 from jax import lax
 
 from ..inference.decode import GenCarry, PagedKVCache, cache_layout, \
-    quantize_kv
+    dequantize_kv, quantize_kv
 from ..resilience.guards import PagePoolExhausted
 
 __all__ = ["PagePool", "RadixPrefixTree", "PageAllocation",
@@ -153,10 +161,8 @@ def hydrate_cache(state: GenCarry, cache, hydrate_row, count):
     n = hydrate_row.shape[0]
     gk, gv = c.k[:, hydrate_row], c.v[:, hydrate_row]  # (L, n, KV, ps, hd)
     if c.k_scale is not None:
-        sk = c.k_scale[:, hydrate_row][..., None]
-        sv = c.v_scale[:, hydrate_row][..., None]
-        gk = (gk.astype(jnp.float32) * sk).astype(cache.k.dtype)
-        gv = (gv.astype(jnp.float32) * sv).astype(cache.v.dtype)
+        gk = dequantize_kv(gk, c.k_scale[:, hydrate_row], cache.k.dtype)
+        gv = dequantize_kv(gv, c.v_scale[:, hydrate_row], cache.v.dtype)
     else:
         gk = gk.astype(cache.k.dtype)
         gv = gv.astype(cache.v.dtype)
@@ -248,6 +254,16 @@ class PageAllocation:
     cow: bool = False           # a partially-matched tail page was copied
     cow_src: Optional[int] = None   # donor page pinned until insert/abort
     registered: bool = False
+    # host-tier restore plan (serving/hostkv.py): ``restored`` cold
+    # blocks continue the tree match from pinned host memory — their
+    # tiles ride here to the engine's restore scatter, their tokens are
+    # counted into ``skip`` (restored, not recomputed), and their pages
+    # are ordinary private pages that ``insert_paged`` overwrites and
+    # ``on_inserted`` registers into the tree like any other prefill.
+    restored: int = 0
+    restore_tiles: Optional[dict] = None
+    restore_tokens: int = 0
+    restore_bytes: int = 0
 
 
 class _Node:
@@ -315,6 +331,22 @@ class RadixPrefixTree:
                     and (cow is None or len(tail) > cow[1]):
                 cow = (page, len(tail))
         return ids, cow
+
+    def peek_blocks(self, toks: np.ndarray) -> int:
+        """Leading full blocks of ``toks`` the tree holds, WITHOUT
+        touching LRU stamps — :meth:`match`'s walk minus its side
+        effects, for read-only probes (the fleet router's residency
+        ranking must not distort eviction order on replicas it only
+        considered)."""
+        ps = self.page_size
+        node, i, n = self.root, 0, 0
+        while i + ps <= len(toks):
+            child = node.children.get(tuple(toks[i:i + ps].tolist()))
+            if child is None:
+                break
+            n += 1
+            node, i = child, i + ps
+        return n
 
     def register(self, prompt: np.ndarray, row: np.ndarray) -> list:
         """Index a just-inserted request's prompt blocks: full blocks as
@@ -455,6 +487,16 @@ class PagePool:
         # the ghost-tree regret ledger's input. None (default) = one
         # `is not None` per eviction pass, nothing else.
         self.on_evict = None
+        # tiered-KV seams (serving/hostkv.py): ``host`` is the engine's
+        # HostKVTier — admission consults it right after the radix-tree
+        # match and restores matched cold blocks instead of recomputing
+        # them; ``on_demote`` is called during an eviction pass with the
+        # evicted FULL-BLOCK entries (page id + token prefix) BEFORE
+        # their pages can be reused, so the engine can gather the tiles
+        # to host. Both None (default) = one `is not None` per
+        # admission/eviction, nothing else.
+        self.host = None
+        self.on_demote = None
         # cumulative accounting (the capacity advisor's "achieved" side).
         # `evictions` counts PAGES freed by tree eviction (the historical
         # meaning, kept); `eviction_events` counts eviction PASSES — one
@@ -524,6 +566,7 @@ class PagePool:
             return need <= 0
         freed = 0
         ghosts = [] if self.on_evict is not None else None
+        demote = [] if self.on_demote is not None else None
         while freed < need:
             # leaf-first passes: dropping a leaf can expose its parent as
             # the next evictable entry, so re-snapshot until the need is
@@ -540,6 +583,15 @@ class PagePool:
                         ghosts.append({
                             "tokens": self.tree.entry_tokens(parent, key),
                             "block": len(key)})
+                    if demote is not None and kind == "node":
+                        # demote-on-evict: full blocks carry a complete
+                        # page of KV worth keeping; partial tails stay
+                        # ghost-only (copy-on-write sources are cheap
+                        # to recompute and block-granular keys keep the
+                        # tier's restore walk trivial)
+                        demote.append({
+                            "tokens": self.tree.entry_tokens(parent, key),
+                            "page": int(page), "block": len(key)})
                     self.tree.drop(kind, parent, key)
                     self.tree_refs[page] = False
                     self.free.append(page)
@@ -556,6 +608,13 @@ class PagePool:
                 # meaning, the event counter says how often pressure bit
                 self.registry.counter("Serve/page_evictions").inc(freed)
                 self.registry.counter("Serve/page_eviction_events").inc()
+            if demote:
+                # BEFORE the freed pages can be popped for reuse: the
+                # engine's handler DISPATCHES the tile gather here (so
+                # it is ordered ahead of any program that could rewrite
+                # the pages) and materializes it to host at the end of
+                # the iteration, off the admission path
+                self.on_demote(demote)
             if ghosts:
                 self.on_evict(ghosts)
         return freed >= need
@@ -597,8 +656,25 @@ class PagePool:
         shared = min(len(shared_ids), total_need)
         shared_ids = shared_ids[:shared]
         skip = shared * ps
-        cow_src, cow_len = (cow if cow is not None and cow[1] > 0
-                            and skip + cow[1] < P else (None, 0))
+        # host-tier restore plan (serving/hostkv.py): cold full blocks
+        # CONTINUING the tree match, pinned in the tier until this
+        # allocation commits (consume) or defers (release). Their pages
+        # are ordinary private pages; only ``skip`` and the tile payload
+        # distinguish a restore from a recompute. The disaggregated
+        # import path (book_savings=False) seats already-computed KV and
+        # must not burn host copies it would never read.
+        restore_keys: list = []
+        if self.host is not None and book_savings:
+            restore_keys = self.host.match(
+                prompt, start_block=shared,
+                max_blocks=total_need - shared)
+        restored = len(restore_keys)
+        skip += restored * ps
+        # a restored full block covers any copy-on-write tail at the
+        # same position — cow only applies to an unrestored admission
+        cow_src, cow_len = (cow if restored == 0 and cow is not None
+                            and cow[1] > 0 and skip + cow[1] < P
+                            else (None, 0))
         private_need = total_need - shared
         # pin the matched pages BEFORE any eviction pass: a tree-only
         # page we are about to share must not be reclaimed to cover the
@@ -613,6 +689,9 @@ class PagePool:
                 self._unref(p)
             if cow_src is not None:
                 self._unref(cow_src)
+            if restore_keys:
+                # the cold blocks stay restorable for the retry
+                self.host.release(restore_keys)
             self.defers += 1
             if self.registry is not None:
                 self.registry.counter("Serve/page_defers").inc()
@@ -638,10 +717,18 @@ class PagePool:
                 if self.registry is not None:
                     self.registry.counter("Serve/page_cow_copies").inc()
         skip = min(skip, P - 1)
+        tiles, rbytes, rtoks = None, 0, 0
+        if restore_keys:
+            # commit point: the pinned host copies move onto the
+            # allocation (the engine scatters them into the prefill
+            # cache before the suffix chunks run)
+            tiles, rbytes, rtoks = self.host.consume(restore_keys)
         alloc = PageAllocation(
             rid=rid, row=row, pages=total_need, shared=shared, skip=skip,
             hydrate_row=hyd, hydrate_pages=hydrate_pages,
-            cow=cow_src is not None, cow_src=cow_src)
+            cow=cow_src is not None, cow_src=cow_src,
+            restored=restored, restore_tiles=tiles,
+            restore_tokens=rtoks, restore_bytes=rbytes)
         self._alloc[rid] = alloc
         if book_savings:
             self.prompt_tokens += P
@@ -701,6 +788,20 @@ class PagePool:
         self._publish()
 
     # -------------------------------------------------------------- readout
+    def residency(self, prompt: np.ndarray) -> tuple:
+        """``(tree_blocks, host_blocks)`` holding ``prompt``'s leading
+        full blocks right now — a READ-ONLY probe for the fleet
+        router's affinity ranking (tree hit > host-tier hit > miss):
+        no LRU touches, no refcounts, no pins, so routing a session
+        cannot distort eviction order on replicas it only considered."""
+        if self.tree is None:
+            return (0, 0)
+        toks = np.asarray(prompt).reshape(-1)
+        tree_blocks = self.tree.peek_blocks(toks)
+        host_blocks = (self.host.peek_blocks(toks, tree_blocks)
+                       if self.host is not None else 0)
+        return tree_blocks, host_blocks
+
     def snapshot(self) -> dict:
         """Flight-recorder provider + the capacity advisor's achieved
         side: pool occupancy, sharing effectiveness, tree size, and the
@@ -747,4 +848,8 @@ class PagePool:
             "oldest_tree_entry_age_s": oldest_age,
             "defers": self.defers,
             "prefix_sharing": self.tree is not None,
+            # the tiered host store's occupancy/traffic picture (None
+            # when no host tier is attached — serving.host_pool_bytes=0)
+            "host_tier": (self.host.snapshot()
+                          if self.host is not None else None),
         }

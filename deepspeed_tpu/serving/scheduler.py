@@ -191,8 +191,13 @@ class Scheduler:
         self.max_queue = max_queue
         self.eos_token_id = eos_token_id
         # paged-KV pool (serving/pages.py PagePool): admission consults
-        # the prefix tree and takes page refs; every terminal path
-        # releases them. None = contiguous slot cache, nothing paged.
+        # the prefix tree — and, when a host tier is attached
+        # (serving/hostkv.py), the pinned-host cold store right after
+        # it — and takes page refs; every terminal path releases them.
+        # A restored admission's plan() shrinks exactly like a tree
+        # hit's (skip covers the restored blocks); the engine scatters
+        # the host tiles before the first chunk runs. None = contiguous
+        # slot cache, nothing paged.
         self.pages = pages
         self._defer_key = None   # (rid, pool generation) of a failed admit
         self.stats = stats if stats is not None else ServingStats()
@@ -553,6 +558,13 @@ class Scheduler:
                 # so far (the rest null) — /requests shows where an
                 # in-flight request's time is going
                 "trace": hop_trace(req),
+                # tiered-KV visibility: how much of this request's
+                # prefix came from the pool/host tier instead of
+                # recompute (0 without a page allocation)
+                "skip_tokens": (req.page_alloc.skip
+                                if req.page_alloc is not None else 0),
+                "restored_pages": (getattr(req.page_alloc, "restored", 0)
+                                   if req.page_alloc is not None else 0),
             }
 
         rows = []
