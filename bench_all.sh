@@ -41,6 +41,14 @@ sleep 20
 # KV_RESIDENCY_BENCH.json (must run AFTER bench_kv_residency: it
 # amends that artifact's host_tier section in place).
 python bench_host_kv.py || { echo "[bench_all] host kv failed"; fails=$((fails+1)); }
+sleep 20
+# Quantized + overlapped collectives: bucketed-overlap int8 grad wire
+# vs the fused fp spelling (step time + exposed fraction + wire ratio)
+# and the int8 TP decode collective (tokens/s + greedy parity) into
+# OVERLAP_BENCH.json, plus on/off commscope rows amended into
+# COMMSCOPE_BENCH.json and the newest MULTICHIP round (must run AFTER
+# bench_commscope: it annotates that artifact in place).
+python bench_overlap.py || { echo "[bench_all] overlap failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

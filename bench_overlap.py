@@ -1,0 +1,499 @@
+"""Quantized + overlapped collectives bench: the thing commscope priced.
+
+Full mode (bench_all chain, TPU with CPU fallback): train the fused-fp
+grad spelling vs the bucketed-overlap int8 spelling and measure step
+wall, run TP decode with the fp psum vs the two-sided int8 collective
+(``inference.tp_comm_quant``) and measure tokens/s, and land the
+commscope on/off rows — ``Comm/exposed_frac`` + per-kind busbw from
+``engine.comm_observatory()`` for BOTH spellings — into
+``OVERLAP_BENCH.json``, a ``grad_overlap`` section in
+``COMMSCOPE_BENCH.json``, and an ``overlap`` section in the newest
+``MULTICHIP_r0*.json`` (perf_ledger tracks ``exposed``/``step_time``
+down-is-good, wire ratio down-is-good). On a CPU backend the profiler
+has no device op timeline, so the time-anatomy columns are null —
+recorded, never faked; the static wire-byte columns are exact either
+way.
+
+``--smoke`` is the CPU tier-1 gate (wired via
+tests/unit/test_overlap_bench.py):
+
+1. fake-trace seam: a fused-spelling trace (grad collective serialized
+   after the backward) vs an overlapped trace (same collective seconds
+   riding concurrent compute) decompose to EXACTLY the known exposed
+   fractions — the measured exposed-fraction DROP the overlap buys;
+2. parity oracles: bucketed fp grads bitwise == the fused flat fp
+   spelling (losses AND params), int8 overlap converges with
+   error-feedback residuals carried, the two-sided int8 psum lands
+   within blockwise-quantization error of the exact sum (end-to-end
+   quantized-TP-decode greedy parity incl. TP=4 is pinned by
+   tests/unit/test_tp_quant.py, which tier-1 runs beside this gate);
+3. zero new steady-state programs with every feature disabled: a
+   default engine and one with the knobs explicitly off compile the
+   same program set and emit bit-identical losses/tokens;
+4. the int8 spelling's compiled wire bytes land within 2% of the static
+   plan summary and under half the fp32 flat equivalent.
+
+Prints one JSON line ending in "smoke-pass"; exits nonzero on failure.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_CHILD_MARK = "_DSTPU_OVERLAP_CHILD"
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+_OUT = os.path.join(_ROOT, "OVERLAP_BENCH.json")
+
+
+# ------------------------------------------------------------- fake traces
+def make_fused_trace(n_steps=3, step_ms=100.0, devices=2):
+    """Known anatomy per 100ms step, the FUSED grad spelling: backward
+    compute [0,60), ONE flat all-reduce [60,90) serialized after it →
+    exposed 30ms, exposed_frac 0.3."""
+    return _trace(n_steps, step_ms, devices, (
+        (0.0, 60e3, "fusion.bwd"),
+        (60e3, 30e3, "all-reduce.grads"),
+    )), 0.3
+
+
+def make_overlap_trace(n_steps=3, step_ms=100.0, devices=2):
+    """Same collective seconds, BUCKETED overlap: compute [0,60) and
+    [65,95); bucket a2a [20,35) fully overlapped, bucket a2a [55,70)
+    exposed only [60,65), gather [95,100) exposed → 10ms exposed,
+    exposed_frac 0.1."""
+    return _trace(n_steps, step_ms, devices, (
+        (0.0, 60e3, "fusion.bwd"),
+        (65e3, 30e3, "fusion.bwd.tail"),
+        (20e3, 15e3, "all-to-all.bucket0"),
+        (55e3, 15e3, "all-to-all.bucket1"),
+        (95e3, 5e3, "all-gather.bucket1"),
+    )), 0.1
+
+
+def _trace(n_steps, step_ms, devices, ops):
+    evs = []
+    for d in range(devices):
+        pid = 10 + d
+        evs.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "args": {"name": f"/device:TPU:{d}"}})
+        for s in range(n_steps):
+            base = s * step_ms * 1e3
+            for ts, dur, name in ops:
+                evs.append({"ph": "X", "pid": pid, "tid": 1,
+                            "ts": base + ts, "dur": dur,
+                            "name": f"{name}.{s}"})
+    windows = [(s * step_ms * 1e-3, (s + 1) * step_ms * 1e-3)
+               for s in range(n_steps)]
+    return {"traceEvents": evs}, windows
+
+
+# ---------------------------------------------------------------- builders
+def build_train(mode=None, overlap=False, bucket=0, commscope=False,
+                trace_dir=None, seed=3, stage=2):
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    n = len(jax.devices())
+    cfg = {
+        "train_batch_size": max(8, n),
+        "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"data": n},
+        "seed": seed,
+    }
+    if mode:
+        cfg["gradient_compression"] = {"enabled": True, "type": mode,
+                                       "overlap": overlap,
+                                       "bucket_elems": bucket}
+    if commscope:
+        obs = {"commscope": {"enabled": True}}
+        if trace_dir:
+            obs.update({"trace_steps": [4, 6], "trace_dir": trace_dir})
+        cfg["observability"] = obs
+    return ds.initialize(cfg, build_model(tiny_test()))
+
+
+def train_batchset(size=8):
+    from deepspeed_tpu.runtime.dataloader import (DataLoader,
+                                                  random_token_dataset)
+
+    data = random_token_dataset(size, 32, 256, learnable=True)
+    return DataLoader(data, local_batch_size=size,
+                      shuffle=False).collate_fn(data[:size])
+
+
+def trained_tiny(steps=16, seed=4):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+    from deepspeed_tpu.runtime.dataloader import (DataLoader,
+                                                  random_token_dataset)
+
+    n = len(jax.devices())
+    bs = max(8, n)
+    model = build_model(tiny_test(max_seq=64, dtype=jnp.float32))
+    eng = ds.initialize({
+        "train_batch_size": bs,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "mesh": {"data": n}, "seed": 0}, model)
+    data = random_token_dataset(8 * bs, 32, 256, learnable=True, seed=seed)
+    dl = DataLoader(data, local_batch_size=bs, shuffle=False)
+    batches = [dl.collate_fn(data[i * bs:(i + 1) * bs]) for i in range(8)]
+    for i in range(steps):
+        eng.train_batch(batches[i % len(batches)])
+    params = jax.tree.map(lambda a: np.asarray(a, np.float32),
+                          eng.state.master_params)
+    prompts = [np.asarray(data[i]["input_ids"][:p], np.int32)
+               for i, p in enumerate((9, 21, 5))]
+    return model, params, prompts
+
+
+# ------------------------------------------------------------------ smoke
+def smoke():
+    # the smoke is the CPU tier-1 gate: force the 8-device host platform
+    # (the tests' conftest does the same) so the data-parallel oracles
+    # exercise real collectives. Must run before jax is first imported.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.comm.hlo_analysis import collective_summary
+    from deepspeed_tpu.observability.commscope import (CommScope,
+                                                       CommScopeConfig)
+
+    # (1) fake-trace seam: the overlap spelling's measured
+    # exposed-fraction DROP, exact by construction
+    fracs = {}
+    for name, (payload, want) in (
+            ("fused", make_fused_trace()),
+            ("overlap", make_overlap_trace())):
+        trace, windows = payload
+        cs = CommScope(CommScopeConfig(enabled=True), n_devices=2)
+        rep = cs.analyze(trace, windows=windows, peak_ici_gbps=300.0)
+        an = rep["anatomy"]
+        tile = an["compute_s"] + an["exposed_collective_s"] + an["other_s"]
+        assert abs(tile - an["wall_s"]) <= 0.01 * an["wall_s"]
+        assert abs(an["exposed_comm_frac"] - want) < 1e-9, \
+            (name, an["exposed_comm_frac"], want)
+        fracs[name] = an["exposed_comm_frac"]
+    drop = fracs["fused"] - fracs["overlap"]
+    assert abs(drop - 0.2) < 1e-9, fracs
+
+    # (2a) parity oracle: bucketed fp == fused flat fp, bitwise
+    b = train_batchset()
+    fused = build_train("fp")
+    bucketed = build_train("fp", overlap=True, bucket=2000)
+    assert len(bucketed._grad_plan.buckets) > 1
+    lf = [float(fused.train_batch(b)["loss"]) for _ in range(3)]
+    lb = [float(bucketed.train_batch(b)["loss"]) for _ in range(3)]
+    assert lf == lb, (lf, lb)
+    for x, y in zip(jax.tree.leaves(fused.state.master_params),
+                    jax.tree.leaves(bucketed.state.master_params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # (2b) int8 overlap converges, residuals carried
+    q = build_train("int8", overlap=True, bucket=2000)
+    ql = [float(q.train_batch(b)["loss"]) for _ in range(5)]
+    assert ql[-1] < ql[0], ql
+    assert float(np.abs(np.asarray(
+        q.state.comm_err["worker"])).max()) > 0.0
+
+    # (2c) quantized TP psum: the int8 two-sided all-reduce is accurate
+    # vs the exact sum (the decode-step collective's primitive oracle;
+    # END-TO-END greedy token parity incl. TP=4 on a trained model is
+    # pinned by tests/unit/test_tp_quant.py, which tier-1 runs beside
+    # this gate — not duplicated here to keep the smoke inside budget)
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm.compressed import int8_psum
+    from deepspeed_tpu.platform.mesh import MeshSpec, build_mesh
+
+    mesh8 = build_mesh(MeshSpec(data=8))
+    xs = np.random.default_rng(7).normal(size=(8, 4, 96)).astype(np.float32)
+    fn = jax.jit(jax.shard_map(
+        lambda v: int8_psum(v[0], "data")[None], mesh=mesh8,
+        axis_names=frozenset({"data"}), in_specs=P("data"),
+        out_specs=P("data"), check_vma=False))
+    with mesh8:
+        got = np.asarray(fn(xs))[0]
+    exact = xs.sum(axis=0)
+    err = float(np.abs(got - exact).max())
+    assert err < 0.05 * max(1.0, float(np.abs(exact).max())), err
+
+    # (3) zero new steady-state programs with the features disabled: an
+    # engine with the knob explicitly off compiles the same program set
+    # and emits bit-identical tokens as one that never heard of it (the
+    # training-side freeze is the whole pre-existing tier-1 suite
+    # running the untouched default path bit-for-bit)
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    model = build_model(tiny_test(max_seq=64, dtype="float32"))
+    params = jax.tree.map(lambda a: np.asarray(a),
+                          jax.jit(model.init)(jax.random.PRNGKey(0)))
+    prompt = np.random.default_rng(9).integers(
+        2, 256, (12,)).astype(np.int32)
+    e_off = ds.init_inference(model, params,
+                              {"dtype": "float32", "eos_token_id": 1,
+                               "tp_comm_quant": 0})
+    e_def = ds.init_inference(model, params,
+                              {"dtype": "float32", "eos_token_id": 1})
+    a = np.asarray(e_off.generate(np.asarray(prompt[None]), 6,
+                                  greedy=True, request_seeds=[1],
+                                  cache_len=64))
+    c = np.asarray(e_def.generate(np.asarray(prompt[None]), 6,
+                                  greedy=True, request_seeds=[1],
+                                  cache_len=64))
+    np.testing.assert_array_equal(a, c)
+    assert len(e_off._gen_cache) == len(e_def._gen_cache)
+
+    # (4) the compiled int8 wire matches the static plan and halves the
+    # fp32 flat equivalent
+    # stage 0 so the grad hops are the ONLY a2a/all-gather in the
+    # program (stage >= 2 adds the ZeRO master->compute param gather)
+    q0 = build_train("int8", overlap=True, bucket=4000, stage=0)
+    g = q0._make_global(b)
+    with q0.mesh:
+        hlo = q0._train_step.lower(q0.state, g).compile().as_text()
+    summ = collective_summary(hlo)
+    got = sum(summ.get(k, {"mbytes": 0.0})["mbytes"]
+              for k in ("all-to-all", "all-gather"))
+    wire = q0.grad_comm_summary()
+    want = wire["wire_mbytes_per_step"]
+    assert abs(got - want) <= 0.02 * want, (got, want)
+    # vs the UNPADDED fp32 flat all-reduce: the dtype floor is ~0.501
+    # (2 int8 hops + scale planes / 4 bytes); the toy model's buckets
+    # sit near the world*BLOCK padding quantum, so CPU-smoke scale pays
+    # ~6 pts of padding on top (real-scale plans amortize it away)
+    assert 0.50 <= wire["wire_ratio"] < 0.60, wire
+
+    print(json.dumps({
+        "smoke": True,
+        "exposed_frac_fused": fracs["fused"],
+        "exposed_frac_overlap": fracs["overlap"],
+        "measured_exposed_drop": drop,
+        "fp_overlap_bit_identical": True,
+        "int8_losses": ql,
+        "int8_psum_max_abs_err": err,
+        "wire_mbytes_per_step": wire["wire_mbytes_per_step"],
+        "wire_ratio_vs_fp32": wire["wire_ratio"],
+        "verdict": "smoke-pass",
+    }))
+
+
+# ------------------------------------------------------------------- full
+def _median(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def _run_child():
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+
+    platform = jax.devices()[0].platform
+    t0 = time.time()
+    n_dev = len(jax.devices())
+    b = train_batchset(max(8, n_dev))
+
+    def step_time(eng, steps=8, warm=3):
+        for _ in range(warm):
+            eng.train_batch(b)
+        walls = []
+        for _ in range(steps):
+            s = time.perf_counter()
+            eng.train_batch(b)
+            jax.block_until_ready(eng.state.step)
+            walls.append(time.perf_counter() - s)
+        return _median(walls)
+
+    rows = {}
+    for name, kw in (("fused_fp", dict(mode="fp")),
+                     ("overlap_int8", dict(mode="int8", overlap=True,
+                                           bucket=4000))):
+        tdir = tempfile.mkdtemp(prefix=f"overlap_bench_{name}_")
+        eng = build_train(commscope=True, trace_dir=tdir, **kw)
+        wall = step_time(eng)
+        rep = eng.comm_observatory(n_steps=3)
+        an, led = rep["anatomy"], rep["ledger"]
+        rows[name] = {
+            "step_time_s": wall,
+            "wire": eng.grad_comm_summary(),
+            "exposed_comm_frac": an["exposed_comm_frac"],
+            "overlap_frac": an["overlap_frac"],
+            "busbw_gbps": {k: v["busbw_gbps"]
+                           for k, v in led["by_kind"].items()},
+            "wire_mbytes_by_kind": {k: v["mbytes_per_step"]
+                                    for k, v in led["by_kind"].items()},
+        }
+        eng.close()
+
+    # TP decode: fp psum vs int8 two-sided wire, tokens/s
+    model, params, prompts = trained_tiny()
+    tp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    decode_rows = {}
+    if tp > 1:
+        base = {"dtype": "float32", "eos_token_id": 1,
+                "tensor_parallel": tp}
+        for name, extra in (("fp_psum", {}),
+                            ("int8_psum", {"tp_comm_quant": 8})):
+            eng = ds.init_inference(model, params, {**base, **extra})
+            p = prompts[1]
+            # warm compile, then timed greedy decode
+            eng.generate(np.asarray(p[None]), 16, greedy=True,
+                         request_seeds=[5], cache_len=64)
+            s = time.perf_counter()
+            reps = 6
+            for r in range(reps):
+                out = eng.generate(np.asarray(p[None]), 16, greedy=True,
+                                   request_seeds=[5 + r], cache_len=64)
+            np.asarray(out)
+            dt = (time.perf_counter() - s) / reps
+            decode_rows[name] = {"tokens_per_s": 16 / dt,
+                                 "wall_s_per_request": dt}
+        parity = np.array_equal(
+            np.asarray(ds.init_inference(model, params, base).generate(
+                np.asarray(prompts[0][None]), 8, greedy=True,
+                request_seeds=[3], cache_len=64)),
+            np.asarray(ds.init_inference(
+                model, params, {**base, "tp_comm_quant": 8}).generate(
+                np.asarray(prompts[0][None]), 8, greedy=True,
+                request_seeds=[3], cache_len=64)))
+    else:
+        parity = None
+
+    fused = rows["fused_fp"]
+    over = rows["overlap_int8"]
+    ratio = over["wire"]["wire_ratio"]
+    out = {
+        "metric": "quantized_overlapped_collectives",
+        # headline value is the wire COMPRESSION factor (up-is-good in
+        # the perf ledger's "value" convention); the raw ratio rides in
+        # wire_ratio_vs_fp32 (down-is-good)
+        "value": (1.0 / ratio) if ratio else None,
+        "unit": "grad wire compression factor vs fp32 flat equivalent "
+                f"(platform={platform}"
+                + ("" if platform == "tpu" else ", CPU-FALLBACK: no "
+                   "device op timeline — exposed/busbw columns null")
+                + ")",
+        "platform": platform,
+        "n_devices": n_dev,
+        "train": rows,
+        "step_time_fused_fp_s": fused["step_time_s"],
+        "step_time_overlap_int8_s": over["step_time_s"],
+        "wire_ratio_vs_fp32": over["wire"]["wire_ratio"],
+        "decode_tp": tp,
+        "decode": decode_rows,
+        "tp_quant_greedy_parity": parity,
+        "seconds": round(time.time() - t0, 1),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _patch_artifacts(result: dict) -> None:
+    """Land the on/off rows beside the PR-12 artifacts: a
+    ``grad_overlap`` section in COMMSCOPE_BENCH.json and an ``overlap``
+    section in the newest MULTICHIP_r0*.json (numeric round order)."""
+    import glob
+    import re
+
+    section = {
+        "exposed_comm_frac_fused": (result.get("train", {})
+                                    .get("fused_fp", {})
+                                    .get("exposed_comm_frac")),
+        "exposed_comm_frac_overlap": (result.get("train", {})
+                                      .get("overlap_int8", {})
+                                      .get("exposed_comm_frac")),
+        "busbw_gbps_overlap": (result.get("train", {})
+                               .get("overlap_int8", {})
+                               .get("busbw_gbps")),
+        "wire_ratio_vs_fp32": result.get("wire_ratio_vs_fp32"),
+        "step_time_fused_fp_s": result.get("step_time_fused_fp_s"),
+        "step_time_overlap_int8_s": result.get("step_time_overlap_int8_s"),
+        "platform": result.get("platform"),
+    }
+    cs = os.path.join(_ROOT, "COMMSCOPE_BENCH.json")
+    try:
+        with open(cs, encoding="utf-8") as f:
+            obj = json.load(f)
+        if isinstance(obj, dict):
+            obj["grad_overlap"] = section
+            with open(cs, "w", encoding="utf-8") as f:
+                json.dump(obj, f, indent=2)
+            print(f"[overlap] wrote grad_overlap section into {cs}",
+                  flush=True)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+    def round_no(p):
+        m = re.search(r"_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    cands = sorted(glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json")),
+                   key=round_no)
+    if not cands:
+        return
+    path = cands[-1]
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return
+    if not isinstance(obj, dict):
+        return
+    obj["overlap"] = section
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=2)
+    print(f"[overlap] wrote overlap section into {path}", flush=True)
+
+
+def main():
+    import bench_common as bc
+
+    if os.environ.get(_CHILD_MARK) == "1":
+        _run_child()
+        return
+    env = dict(os.environ)
+    env[_CHILD_MARK] = "1"
+    # multi-device collectives are the whole subject: give the child a
+    # multi-device host platform (affects the CPU backend only — a real
+    # TPU's device count is the hardware's)
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    me = os.path.abspath(__file__)
+    window_s = float(os.environ.get("DSTPU_BENCH_WINDOW_S", 10 * 60))
+    result = bc.run_with_tpu_window(me, env, window_s=window_s,
+                                    child_timeout=600, tag="overlap")
+    if result is None:
+        bc.log("TPU unavailable; measuring on CPU (exposed/busbw columns "
+               "will be null — no device op timeline)", "overlap")
+        result = bc.run_child(me, bc.cpu_fallback_env(env, n_devices=8),
+                              timeout=600, tag="overlap")
+    if result is None:
+        raise SystemExit("overlap bench failed on TPU and CPU")
+    with open(_OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result), flush=True)
+    _patch_artifacts(result)
+
+
+if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        main()

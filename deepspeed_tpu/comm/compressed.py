@@ -22,7 +22,7 @@ the slow ``data`` hop is compressed — the reference's 2-hop qgZ design.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -64,27 +64,117 @@ def chunk_elems(n: int, world: int, block: int = BLOCK) -> int:
 # ------------------------------------------------------------------- int8
 
 
-def int8_allreduce_mean(flat: jax.Array, axis: str = "data",
-                        block: int = BLOCK) -> jax.Array:
-    """Mean-all-reduce of a flat fp32 vector over a *manual* mesh axis with
-    int8 payloads (qgZ). Bytes on the wire: ~N int8 for the a2a hop plus
-    ~N int8 for the gather hop, vs 2N fp32 for a ring all-reduce."""
+def int8_reduce_scatter_mean(flat: jax.Array, axis: str = "data",
+                             block: int = BLOCK, *,
+                             worker_err: Optional[jax.Array] = None):
+    """Hop 1 of qgZ: blockwise-int8 all-to-all + local mean — the
+    *reduce-scatter* half of the quantized all-reduce. Each rank keeps its
+    own contiguous ``per``-element chunk of the (padded) flat vector: the
+    flat-vector spelling of "reduce-scatter into the ZeRO partition"
+    (the engine's stage>=2 master sharding then slices the gathered
+    result locally, with zero extra wire bytes — the gather hop below is
+    the only cross-rank traffic after this).
+
+    ``worker_err`` (the ``per * world``-element error-feedback residual,
+    in true gradient units) makes the quantization unbiased over steps:
+    the residual is added before quantizing and the new residual is the
+    quantization error left behind — the same discipline the 1-bit path
+    has always had; without it int8 silently drops its rounding error
+    every step. Returns ``(my_chunk (per,), new_worker_err | None)``.
+    """
     world = lax.axis_size(axis)
-    if world == 1:
-        return flat
     n = flat.shape[0]
     per = chunk_elems(n, world, block)
-    x = jnp.pad(flat, (0, per * world - n)).reshape(world, per // block, block)
-    q, s = _quant_blocks(x)
+    x = jnp.pad(flat, (0, per * world - n))
+    if worker_err is not None:
+        x = x + worker_err
+    xb = x.reshape(world, per // block, block)
+    q, s = _quant_blocks(xb)
+    new_err = None
+    if worker_err is not None:
+        new_err = x - (q.astype(jnp.float32) * s).reshape(-1)
     # a2a: rank r keeps chunk r of every sender → reduce locally.
     q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
     s = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
     mine = jnp.mean(q.astype(jnp.float32) * s, axis=0)        # (nb, block)
+    return mine, new_err
+
+
+def int8_allreduce_mean(flat: jax.Array, axis: str = "data",
+                        block: int = BLOCK, *,
+                        worker_err: Optional[jax.Array] = None,
+                        server_err: Optional[jax.Array] = None):
+    """Mean-all-reduce of a flat fp32 vector over a *manual* mesh axis with
+    int8 payloads (qgZ). Bytes on the wire: ~N int8 for the a2a hop plus
+    ~N int8 for the gather hop, vs 2N fp32 for a ring all-reduce.
+
+    Structure: :func:`int8_reduce_scatter_mean` (each rank reduces its
+    chunk) + an int8 re-quantize/all-gather second hop — ZeRO++'s 2-hop
+    qgZ. With ``worker_err``/``server_err`` both hops carry error-feedback
+    residuals (worker: the pre-a2a quantization error of the full padded
+    vector; server: the pre-gather re-quantization error of this rank's
+    ``per``-element chunk) and the call returns
+    ``(reduced, new_worker_err, new_server_err)`` — both residuals must
+    persist across steps like the 1-bit pair. Without residual arguments
+    the call returns just ``reduced`` (the historical biased spelling,
+    kept for primitive-level callers)."""
+    if (worker_err is None) != (server_err is None):
+        raise ValueError(
+            "int8 error-feedback residuals come as a pair: pass both "
+            "worker_err and server_err or neither")
+    world = lax.axis_size(axis)
+    ef = worker_err is not None
+    if world == 1:
+        return (flat, worker_err, server_err) if ef else flat
+    n = flat.shape[0]
+    mine, new_worker = int8_reduce_scatter_mean(
+        flat, axis, block, worker_err=worker_err)
+    comp = mine
+    if server_err is not None:
+        comp = mine + server_err.reshape(mine.shape)
     # second hop: re-quantize the reduced chunk and gather all chunks.
-    q2, s2 = _quant_blocks(mine)
+    q2, s2 = _quant_blocks(comp)
+    new_server = None
+    if server_err is not None:
+        new_server = (comp - q2.astype(jnp.float32) * s2).reshape(-1)
     qg = lax.all_gather(q2, axis, axis=0, tiled=False)         # (W, nb, block)
     sg = lax.all_gather(s2, axis, axis=0, tiled=False)
-    return (qg.astype(jnp.float32) * sg).reshape(-1)[:n]
+    red = (qg.astype(jnp.float32) * sg).reshape(-1)[:n]
+    return (red, new_worker, new_server) if ef else red
+
+
+def int8_psum(x: jax.Array, axis: str = "model",
+              block: int = BLOCK) -> jax.Array:
+    """Sum-all-reduce of a (any-shape) partial over a *manual* mesh axis
+    with int8 payloads on both hops — the EQuARX two-sided quantized
+    all-reduce, for the TP decode step's ``model``-axis partial-sum
+    reduction (attention ``wo`` / MLP ``w_out`` row-sharded matmuls).
+
+    Unlike the gradient path this is a one-shot activation reduction:
+    no error feedback (there is no "next step" for the residual of a
+    decode activation), SUM semantics (matmul partials), and the result
+    is cast back to the input dtype. Blockwise fp32 scales bound the
+    relative error to ~1/127 per hop — small enough that greedy decode
+    stays exact on short contexts (the parity oracle the serving tests
+    pin)."""
+    world = lax.axis_size(axis)
+    if world == 1:
+        return x
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    per = chunk_elems(n, world, block)
+    xb = jnp.pad(flat, (0, per * world - n)).reshape(
+        world, per // block, block)
+    q, s = _quant_blocks(xb)
+    q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    s = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
+    mine = jnp.sum(q.astype(jnp.float32) * s, axis=0)         # (nb, block)
+    q2, s2 = _quant_blocks(mine)
+    qg = lax.all_gather(q2, axis, axis=0, tiled=False)
+    sg = lax.all_gather(s2, axis, axis=0, tiled=False)
+    out = (qg.astype(jnp.float32) * sg).reshape(-1)[:n]
+    return out.reshape(shape).astype(dtype)
 
 
 # ------------------------------------------------------------------ onebit
@@ -139,3 +229,238 @@ def onebit_allreduce_mean(flat: jax.Array, worker_err: jax.Array,
     sg = lax.all_gather(scale2, axis, axis=0, tiled=False)
     reduced = (_unpack_signs(pg, block) * sg).reshape(-1)[:n]
     return reduced, new_worker_err, new_server_err
+
+
+# --------------------------------------------------------------- bucketing
+class BucketPlan(NamedTuple):
+    """Static layer-aligned bucketing of a gradient tree's flat vector.
+
+    ``seg_sizes`` are the element counts of the layer-aligned segments in
+    ``jax.tree.leaves`` order: an unstacked leaf is one segment, a
+    layer-stacked ``(L, ...)`` leaf contributes L per-layer segments
+    (contiguous in the C-order flattened vector, so bucket boundaries
+    land exactly on layer boundaries). ``buckets`` are ``[lo, hi)``
+    segment ranges — each bucket becomes ONE independent collective whose
+    data dependency is only its own segments' grads, which is what lets
+    XLA's latency-hiding scheduler overlap bucket i's wire time with the
+    rest of the backward (per-leaf grads of non-scanned params appear
+    progressively during the backward) and, for scanned stacks, with the
+    quantize/dequantize compute of the neighbouring buckets — the
+    T3-style pipelining the fused flat spelling (one concat over ALL
+    leaves → one collective serialized after the whole backward)
+    structurally forbids."""
+
+    seg_sizes: tuple
+    buckets: tuple
+
+    @property
+    def total_elems(self) -> int:
+        return int(sum(self.seg_sizes))
+
+    def bucket_elems(self) -> list:
+        return [int(sum(self.seg_sizes[lo:hi])) for lo, hi in self.buckets]
+
+
+def segment_sizes(shapes, stacked_flags) -> tuple:
+    """Layer-aligned segment element counts for leaves with the given
+    shapes (``jax.tree.leaves`` order). ``stacked_flags[i]`` marks leaf i
+    as layer-stacked: its leading dim is a ``lax.scan``-over-layers axis
+    and each layer's slice becomes its own segment."""
+    sizes = []
+    for shp, stk in zip(shapes, stacked_flags):
+        n = int(np.prod(shp)) if shp else 1
+        if stk and len(shp) >= 2 and shp[0] > 1 and n > 0:
+            sizes.extend([n // int(shp[0])] * int(shp[0]))
+        else:
+            sizes.append(n)
+    return tuple(sizes)
+
+
+def plan_buckets(shapes, stacked_flags, bucket_elems: int) -> BucketPlan:
+    """Greedy fixed-size packing of layer-aligned segments into buckets.
+
+    ``bucket_elems <= 0`` (or a tree smaller than one bucket) degrades to
+    ONE bucket over the whole tree — numerically the fused flat spelling.
+    A segment larger than ``bucket_elems`` gets a bucket of its own
+    (buckets never split a segment: layer alignment is the invariant);
+    the last bucket is whatever remains (uneven by construction)."""
+    sizes = segment_sizes(shapes, stacked_flags)
+    if not sizes:
+        return BucketPlan((), ())
+    if bucket_elems <= 0:
+        return BucketPlan(sizes, ((0, len(sizes)),))
+    buckets = []
+    lo, acc = 0, 0
+    for i, s in enumerate(sizes):
+        if i > lo and acc + s > bucket_elems:
+            buckets.append((lo, i))
+            lo, acc = i, 0
+        acc += s
+    buckets.append((lo, len(sizes)))
+    return BucketPlan(sizes, tuple(buckets))
+
+
+def plan_comm_err_shapes(plan: BucketPlan, world: int,
+                         block: int = BLOCK) -> dict:
+    """Error-feedback residual shapes for a bucketed plan (leading dim =
+    data axis, the engine's ``comm_err`` sharding convention): worker =
+    the concatenation of every bucket's padded vector, server = the
+    concatenation of every bucket's per-rank chunk. One flat vector per
+    role; the bucketed reduce slices its own windows (static offsets)."""
+    pers = [chunk_elems(n, world, block) for n in plan.bucket_elems()]
+    return {"worker": (world, sum(p * world for p in pers)),
+            "server": (world, sum(pers))}
+
+
+def tree_segments(tree, stacked_fn):
+    """Pytree → list of layer-aligned 1-D fp32 segments (leaves order,
+    matching :func:`segment_sizes` over the same shapes/flags)."""
+    segs = []
+    for leaf in jax.tree.leaves(tree):
+        shp = leaf.shape
+        n = int(np.prod(shp)) if shp else 1
+        if stacked_fn(shp) and len(shp) >= 2 and shp[0] > 1 and n > 0:
+            rows = leaf.reshape(shp[0], -1).astype(jnp.float32)
+            segs.extend(rows[i] for i in range(shp[0]))
+        else:
+            segs.append(leaf.reshape(-1).astype(jnp.float32))
+    return segs
+
+
+def unflatten_like(tree, flat: jax.Array):
+    """Reassemble a flat fp32 vector (concatenated in ``tree_segments``
+    order == ``jax.tree.leaves`` order) back into ``tree``'s structure."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sizes = [int(np.prod(l.shape)) for l in leaves]
+    parts = jnp.split(flat, np.cumsum(sizes)[:-1]) if len(sizes) > 1 \
+        else [flat]
+    return jax.tree_util.tree_unflatten(
+        treedef, [p.reshape(l.shape) for p, l in zip(parts, leaves)])
+
+
+def bucketed_grad_reduce(grads, plan: BucketPlan, *, mode: str, axis: str,
+                         stacked_fn, scale=None,
+                         worker_err: Optional[jax.Array] = None,
+                         server_err: Optional[jax.Array] = None,
+                         block: int = BLOCK):
+    """Per-bucket compressed (or fp) mean-reduction of a gradient tree
+    over a *manual* mesh axis — the engine's bucketed grad-communication
+    core (``runtime/engine.py _compressed_grads``).
+
+    Each bucket concatenates only ITS OWN segments (never the whole
+    tree), so bucket i's collective depends on nothing but bucket i's
+    grads and XLA's scheduler is free to overlap it with the remaining
+    backward / the neighbouring buckets' quantize compute. ``scale``
+    (the fp16 loss scale) is divided out per bucket BEFORE compressing,
+    so the error-feedback residuals live in true gradient units — a
+    dynamic loss-scale change can never leave them stale (the same
+    unscale-aware discipline as the fused path).
+
+    ``mode``: ``"fp"`` = uncompressed ``lax.pmean`` per bucket (bitwise
+    identical to the fused flat spelling: the reduction is elementwise);
+    ``"int8"`` = qgZ with worker+server error feedback; ``"onebit"`` =
+    sign compression with the 1-bit residual pair. Returns
+    ``(reduced_tree, new_worker_err, new_server_err)`` — the residuals
+    are ``None`` for fp mode / world == 1 / residuals not supplied."""
+    if (worker_err is None) != (server_err is None):
+        raise ValueError(
+            "error-feedback residuals come as a pair: pass both "
+            "worker_err and server_err or neither (got "
+            f"worker_err={'set' if worker_err is not None else None}, "
+            f"server_err={'set' if server_err is not None else None})")
+    world = lax.axis_size(axis)
+    segs = tree_segments(grads, stacked_fn)
+    assert len(segs) == len(plan.seg_sizes), \
+        (len(segs), len(plan.seg_sizes))
+    outs, new_w, new_s = [], [], []
+    w_off = s_off = 0
+    ef = worker_err is not None and world > 1 and mode != "fp"
+    for lo, hi in plan.buckets:
+        flat = segs[lo] if hi == lo + 1 else jnp.concatenate(segs[lo:hi])
+        if scale is not None:
+            flat = flat / scale
+        if world == 1 or mode == "fp":
+            outs.append(lax.pmean(flat, axis) if world > 1 else flat)
+            continue
+        n = flat.shape[0]
+        per = chunk_elems(n, world, block)
+        we = se = None
+        if ef:
+            # static windows into the flat residual vectors (the plan is
+            # trace-time constant, so these are plain slices)
+            we = worker_err[w_off:w_off + per * world]
+            se = server_err[s_off:s_off + per]
+            w_off += per * world
+            s_off += per
+        if mode == "onebit":
+            if we is None:      # residuals are the algorithm for 1-bit
+                raise ValueError("onebit grad compression requires the "
+                                 "worker/server error-feedback residuals")
+            red, nw, ns = onebit_allreduce_mean(flat, we, se, axis, block)
+        elif mode == "int8":
+            if ef:
+                red, nw, ns = int8_allreduce_mean(
+                    flat, axis, block, worker_err=we, server_err=se)
+            else:
+                red, nw, ns = int8_allreduce_mean(flat, axis, block), \
+                    None, None
+        else:
+            raise ValueError(f"unknown grad compression mode {mode!r}")
+        outs.append(red)
+        if nw is not None:
+            new_w.append(nw)
+            new_s.append(ns)
+    full = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    nw_out = jnp.concatenate(new_w) if new_w else None
+    ns_out = jnp.concatenate(new_s) if new_s else None
+    return unflatten_like(grads, full), nw_out, ns_out
+
+
+# byte cost per fp32 element of each mode's wire payload (both hops,
+# result-payload convention — the same convention the HLO census counts):
+# int8 = 1B payload + 4B/BLOCK scale per hop; onebit = 1/8B signs + scale.
+def plan_wire_mbytes(plan: BucketPlan, world: int, mode: str,
+                     block: int = BLOCK) -> dict:
+    """Static per-step wire summary of a bucketed grad-reduction plan —
+    the ``achieved`` side of the capacity advisor's
+    ``quantized_collectives`` lever (what the spelling actually puts on
+    the wire vs the fp32 flat all-reduce it replaces). Exact from the
+    plan's padded bucket sizes; no compile needed.
+
+    The denominator is the UNPADDED flat fp32 all-reduce GSPMD would
+    emit with compression off — chunk/block padding is an artifact of
+    the compressed reduce-scatter spelling, not of what it replaces.
+    ``"fp"`` mode reduces each bucket with a plain elementwise
+    ``lax.pmean`` (no padding, no scale planes), so its ratio is
+    exactly 1.0; the quantized modes pay each bucket's own padding, so
+    their ``wire_ratio`` honestly exceeds the dtype ratio when buckets
+    sit near the ``world * block`` padding quantum (and can exceed 1.0
+    for degenerate tiny-bucket plans: quantized padding costing more
+    than the fp32 wire is a real outcome, reported, never hidden — the
+    engine clamps ``bucket_elems`` to the quantum for exactly this
+    reason)."""
+    pers = [chunk_elems(n, world, block) for n in plan.bucket_elems()]
+    padded = sum(p * world for p in pers)
+    fp32_equiv = 4.0 * plan.total_elems
+    if world <= 1:
+        payload = 0.0
+    elif mode == "fp":
+        payload = 4.0 * plan.total_elems
+    elif mode == "int8":
+        # hop 1: int8 a2a of the padded vector + f32 block scales;
+        # hop 2: int8 gather of the reduced chunks + f32 block scales
+        per_hop = padded * 1.0 + (padded // block) * 4.0
+        payload = 2.0 * per_hop
+    elif mode == "onebit":
+        per_hop = padded / 8.0 + (padded // block) * 4.0
+        payload = 2.0 * per_hop
+    else:
+        raise ValueError(f"unknown grad compression mode {mode!r}")
+    return {
+        "mode": mode,
+        "buckets": len(plan.buckets),
+        "bucket_elems": plan.bucket_elems(),
+        "wire_mbytes_per_step": payload / 1e6,
+        "fp32_equivalent_mbytes": fp32_equiv / 1e6,
+        "wire_ratio": (payload / fp32_equiv) if fp32_equiv else None,
+    }

@@ -292,10 +292,34 @@ class DataTypesConfig(ConfigModel):
 
 class GradientCompressionConfig(ConfigModel):
     """1-bit / compressed data-parallel gradient path
-    (reference ``runtime/comm/nccl.py:51`` error-feedback sign compression)."""
+    (reference ``runtime/comm/nccl.py:51`` error-feedback sign compression).
+
+    ``type="fp"`` keeps fp32 payloads but still routes the reduction
+    through the explicit manual-axis spelling — the bit-parity oracle for
+    ``overlap`` (bucketed fp is bitwise identical to the fused flat fp
+    collective) and the way to get backward-overlap WITHOUT quantization.
+
+    ``overlap=True`` splits the flat grad vector into fixed-size
+    layer-aligned buckets (``comm.compressed.plan_buckets``) and reduces
+    each as its own collective, so bucket i's wire time can overlap the
+    remaining backward / the neighbouring buckets' quantize compute
+    (T3-style pipelining; ZeRO++'s block quantization runs per bucket).
+    Bucket size comes from ``bucket_elems`` (fp32 elements), defaulting
+    to ``zero_optimization.reduce_bucket_size`` — the reference's bucket
+    knob, which this finally wires up."""
 
     enabled: bool = False
-    type: Literal["onebit", "int8"] = "int8"
+    type: Literal["onebit", "int8", "fp"] = "int8"
+    overlap: bool = False
+    bucket_elems: int = 0   # 0 = zero_optimization.reduce_bucket_size
+
+    @field_validator("bucket_elems", mode="before")
+    @classmethod
+    def _sci_bucket(cls, v):
+        v = sci_int(v) if not is_auto(v) else v
+        if isinstance(v, int) and v < 0:
+            raise ValueError(f"bucket_elems must be >= 0, got {v}")
+        return v
 
 
 class CurriculumConfig(ConfigModel):

@@ -12,6 +12,7 @@ identical static shape (one compiled program for the whole generation).
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -19,10 +20,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..models.transformer import TransformerConfig, _norm, _rope
+from ..models.transformer import TransformerConfig, _activation, _norm, _rope
 from ..platform.mesh import BATCH_AXES, constrain
 from .quantization import (QuantizedTensor, dequant_rows, matmul_any,
-                           woq_dot, woq_dot_t)
+                           tp_quant_dot, woq_dot, woq_dot_t)
 
 # Host constant, NOT jnp.float32(...): a device constant here would run a
 # computation at import time and initialize the XLA backend — which breaks
@@ -258,6 +259,46 @@ def _paged_view(cp, sp, page_table, dtype):
     return g
 
 
+def _tp_quant_eligible(model, p, T: int) -> int:
+    """int8 bits when the quantized TP decode collective applies to this
+    step, else 0. Gates: the engine opted in (``tp_comm_quant``, stamped
+    on the model like ``woq_kernel``), T == 1 (decode only — prefill is
+    compute-bound and pays the psum once per request, not per token),
+    and the row-sharded projections are DENSE (a WOQ ``QuantizedTensor``
+    reduces inside its own shard_map — see ``woq_dot``'s psum — and
+    keeps the fp wire there). ``tp_quant_dot`` itself declines meshes
+    without a ``model`` axis, so a TP=1 engine with the knob on compiles
+    the identical program."""
+    bits = int(getattr(model, "tp_quant", 0) or 0)
+    if not bits or T != 1:
+        return 0
+    if isinstance(p.get("wo"), QuantizedTensor):
+        return 0
+    return bits
+
+
+def _mlp_tp_quant(model, y, p, bits: int):
+    """The dense-MLP half of a decode step with the ``w_out`` model-axis
+    partial-sum reduction quantized (two-sided int8) — the same math as
+    ``TransformerLM._mlp_block`` (decode never remats, so the
+    checkpoint-name tags there are identities this spelling drops).
+    Falls back to the model's own block when the explicit spelling
+    doesn't apply (no TP mesh, uneven shards, quantized w_out)."""
+    cfg = model.cfg
+    if isinstance(p.get("w_out"), QuantizedTensor):
+        return model._mlp_block(y, p)
+    u = model._maybe_bias(model._proj(y, p, "w_in"), p, "b_in")
+    if cfg.is_glu:
+        u = jax.nn.silu(model._proj(y, p, "w_gate")) * u
+    else:
+        u = _activation(u, cfg.activation)
+    u = constrain(u, P(BATCH_AXES, "seq", "model"))
+    out = tp_quant_dot(u, p["w_out"], bits=bits)
+    if out is None:
+        out = model._proj(u, p, "w_out")
+    return model._maybe_bias(out, p, "b_out"), jnp.float32(0.0)
+
+
 def _qkv_proj(model, y, p):
     """The attention projections as ONE GEMM when the engine pre-fused
     them (``wqkv`` = [wq | wk | wv] along the output dim, ``bqkv``
@@ -348,15 +389,26 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
         alibi = alibi_slopes(h)
     o = _cache_attend(q, attend_k, attend_v, length, flash_decode=flash_decode,
                       alibi=alibi)
-    o = model._maybe_bias(
-        matmul_any(o.reshape(B, T, h * hd), p["wo"],
-                   use_kernel=getattr(model, "woq_kernel", False)), p, "bo")
+    # Quantized TP decode collective (inference.tp_comm_quant): the wo
+    # and dense-MLP w_out partial-sum reductions — the per-token
+    # model-axis wire cost every TP decode step pays — spell as explicit
+    # two-sided int8 all-reduces. 0 (default) keeps this path bit-frozen
+    # on the GSPMD fp psum.
+    tpq = _tp_quant_eligible(model, p, T)
+    o_flat = o.reshape(B, T, h * hd)
+    o = tp_quant_dot(o_flat, p["wo"], bits=tpq) if tpq else None
+    if o is None:
+        o = matmul_any(o_flat, p["wo"],
+                       use_kernel=getattr(model, "woq_kernel", False))
+    o = model._maybe_bias(o, p, "bo")
     # MoE trunks expose a single-group no-drop dispatch (_mlp_block_infer,
     # models/moe.py) for the T=1 decode step; prefill (T>1) and dense
     # trunks use the training MLP unchanged (per-row grouping keeps
     # prefill's dispatch one-hots at the training memory profile).
-    mlp = (getattr(model, "_mlp_block_infer", None) if T == 1 else None) \
-        or model._mlp_block
+    moe_infer = getattr(model, "_mlp_block_infer", None) if T == 1 else None
+    mlp = moe_infer or model._mlp_block
+    if tpq and moe_infer is None:
+        mlp = partial(_mlp_tp_quant, model, bits=tpq)
     if cfg.parallel_residual:
         y2 = y if cfg.parallel_shared_ln else _norm(
             x, p["ln2_scale"], p.get("ln2_bias"), cfg.norm, cfg.norm_eps)

@@ -142,6 +142,20 @@ class InferenceEngine:
         if cfg.decode_chunk < 0:
             raise ValueError(f"decode_chunk must be >= 0, got "
                              f"{cfg.decode_chunk}")
+        if cfg.tp_comm_quant not in (0, 8):
+            raise ValueError(f"tp_comm_quant must be 0 (off) or 8 (int8), "
+                             f"got {cfg.tp_comm_quant}")
+        if cfg.tp_comm_quant:
+            # stamped on the model like woq_kernel: the shared decode step
+            # can't thread an engine handle through. Clone first so a
+            # shared training model isn't flagged.
+            if self.model is model:
+                self.model = copy.copy(model)
+            self.model.tp_quant = cfg.tp_comm_quant
+            log_dist(f"inference: int{cfg.tp_comm_quant} quantized TP "
+                     f"decode collective (tp={cfg.tensor_parallel}; "
+                     "wo/w_out psums two-sided int8, logits stay fp)",
+                     ranks=[0])
         self._gen_cache: OrderedDict = OrderedDict()
         # split prefill/decode program caches: used by request tracing AND
         # by the chunked-decode early-stop path (decode_chunk > 0)

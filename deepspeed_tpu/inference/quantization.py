@@ -298,6 +298,45 @@ def matmul_any(x, w, use_kernel: bool = False):
     return x @ w.astype(x.dtype)
 
 
+def tp_quant_dot(x, w, bits: int = 8):
+    """``x @ w`` for a DENSE row-sharded (contraction-split) weight with
+    the ``model``-axis partial-sum reduction spelled as an explicit
+    EQuARX-style two-sided int8 all-reduce
+    (``comm.compressed.int8_psum``) instead of the fp psum GSPMD
+    inserts — the quantized TP decode collective
+    (``inference.tp_comm_quant``).
+
+    Local partials accumulate in fp32 (``preferred_element_type``), the
+    wire carries int8 payloads + fp32 block scales on both hops, and the
+    result is cast back to ``x.dtype``. Returns ``None`` when the
+    explicit spelling doesn't apply — no TP mesh in context, or the
+    contraction dim doesn't shard evenly — and the caller falls back to
+    the plain GSPMD matmul (same program as the knob-off path)."""
+    if bits != 8:
+        raise ValueError(f"tp_quant_dot supports int8 only, got {bits}")
+    mesh, tp = _mesh_tp()
+    if tp <= 1:
+        return None
+    K = x.shape[-1]
+    N = w.shape[-1]
+    if K % tp != 0:
+        return None
+    from ..comm.compressed import int8_psum
+
+    x2 = x.reshape(-1, K)
+
+    def body(xs, ws):
+        part = jax.lax.dot_general(
+            xs, ws.astype(xs.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return int8_psum(part, "model").astype(x.dtype)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(None, "model"), P("model", None)),
+                       out_specs=P(None, None), check_vma=False)
+    return fn(x2, w).reshape(x.shape[:-1] + (N,))
+
+
 # ------------------------------------------------------------- pytree ops
 def _should_quantize(path, leaf, min_size: int) -> bool:
     if leaf.ndim < 2 or leaf.size < min_size:

@@ -583,7 +583,12 @@ class TransformerLM:
 
     @jax.named_scope("mlp")
     def _mlp_block(self, y, p):
-        """FFN half. Returns (out, aux_loss); MoE trunks override this."""
+        """FFN half. Returns (out, aux_loss); MoE trunks override this.
+
+        NOTE: ``inference/decode.py _mlp_tp_quant`` mirrors this math
+        with the w_out psum quantized (tp_comm_quant) — a change to the
+        activation/gate/bias sequence here must be mirrored there or the
+        quantized-TP greedy-parity oracle breaks for knob-on users."""
         cfg = self.cfg
         u = self._maybe_bias(self._proj(y, p, "w_in"), p, "b_in")
         if cfg.is_glu:

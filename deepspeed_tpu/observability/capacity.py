@@ -505,6 +505,33 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     "wall (commscope trace anatomy) — the time "
                     "overlapping/quantizing collectives can reclaim; "
                     "achieved bus bandwidth per kind attached")
+    # the lever is PULLED (quantized grad collectives / bucketed overlap
+    # / int8 TP decode wire active): report what the spelling achieves —
+    # exact static wire bytes vs the fp32 equivalent
+    # (Engine.grad_comm_summary), the serving tp_quant bits — beside the
+    # projection, and score only what REMAINS: the measured exposed
+    # fraction still on the wall (self-demoting toward zero as the
+    # overlap absorbs it — the PR-14 tiered_kv pattern), or 0 with the
+    # reason stated when this backend can't measure what remains.
+    gq = (commscope or {}).get("quantized") or {}
+    if gq.get("active"):
+        coll_est["achieved"] = {k: gq.get(k) for k in (
+            "mode", "overlap", "error_feedback", "buckets",
+            "tp_quant_bits", "wire_mbytes_per_step",
+            "fp32_equivalent_mbytes", "wire_ratio", "data_world")}
+        if cs_an.get("exposed_comm_frac") is not None:
+            coll_score = float(cs_an["exposed_comm_frac"])
+            why_coll += ("; quantized/overlapped collectives ACTIVE — "
+                         "achieved wire ratio reported, score is the "
+                         "REMAINING measured exposed fraction "
+                         "(self-demotes as overlap absorbs it)")
+        else:
+            coll_score = 0.0
+            why_coll = ("quantized/overlapped collectives ACTIVE — "
+                        "achieved wire ratio reported; exposed fraction "
+                        "unmeasured on this backend, so nothing further "
+                        "to project (run the commscope observatory on "
+                        "TPU for the remaining-exposed score)")
     levers.append({"name": LEVER_COLLECTIVES, "score": float(coll_score),
                    "estimate": coll_est, "why": why_coll})
 

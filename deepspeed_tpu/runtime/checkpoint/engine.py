@@ -331,8 +331,40 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> str:
         restore_args = jax.tree.map(
             lambda x, s: ocp.ArrayRestoreArgs(sharding=s, dtype=x.dtype),
             engine.state, engine.state_shardings)
-        restored = ckptr.restore(path / "state", item=abstract,
-                                 restore_args=restore_args)
+        # The engine may want error-feedback residuals the checkpoint
+        # can't supply: a pre-error-feedback int8 save (comm_err == {}),
+        # an fp-mode save resumed under int8/onebit, or an elastic/
+        # bucket-plan change that resized the flat residual vectors.
+        # Probe the checkpoint's ACTUAL saved structure up front and
+        # zero-init only on a genuine mismatch — catching restore
+        # failures instead would zero valid residuals on a transient
+        # error and mask unrelated corruption with the retry's traceback.
+        want_err = getattr(engine.state, "comm_err", None) or None
+        mismatch = False
+        if want_err:
+            want_shapes = {k: tuple(v.shape) for k, v in want_err.items()}
+            try:
+                saved = ckptr.metadata(path / "state").get("comm_err") or {}
+                saved_shapes = {k: tuple(m.shape) for k, m in saved.items()}
+                mismatch = saved_shapes != want_shapes
+            except Exception as e:
+                log_dist("load_checkpoint: could not probe the saved "
+                         f"comm_err structure ({e}) — restoring strictly",
+                         ranks=[0])
+        if mismatch:
+            restored = ckptr.restore(
+                path / "state", item=abstract._replace(comm_err={}),
+                restore_args=restore_args._replace(comm_err={}))
+            restored = restored._replace(comm_err=engine.state.comm_err)
+            log_dist("load_checkpoint: checkpoint comm_err residuals "
+                     f"{saved_shapes or 'absent'} don't match this run's "
+                     f"{want_shapes} (pre-error-feedback save, changed "
+                     "bucket plan, or changed data world) — zero-"
+                     "initialized; error feedback re-debiases from the "
+                     "next step", ranks=[0])
+        else:
+            restored = ckptr.restore(path / "state", item=abstract,
+                                     restore_args=restore_args)
         engine.state = restored
         step_guess = int(restored.step)
     engine.global_steps = int(meta_pre.get("global_steps", step_guess))

@@ -250,12 +250,52 @@ class Engine:
         self.grad_comp: Optional[str] = (
             gc.type if gc.enabled
             else ("int8" if zcfg.zero_quantized_gradients else None))
+        # bucketed backward-overlap dispatch (comm/compressed.py): bucket
+        # size in fp32 elements, defaulting to the reference's
+        # reduce_bucket_size knob. 0 buckets = the fused flat spelling.
+        self.grad_overlap: bool = bool(self.grad_comp and gc.overlap)
+        self._grad_bucket_elems: int = (
+            (int(gc.bucket_elems) or int(zcfg.reduce_bucket_size))
+            if self.grad_overlap else 0)
+        if self._grad_bucket_elems and self.grad_comp != "fp":
+            # every QUANTIZED bucket pads to whole per-rank scale blocks,
+            # so a bucket smaller than world * BLOCK moves MORE bytes
+            # than it carries — clamp to the padding quantum (the wire
+            # summary still reports the padding that remains). fp buckets
+            # reduce with a plain unpadded pmean: no padding to clamp for.
+            from ..comm.compressed import BLOCK
+
+            floor = int(self.mesh.shape["data"]) * BLOCK
+            if self._grad_bucket_elems < floor:
+                log_dist(
+                    f"gradient_compression: bucket_elems="
+                    f"{self._grad_bucket_elems} is below the padding "
+                    f"quantum data_world*{BLOCK}={floor} (each bucket "
+                    "pads to whole per-rank scale blocks) — clamped to "
+                    f"{floor}", ranks=[0])
+                self._grad_bucket_elems = floor
         if self.grad_comp and zcfg.stage >= 3 \
                 and not (self.partitioner.hpz or self.partitioner.mics):
             raise ValueError(
                 "gradient compression (qgZ / 1-bit) under ZeRO-3 requires "
                 "zero_hpz_partition_size > 1 or mics_shard_size > 0: compute "
                 "params must not be sharded over the compressed 'data' axis")
+        if self.grad_comp and jax.__version__.startswith("0.4"):
+            fast = [a for a in ("model", "seq", "expert", "zero", "pipe")
+                    if int(self.mesh.shape.get(a, 1)) > 1]
+            if fast:
+                # Not a policy choice — 0.4's SPMD partitioner hard-ABORTS
+                # the process (Check failed: sharding.IsManualSubgroup())
+                # when the manual-'data' grad shard_map carries operands
+                # sharded over a GSPMD-managed sub-axis. An init-time
+                # typed error beats an uncatchable abort; jax >= 0.9
+                # handles manual subgroups and lifts the restriction.
+                raise ValueError(
+                    f"gradient_compression on jax {jax.__version__} "
+                    f"requires a pure-data mesh: the manual-'data' "
+                    f"shard_map with GSPMD-managed {fast} axes crashes "
+                    "the 0.4 SPMD partitioner (IsManualSubgroup check "
+                    "abort) — drop the axes or run the jax>=0.9 image")
         from .onebit import ONEBIT_TYPES, OnebitConfig
 
         opt_type = self.config.optimizer.type.lower().replace("-", "_")
@@ -312,6 +352,29 @@ class Engine:
         self.master_specs = self.partitioner.master_specs(model_specs, shapes, stacked)
         self.compute_shardings = shardings_from_specs(self.mesh, self.compute_specs)
         self.master_shardings = shardings_from_specs(self.mesh, self.master_specs)
+        # static layer-aligned bucket plan for the compressed/overlapped
+        # grad reduction (one bucket when overlap is off — the fused flat
+        # spelling, numerically unchanged)
+        self._stacked_fn = stacked
+        self._grad_plan = None
+        if self.grad_comp:
+            from ..comm.compressed import plan_buckets
+
+            leaf_shapes = [tuple(s) for s in jax.tree.leaves(
+                shapes, is_leaf=lambda x: isinstance(x, tuple))]
+            self._grad_plan = plan_buckets(
+                leaf_shapes, [stacked(s) for s in leaf_shapes],
+                self._grad_bucket_elems)
+            if self.grad_overlap and len(self._grad_plan.buckets) == 1:
+                log_dist(
+                    "gradient_compression.overlap: the whole grad tree "
+                    f"fits one bucket ({self._grad_plan.total_elems} <= "
+                    f"bucket_elems={self._grad_bucket_elems}) — the "
+                    "reduction compiles to the fused flat spelling with "
+                    "nothing to overlap; lower gradient_compression."
+                    "bucket_elems (or zero_optimization.reduce_bucket_"
+                    "size) below the param count to get bucketed "
+                    "dispatch", ranks=[0])
 
         self.param_count = sum(int(np.prod(a.shape))
                                for a in jax.tree.leaves(abstract))
@@ -368,11 +431,21 @@ class Engine:
         # ---------------- init state (sharded at construction: the zero.Init
         # analog — params are born partitioned, never materialized replicated)
         self._comm_err_shapes = {}
-        if self.grad_comp == "onebit" or self.onebit is not None:
+        if self.onebit is not None:
             from .onebit import comm_err_shapes
 
             self._comm_err_shapes = comm_err_shapes(
                 self.param_count, int(self.mesh.shape["data"]))
+        elif self.grad_comp in ("onebit", "int8"):
+            # error-feedback residuals for BOTH compressed grad modes
+            # (int8 historically dropped its quantization error every
+            # step — the residual pair makes it unbiased like 1-bit),
+            # sized from the bucket plan so each bucket's padded window
+            # is a static slice of one flat vector per role
+            from ..comm.compressed import plan_comm_err_shapes
+
+            self._comm_err_shapes = plan_comm_err_shapes(
+                self._grad_plan, int(self.mesh.shape["data"]))
         comm_err_shardings = {k: NamedSharding(self.mesh, P("data"))
                               for k in self._comm_err_shapes}
         # Moment shardings follow the master EXCEPT for moments the
@@ -1074,32 +1147,42 @@ class Engine:
 
     def _compressed_grads(self, compute_params, batch, scale, comm_err):
         """Per-rank local grads under a manual-``data`` shard_map + explicit
-        compressed all-reduce (qgZ int8 / 1-bit error feedback). The fast
+        bucketed reduction (qgZ int8 / 1-bit error feedback / fp). The fast
         sub-axes (zero/expert/seq/model) stay GSPMD-managed inside — only the
-        slow data hop moves compressed bytes."""
-        from ..comm.compressed import (flatten_tree, int8_allreduce_mean,
-                                       onebit_allreduce_mean)
+        slow data hop moves compressed bytes.
+
+        With ``gradient_compression.overlap`` the reduction runs per
+        layer-aligned bucket (``comm/compressed.py plan_buckets``): each
+        bucket's collective depends only on its own layers' grads, so
+        XLA's latency-hiding scheduler dispatches bucket i's quantized
+        wire time against the remaining backward / the neighbouring
+        buckets' quantize compute instead of serializing ONE flat
+        collective after the whole backward. Both compressed modes carry
+        error-feedback residuals in the ``comm_err`` state (unscaled —
+        true gradient units, loss-scale-change safe); fp mode is bitwise
+        identical to the fused flat spelling by construction."""
+        from ..comm.compressed import bucketed_grad_reduce
 
         D = int(self.mesh.shape["data"])
         mode = self.grad_comp
+        plan = self._grad_plan
+        stacked_fn = self._stacked_fn
 
         def body(cp, b, ce):
             grads, loss = self._gas_scan(cp, b, scale)
-            flat, unflatten = flatten_tree(grads)
-            # Unscale BEFORE compressing so the error-feedback residuals are
-            # stored in true gradient units — otherwise a dynamic loss-scale
-            # change would leave stale residuals off by the scale ratio.
-            flat = flat / scale
-            if D > 1 and mode == "onebit":
-                red, nw, ns = onebit_allreduce_mean(
-                    flat, ce["worker"][0], ce["server"][0], "data")
+            # scale is divided out per bucket BEFORE compressing so the
+            # error-feedback residuals are stored in true gradient units —
+            # otherwise a dynamic loss-scale change would leave stale
+            # residuals off by the scale ratio.
+            red, nw, ns = bucketed_grad_reduce(
+                grads, plan, mode=mode, axis="data",
+                stacked_fn=stacked_fn, scale=scale,
+                worker_err=ce["worker"][0] if "worker" in ce else None,
+                server_err=ce["server"][0] if "server" in ce else None)
+            if nw is not None:
                 ce = {"worker": nw[None], "server": ns[None]}
-            elif D > 1:
-                red = int8_allreduce_mean(flat, "data")
-            else:
-                red = flat
             loss = lax.pmean(loss, "data")
-            return unflatten(red), loss, ce
+            return red, loss, ce
 
         # check_vma=False: grads/loss really are replicated over 'data' (they
         # come out of an all-gather of identical chunks + a pmean), but the
@@ -1390,6 +1473,31 @@ class Engine:
             census.attach_spans(self.spans.events())
         return census.report()
 
+    def grad_comm_summary(self) -> Optional[dict]:
+        """Static wire summary of the gradient-communication spelling:
+        mode, bucket plan, and exact payload bytes per step vs the fp32
+        flat all-reduce it replaces (``comm.compressed.plan_wire_mbytes``).
+        The ``achieved`` input of the capacity advisor's
+        ``quantized_collectives`` lever; None when the explicit grad
+        path is off (GSPMD owns the reduction — nothing to report)."""
+        if not self.grad_comp or self._grad_plan is None:
+            return None
+        from ..comm.compressed import plan_wire_mbytes
+
+        D = int(self.mesh.shape["data"])
+        out = plan_wire_mbytes(self._grad_plan, D, self.grad_comp)
+        # report the overlap the PLAN actually delivers, not the config
+        # intent: bucket_elems larger than the tree degrades to one fused
+        # bucket, which has nothing to overlap (the advisor's achieved
+        # block must not claim otherwise)
+        out.update({"active": True,
+                    "overlap": bool(self.grad_overlap
+                                    and len(self._grad_plan.buckets) > 1),
+                    "overlap_requested": self.grad_overlap,
+                    "error_feedback": bool(self._comm_err_shapes),
+                    "data_world": D})
+        return out
+
     def observe_device_stamps(self, step: int, stamps: dict) -> list:
         """Cross-host/device per-step completion stamps → the commscope
         straggler detector (observability/commscope.py). The seam a
@@ -1429,6 +1537,12 @@ class Engine:
             a, b = (int(s) for s in obs.trace_steps)
             n_steps = b - a + 1
         report = self.commscope.analyze(trace_source, n_steps=n_steps)
+        # the quantized/overlapped grad-communication spelling, if on:
+        # static wire bytes vs the fp32 equivalent — the capacity
+        # advisor's quantized_collectives lever reads this as its
+        # achieved block (score self-demotes to the REMAINING measured
+        # exposed fraction)
+        report["quantized"] = self.grad_comm_summary()
         if path:
             import json
             from pathlib import Path as _Path

@@ -128,6 +128,16 @@ class ServingEngine:
         self._mat = engine._materialized if engine.config.quantize else None
         kw = {"clock": clock} if clock is not None else {}
         self.stats = ServingStats(registry=registry, **kw)
+        # quantized TP decode collective (inference.tp_comm_quant): the
+        # knob lives on the InferenceEngine — the shared decode step
+        # carries it into every serving program automatically — but
+        # serving surfaces it as a gauge so /metrics and the capacity
+        # report can tell a quantized-wire replica from an fp one.
+        self._tp_quant = int(getattr(engine.config, "tp_comm_quant", 0)
+                             or 0)
+        if self._tp_quant:
+            self.stats.registry.gauge("Serve/tp_quant_bits").set(
+                float(self._tp_quant))
         # ---- observability: spans / flight / SLO (docs/OBSERVABILITY.md).
         # All default-off; disabled they cost the hot path `is not None`
         # checks only — no clock reads, no syncs, no programs.
@@ -1364,6 +1374,14 @@ class ServingEngine:
         if isinstance(occ, float) and _math.isnan(occ):
             occ = None
         wl = self.workload.snapshot() if self.workload is not None else None
+        if self._tp_quant:
+            # the quantized TP decode collective is ON: the advisor's
+            # quantized_collectives lever reports it as achieved (wire
+            # already int8) instead of projecting the same win again
+            commscope = dict(commscope) if commscope else {}
+            gq = dict(commscope.get("quantized") or {})
+            gq.update({"active": True, "tp_quant_bits": self._tp_quant})
+            commscope["quantized"] = gq
         rep = capacity_report(
             ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
             commscope=commscope, kvscope=self.kv_residency(),

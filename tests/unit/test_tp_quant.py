@@ -1,0 +1,152 @@
+"""Quantized TP decode collective (inference.tp_comm_quant).
+
+The decode step's ``model``-axis partial-sum reductions — attention
+``wo`` and dense-MLP ``w_out`` — spelled as explicit EQuARX-style
+two-sided int8 all-reduces (``comm.compressed.int8_psum``). Oracles:
+
+- greedy short-context EXACT token parity vs the fp default, incl. TP=4
+  (quantization noise below the argmax margin of a minimally trained
+  model — the int8-KV contract, PR 7);
+- TP=1 and knob-off are bit-frozen no-ops (same programs, same tokens);
+- serving output with the knob on is bit-identical to solo generate()
+  with the knob on (the shared-decode-step discipline);
+- the capacity advisor's quantized_collectives lever reports the lever
+  as ACHIEVED when serving with the knob on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model, tiny_test
+from deepspeed_tpu.runtime.dataloader import DataLoader, random_token_dataset
+from deepspeed_tpu.serving import ServingEngine
+
+EOS = 1
+M = 64
+
+
+def _trained(mcfg_overrides=None, steps=16, lr=3e-3, seed=4):
+    """A briefly-trained tiny model: confident next-token margins, so the
+    int8 psum noise stays below the greedy argmax gap (the parity
+    contract — random init's near-ties are degenerate for ANY lossy
+    wire, int8 KV included)."""
+    mcfg = tiny_test(max_seq=M, dtype=jnp.float32,
+                     **(mcfg_overrides or {}))
+    model = build_model(mcfg)
+    eng = ds.initialize({
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": lr}},
+        "mesh": {"data": 8}, "seed": 0}, model)
+    data = random_token_dataset(64, 32, 256, learnable=True, seed=seed)
+    dl = DataLoader(data, local_batch_size=8, shuffle=False)
+    batches = [dl.collate_fn(data[i * 8:(i + 1) * 8]) for i in range(8)]
+    for i in range(steps):
+        eng.train_batch(batches[i % len(batches)])
+    params = jax.tree.map(lambda a: np.asarray(a, np.float32),
+                          eng.state.master_params)
+    prompts = [np.asarray(data[i]["input_ids"][:p], np.int32)
+               for i, p in enumerate((9, 21, 5, 14))]
+    return model, params, prompts
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return _trained()
+
+
+BASE = {"dtype": "float32", "eos_token_id": EOS}
+
+
+def _gen(engine, prompt, n, seed, greedy=True):
+    return np.asarray(engine.generate(
+        jnp.asarray(prompt[None]), n, greedy=greedy,
+        request_seeds=[seed], cache_len=M))
+
+
+def test_greedy_parity_tp4(trained):
+    model, params, prompts = trained
+    e_fp = ds.init_inference(model, params,
+                             {**BASE, "tensor_parallel": 4})
+    e_q = ds.init_inference(model, params,
+                            {**BASE, "tensor_parallel": 4,
+                             "tp_comm_quant": 8})
+    for i, p in enumerate(prompts):
+        a = _gen(e_fp, p, 10, 7 + i)
+        b = _gen(e_q, p, 10, 7 + i)
+        np.testing.assert_array_equal(a, b, err_msg=f"prompt {i}")
+
+
+def test_greedy_parity_tp2_glu_trunk():
+    """The GLU branch of the quantized-MLP spelling (llama-style
+    silu_glu): w_gate stays column-sharded collective-free, only the
+    w_out psum quantizes."""
+    model, params, prompts = _trained({"activation": "silu_glu",
+                                       "d_ff": 128}, steps=16)
+    e_fp = ds.init_inference(model, params,
+                             {**BASE, "tensor_parallel": 2})
+    e_q = ds.init_inference(model, params,
+                            {**BASE, "tensor_parallel": 2,
+                             "tp_comm_quant": 8})
+    for i, p in enumerate(prompts[:2]):
+        np.testing.assert_array_equal(_gen(e_fp, p, 8, 3 + i),
+                                      _gen(e_q, p, 8, 3 + i))
+
+
+def test_tp1_knob_is_noop(trained):
+    """tp_quant_dot declines meshes without a model axis: a TP=1 engine
+    with the knob on emits bit-identical tokens AND compiles the same
+    number of programs as the fp default."""
+    model, params, prompts = trained
+    e1 = ds.init_inference(model, params, dict(BASE))
+    e1q = ds.init_inference(model, params, {**BASE, "tp_comm_quant": 8})
+    for i, p in enumerate(prompts[:2]):
+        np.testing.assert_array_equal(_gen(e1, p, 6, 3 + i),
+                                      _gen(e1q, p, 6, 3 + i))
+    assert len(e1q._gen_cache) == len(e1._gen_cache)
+
+
+def test_knob_off_default_untouched(trained):
+    """tp_comm_quant=0 (the default) never stamps the model: the decode
+    trace takes the historical path exactly (no tp_quant attribute, no
+    gate evaluation beyond one getattr)."""
+    model, params, _ = trained
+    e = ds.init_inference(model, params,
+                          {**BASE, "tensor_parallel": 4})
+    assert int(getattr(e.model, "tp_quant", 0) or 0) == 0
+
+
+def test_bad_knob_value_rejected(trained):
+    model, params, _ = trained
+    with pytest.raises(ValueError, match="tp_comm_quant"):
+        ds.init_inference(model, params, {**BASE, "tp_comm_quant": 4})
+
+
+def test_serving_matches_solo_with_tp_quant(trained):
+    """Serving with the quantized TP wire is bit-identical to solo
+    generate() with the same knob (ONE decode_step definition), and the
+    serving engine surfaces Serve/tp_quant_bits + the achieved lever."""
+    model, params, prompts = trained
+    e_q = ds.init_inference(model, params,
+                            {**BASE, "tensor_parallel": 4,
+                             "tp_comm_quant": 8})
+    reqs = [(prompts[0], 6, 70), (prompts[2], 8, 71)]
+    scfg = {"slots": 2, "max_len": M, "prefill_chunk": 16, "greedy": True}
+    srv = ServingEngine(e_q, scfg)
+    outs = srv.serve_batch([p for p, _, _ in reqs],
+                           [n for _, n, _ in reqs],
+                           [s for _, _, s in reqs])
+    for (p, n, s), got in zip(reqs, outs):
+        want = _gen(e_q, p, n, s)[0]
+        np.testing.assert_array_equal(got, want[:len(got)])
+        assert np.all(want[len(got):] == EOS)
+    snap = srv.stats.registry.snapshot()["gauges"]
+    assert snap.get("Serve/tp_quant_bits") == 8.0
+    rep = srv.capacity_report(census=False)
+    lever = {d["name"]: d for d in rep["advisor"]["levers"]}
+    ach = lever["quantized_collectives"]["estimate"].get("achieved")
+    assert ach is not None and ach["tp_quant_bits"] == 8
+    assert "ACTIVE" in lever["quantized_collectives"]["why"]
+    assert lever["quantized_collectives"]["score"] == 0.0  # unmeasured CPU
