@@ -49,6 +49,18 @@ sleep 20
 # COMMSCOPE_BENCH.json and the newest MULTICHIP round (must run AFTER
 # bench_commscope: it annotates that artifact in place).
 python bench_overlap.py || { echo "[bench_all] overlap failed"; fails=$((fails+1)); }
+sleep 20
+# Serving engine: static-vs-continuous goodput, multi-turn prefix
+# sharing, and the self-speculative decoding rows (spec-on vs spec-off
+# accepted-tokens/step, verify-step overhead, wall goodput speedup,
+# greedy parity) into SERVING_BENCH.json.
+python bench_serving.py || { echo "[bench_all] serving failed"; fails=$((fails+1)); }
+sleep 20
+# Replay observatory: capture/replay parity and the advisor backtest —
+# incl. the speculative_decoding lever (predicted vs achieved
+# first-draft acceptance, +-10 pt band) — into REPLAY_BENCH.json and
+# BACKTEST_REPORT.json.
+python bench_replay.py || { echo "[bench_all] replay failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

@@ -186,6 +186,8 @@ def smoke():
     bt = advisor_backtest(trace, eng,
                           {"slots": 2, "max_len": max_len,
                            "prefill_chunk": 16, "greedy": True},
+                          levers=("prefix_sharing", "kv_quantization",
+                                  "speculative_decoding"),
                           capacity_report=cap_report, page_size=8)
     ps = bt["levers"]["prefix_sharing"]
     assert ps["source"] == "capacity_report", ps["source"]
@@ -194,6 +196,12 @@ def smoke():
     kv = bt["levers"]["kv_quantization"]
     assert kv["achieved"] is not None and kv["achieved"] <= 0.5, \
         "int8 KV failed to at least halve ledger bytes/token in replay"
+    sd = bt["levers"]["speculative_decoding"]
+    assert sd["parity"] is True, \
+        "greedy spec-on replay diverged from recorded tokens"
+    assert sd.get("abs_error_pts") is not None and \
+        sd["abs_error_pts"] <= 10, \
+        f"speculation prediction off by {sd.get('abs_error_pts')} pts"
     write_backtest_report(bt, os.path.join(_ROOT, "BACKTEST_REPORT.json"))
     rep.write(os.path.join(_ROOT, "REPLAY_REPORT.json"))
     res["backtest"] = {
@@ -202,6 +210,9 @@ def smoke():
         "prefix_sharing_abs_error_pts": round(ps["abs_error_pts"], 2),
         "kv_bytes_ratio_predicted": kv["predicted"],
         "kv_bytes_ratio_achieved": kv["achieved"],
+        "speculation_predicted": sd["predicted"],
+        "speculation_achieved": sd["achieved"],
+        "speculation_abs_error_pts": round(sd["abs_error_pts"], 2),
         "what_if_ttft_p50_s": ps["what_if"]["ttft_p50_s"],
         "what_if_goodput_frac": ps["what_if"]["goodput_frac"],
     }
@@ -278,6 +289,8 @@ def main():
     bt = advisor_backtest(trace, eng,
                           {"slots": 4, "max_len": max_len,
                            "prefill_chunk": 16, "greedy": True},
+                          levers=("prefix_sharing", "kv_quantization",
+                                  "speculative_decoding"),
                           capacity_report=cap_report, page_size=8)
     led = pl.update_ledger(_ROOT, os.path.join(_ROOT, "PERF_LEDGER.json"))
     res = {

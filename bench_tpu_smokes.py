@@ -79,8 +79,63 @@ def _smoke_fp16_offload():
             "loss_scale": m["loss_scale"], "skipped": m["skipped"]}
 
 
+def _smoke_spec_decode():
+    """Self-speculative serving lane (PR-16): greedy spec-on must
+    reproduce spec-off bit-exactly while committing >= 1 token per
+    slot-step, and the fixed-shape verify must not mint compile shapes
+    per acceptance count — a second traffic batch with different
+    accept/reject patterns compiles NOTHING new. CPU-runnable (tier-1
+    wiring lives in tests/unit/test_speculation.py); on TPU it proves
+    the T=k+1 verify program lowers on the real backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model, tiny_test
+
+    cfg = tiny_test(n_layer=2, d_model=64, d_ff=128, n_head=4,
+                    max_seq=128, dtype=jnp.float32)
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = ds.init_inference(model, params, {"dtype": "float32"})
+
+    def traffic(seed):
+        rng = np.random.default_rng(seed)
+        return [np.tile(rng.integers(0, 64, (4,)).astype(np.int32), 5)
+                for _ in range(5)]
+
+    base = {"slots": 3, "max_len": 128, "prefill_chunk": 16,
+            "greedy": True, "page_size": 16}
+    spec = {**base, "speculation": {"ngram": 3, "max_draft": 4}}
+    prompts, max_new = traffic(7), [24] * 5
+    srv = ds.ServingEngine(eng, base)
+    want = srv.serve_batch(prompts, max_new)
+    srv.close()
+    srv = ds.ServingEngine(eng, spec)
+    got = srv.serve_batch(prompts, max_new)
+    assert all(np.array_equal(a, b) for a, b in zip(want, got)), \
+        "greedy spec-on diverged from spec-off"
+    snap = srv.spec_snapshot()
+    assert snap["verify_steps"] > 0, snap
+    assert snap["accepted_tokens_per_step"] >= 1.0, snap
+    warm = srv.compiles
+    srv.serve_batch(traffic(8), max_new)   # new acceptance patterns
+    assert srv.compiles == warm, \
+        f"{srv.compiles - warm} new compiles after warmup — verify " \
+        "shape must not depend on acceptance counts"
+    snap = srv.spec_snapshot()
+    srv.close()
+    return {"parity_requests": len(prompts),
+            "verify_steps": snap["verify_steps"],
+            "accepted_tokens_per_step":
+                round(snap["accepted_tokens_per_step"], 3),
+            "new_compiles_after_warmup": 0}
+
+
 _SMOKES = {"bf16_pipeline": _smoke_bf16_pipeline,
-           "fp16_offload": _smoke_fp16_offload}
+           "fp16_offload": _smoke_fp16_offload,
+           "spec_decode": _smoke_spec_decode}
 
 
 def _run_child():

@@ -125,6 +125,15 @@ class ServingConfig:
     # runs only when a capacity report asks). None (default) builds
     # nothing: one `is not None` per admission/retirement/eviction.
     kvscope: "object | None" = None
+    # Draft-free self-speculative decoding
+    # (inference.speculation.SpeculationConfig | dict): per-slot n-gram
+    # prompt-lookup drafting + one fixed-shape length-(max_draft+1)
+    # verify forward per decode step, with page-table-aware rollback of
+    # rejected tokens. Requires greedy sampling (the serving engine
+    # enforces it — greedy spec-on is bit-identical to greedy spec-off).
+    # None (default) builds nothing: the decode lane stays the plain
+    # one-token step.
+    speculation: "object | None" = None
     # Goodput/badput wall-time attribution (observability/goodput.py):
     # decomposes elapsed wall time into productive decode/prefill vs
     # badput buckets (compile, queue-empty idle, watchdog stall, drain,
@@ -218,6 +227,10 @@ class ServingConfig:
             from ..observability.kvscope import KVScopeConfig
 
             self.kvscope = KVScopeConfig.from_any(self.kvscope)
+        if self.speculation is not None:
+            from .speculation import SpeculationConfig
+
+            self.speculation = SpeculationConfig.from_any(self.speculation)
         if self.telemetry is not None:
             from ..observability.server import TelemetryConfig
 

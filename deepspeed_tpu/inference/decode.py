@@ -211,33 +211,37 @@ def dequantize_kv(q, scale, dtype, axis: int = -1):
 
 
 def _paged_append(ck, cv, ks, vs, k, v, page_table, new_len):
-    """Append one decode token's K/V per slot into the page pool.
+    """Append T decode tokens' K/V per slot into the page pool.
 
     ``ck``/``cv`` are one layer's pools ``(pages, KV, page_size, hd)``;
-    ``k``/``v`` the new projections ``(B, 1, KV, hd)``; ``new_len`` the
-    (B,) post-append lengths. Each row's write position ``new_len - 1``
-    maps through its ``page_table`` row to (pool page, in-page offset) —
-    one scatter per pool. Rows whose table entries are scratch (idle or
-    freshly retired slots) write harmlessly into page 0; a live row past
-    its last page clips onto scratch-redirected entries the host cleared
-    at retirement, so stale rows can never touch another slot's pages."""
-    B = k.shape[0]
+    ``k``/``v`` the new projections ``(B, T, KV, hd)``; ``new_len`` the
+    (B,) post-append lengths. Row ``b``'s token ``j`` writes position
+    ``new_len[b] - T + j``, which maps through its ``page_table`` row to
+    (pool page, in-page offset) — one scatter per pool covering all B·T
+    writes. T == 1 is the plain decode step; T > 1 is the speculative
+    verify forward (``serving/engine.py``), whose headroom gate
+    guarantees every live row has ``new_len <= max_len`` so the clip
+    below never folds a live write back onto the row's last page. Rows
+    whose table entries are scratch (idle or freshly retired slots)
+    write harmlessly into page 0; a live row past its last page clips
+    onto scratch-redirected entries the host cleared at retirement, so
+    stale rows can never touch another slot's pages."""
+    B, T = k.shape[0], k.shape[1]
     ps, n = ck.shape[2], page_table.shape[1]
-    pos = new_len - 1
+    pos = (new_len - T)[:, None] + jnp.arange(T, dtype=new_len.dtype)[None, :]
     pidx = jnp.clip(pos // ps, 0, n - 1)
-    pid = jnp.take_along_axis(page_table, pidx[:, None], axis=1)[:, 0]
+    pid = jnp.take_along_axis(page_table, pidx, axis=1)     # (B, T)
     off = pos % ps
-    kb, vb = k[:, 0], v[:, 0]                      # (B, KV, hd)
     if ks is not None:
-        qk, sk = quantize_kv(kb)
-        qv, sv = quantize_kv(vb)
+        qk, sk = quantize_kv(k)
+        qv, sv = quantize_kv(v)
         ck = ck.at[pid, :, off, :].set(qk)
         cv = cv.at[pid, :, off, :].set(qv)
         ks = ks.at[pid, :, off].set(sk)
         vs = vs.at[pid, :, off].set(sv)
     else:
-        ck = ck.at[pid, :, off, :].set(kb.astype(ck.dtype))
-        cv = cv.at[pid, :, off, :].set(vb.astype(cv.dtype))
+        ck = ck.at[pid, :, off, :].set(k.astype(ck.dtype))
+        cv = cv.at[pid, :, off, :].set(v.astype(cv.dtype))
     return ck, cv, ks, vs
 
 
@@ -338,10 +342,11 @@ def _layer_step(model, x, p, cache_k, cache_v, length, positions,
     HBM each step — never a hoisted bf16 copy.
 
     ``paged`` is ``(page_table, k_scale, v_scale)`` for the pooled page
-    layout (T == 1 serving decode only): the append scatters through the
-    page table and the attention read gathers the slot's pages back into
-    the contiguous view — same values, same mask math, so the fp paged
-    step is bit-identical to the contiguous one by construction.
+    layout (serving decode: T == 1 plain steps, T == max_draft + 1
+    speculative verify): the append scatters through the page table and
+    the attention read gathers the slot's pages back into the contiguous
+    view — same values, same mask math, so the fp paged step is
+    bit-identical to the contiguous one by construction.
     """
     cfg = model.cfg
     B, T, d = x.shape
@@ -485,7 +490,8 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     empty) and decode (T = 1). Returns (fp32 logits (B, T, V), new cache).
     ``cache.length`` may be a scalar (every row at the same position) or a
     (B,) per-slot vector (serving: each slot appends at its own length).
-    ``cache`` may also be a :class:`PagedKVCache` (T == 1 only): appends
+    ``cache`` may also be a :class:`PagedKVCache` (decode-side T: the
+    plain step's 1 or the speculative verify's max_draft + 1): appends
     scatter through the slot page tables and the attention read gathers
     each slot's pages — page-table CONTENTS are data, so traffic churn
     never changes the program.
@@ -499,11 +505,11 @@ def forward_with_cache(model, params, input_ids, cache: KVCache,
     cfg = model.cfg
     B, T = input_ids.shape
     paged = isinstance(cache, PagedKVCache)
-    if paged and T != 1:
-        raise ValueError(
-            "the paged KV cache serves the T == 1 slot decode step only; "
-            "prefill runs through a contiguous per-request cache and is "
-            "scattered into pages at insert (serving/pages.py)")
+    # Paged T > 1 is the serving engine's speculative verify forward
+    # (carry token + drafts in one fixed-shape call); its headroom gate
+    # keeps every live slot's post-append length within max_len. Prefill
+    # still runs through a contiguous per-request cache and is scattered
+    # into pages at insert (serving/pages.py).
     new_len = cache.length + T
     per_slot = getattr(cache.length, "ndim", 0) == 1
     if positions is None:

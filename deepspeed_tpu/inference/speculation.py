@@ -1,0 +1,166 @@
+"""Self-speculative (prompt-lookup) drafting: the shared n-gram helper.
+
+Reference analog: DeepSpeed-FastGen / Medusa-class speculative decoding,
+restricted to the draft-free variant — the "draft model" is an n-gram
+table over the request's OWN token history (prompt + everything emitted
+so far), so acceptance is pure profit on repetitive traffic and zero
+extra weights are resident. The same table spelling serves three
+consumers, which is the whole point of this module:
+
+- the OFFLINE estimator (``observability/workload.py:selfspec_acceptance``)
+  that prices the lever before it is switched on,
+- the LIVE drafter inside ``serving/engine.py``'s decode lane, and
+- the replay backtest that checks predicted-vs-achieved acceptance.
+
+One implementation means predicted and achieved acceptance cannot drift
+by construction. The serving engine verifies drafts with a single
+fixed-shape length-``max_draft + 1`` forward (chunked-prefill spelling:
+the number of ACCEPTED tokens is host-side data, never a compile shape),
+and under greedy sampling the verified stream is bit-identical to plain
+decode — see ``docs/SERVING.md``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SpeculationConfig", "NGramTable", "acceptance_stats"]
+
+
+@dataclass
+class SpeculationConfig:
+    """The ``serving.speculation`` block.
+
+    ``ngram`` is the context length of the lookup table (matches the
+    workload estimator's ``ngram`` so the estimator prices exactly the
+    drafter that runs); ``max_draft`` is the per-step draft ceiling, so
+    the verify forward is a fixed ``max_draft + 1``-token program.
+    Speculation requires greedy sampling (the parity guarantee is
+    argmax-chaining); the serving engine enforces that at construction.
+    """
+
+    enabled: bool = True
+    ngram: int = 3
+    max_draft: int = 4
+
+    def __post_init__(self):
+        if self.ngram < 1:
+            raise ValueError(f"speculation.ngram must be >= 1, got {self.ngram}")
+        if self.max_draft < 1:
+            raise ValueError(
+                f"speculation.max_draft must be >= 1, got {self.max_draft}")
+
+    @classmethod
+    def from_any(cls, obj) -> "SpeculationConfig":
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            unknown = set(obj) - {f for f in cls.__dataclass_fields__}
+            if unknown:
+                raise ValueError(
+                    f"unknown speculation config keys: {sorted(unknown)}")
+            return cls(**obj)
+        raise TypeError(f"cannot build SpeculationConfig from {type(obj)!r}")
+
+
+class NGramTable:
+    """Most-recent-occurrence n-gram lookup over one token stream.
+
+    ``extend`` feeds tokens in order; each full ``ngram``-length context
+    maps to the token that followed it, last write wins. ``predict``
+    looks up the CURRENT trailing context, ``draft`` chains predictions
+    (feeding each predicted token back as context) until the table has
+    no entry or ``k`` tokens are drafted. The estimator's
+    predict-then-extend loop reproduces the historical
+    lookup-before-insert scoring exactly.
+    """
+
+    __slots__ = ("ngram", "_table", "_ctx")
+
+    def __init__(self, ngram: int):
+        if ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {ngram}")
+        self.ngram = int(ngram)
+        self._table: dict = {}
+        self._ctx: tuple = ()
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        ctx, n = self._ctx, self.ngram
+        for t in tokens:
+            t = int(t)
+            if len(ctx) == n:
+                self._table[ctx] = t
+            ctx = (ctx + (t,))[-n:]
+        self._ctx = ctx
+
+    def predict(self) -> Optional[int]:
+        if len(self._ctx) != self.ngram:
+            return None
+        return self._table.get(self._ctx)
+
+    def draft(self, k: int) -> list:
+        """Chain up to ``k`` predictions from the trailing context.
+
+        The chain stops at the first context with no table entry; the
+        speculative continuation is only as long as the history supports.
+        Chaining mutates nothing — the table and trailing context are
+        restored before returning, so a draft is a pure read.
+        """
+        if len(self._ctx) != self.ngram or k <= 0:
+            return []
+        out = []
+        ctx = self._ctx
+        for _ in range(k):
+            pred = self._table.get(ctx)
+            if pred is None:
+                break
+            out.append(pred)
+            ctx = (ctx + (pred,))[-self.ngram:]
+        return out
+
+
+def acceptance_stats(tokens, ngram: int) -> Optional[dict]:
+    """Score a finished token stream as if the prompt-lookup drafter had
+    run over it: at each position past the first ``ngram`` tokens, would
+    the table (built from the stream so far) have predicted the actual
+    next token?
+
+    Returns None when the stream is too short to score, else a dict:
+
+    - ``scored``: positions scored (``len(tokens) - ngram``),
+    - ``predicted``: positions where the table HAD a prediction,
+    - ``hits``: positions where that prediction matched,
+    - ``rate``: ``hits / scored`` — the historical estimator semantics
+      (no-prediction counts as a miss), and
+    - ``hit_rate``: ``hits / predicted`` — the conditional rate, which
+      is what the LIVE drafter's first-draft accept rate converges to
+      (the live drafter simply doesn't propose when there's no entry).
+    """
+    toks = np.asarray(tokens).reshape(-1).tolist()
+    n = len(toks)
+    if n <= ngram:
+        return None
+    tab = NGramTable(ngram)
+    tab.extend(toks[:ngram])
+    hits = predicted = 0
+    for t in toks[ngram:]:
+        pred = tab.predict()
+        if pred is not None:
+            predicted += 1
+            if pred == int(t):
+                hits += 1
+        tab.extend((int(t),))
+    scored = n - ngram
+    return {
+        "scored": scored,
+        "predicted": predicted,
+        "hits": hits,
+        "rate": hits / scored,
+        "hit_rate": (hits / predicted) if predicted else None,
+    }

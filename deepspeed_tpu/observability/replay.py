@@ -756,10 +756,42 @@ def _lever_prediction(lever: str, capacity_report: Optional[dict],
     return None, "none"
 
 
+def _speculation_prediction(trace: TrafficTrace, ngram: int) \
+        -> "tuple[Optional[float], str]":
+    """Predicted first-draft acceptance for the self-speculation lever:
+    the shared n-gram helper (the SAME implementation the live drafter
+    runs) scored over each recorded request's prompt + reference output,
+    restricted to the decode region and CONDITIONED on the table having
+    a prediction — exactly what the live drafter's per-step first-draft
+    accept rate measures (it only proposes when the table has an
+    entry). Pooled over the trace. None when no recorded output is long
+    enough to score."""
+    from ..inference.speculation import acceptance_stats
+
+    results = trace.results
+    hits = predicted = 0
+    for e in trace.requests:
+        prompt = resolve_prompt(e).tolist()
+        ref = (results.get(e["rid"]) or {}).get("tokens") or []
+        if not ref:
+            continue
+        full = acceptance_stats(prompt + [int(t) for t in ref], ngram)
+        if full is None:
+            continue
+        head = acceptance_stats(prompt, ngram) \
+            or {"hits": 0, "predicted": 0}
+        hits += full["hits"] - head["hits"]
+        predicted += full["predicted"] - head["predicted"]
+    if not predicted:
+        return None, "ngram_estimator"
+    return hits / predicted, "ngram_estimator"
+
+
 def advisor_backtest(trace: TrafficTrace, engine, serving: dict,
                      levers=("prefix_sharing", "kv_quantization"),
                      capacity_report: Optional[dict] = None,
-                     page_size: int = 8) -> dict:
+                     page_size: int = 8,
+                     speculation: Optional[dict] = None) -> dict:
     """Score the capacity advisor against reality: replay ``trace``
     under each lever's what-if config and compare the advisor's
     prediction to the achieved outcome — the prediction-error report.
@@ -780,6 +812,13 @@ def advisor_backtest(trace: TrafficTrace, engine, serving: dict,
       (the ±10-point acceptance band in ``bench_replay.py --smoke``).
     - ``kv_quantization`` — predicted int8/fp KV bytes-per-token ratio
       (the ledger math) vs the achieved ledger ratio in the int8 replay.
+    - ``speculative_decoding`` — predicted first-draft acceptance (the
+      shared n-gram helper scored over each recorded request's decode
+      region, conditioned on the table proposing) vs the ACHIEVED live
+      first-draft accept rate from the spec-on replay's engine
+      snapshot. The what-if forces ``greedy: True`` (self-speculation
+      requires it); ``speculation`` overrides the lever's config
+      (default ``{"ngram": 3, "max_draft": 4}``).
     """
     from ..serving.engine import ServingEngine
 
@@ -799,6 +838,7 @@ def advisor_backtest(trace: TrafficTrace, engine, serving: dict,
             "ttft_p50_s": (snap.get("ttft_s") or {}).get("p50"),
             "goodput_frac": gp.get("goodput_frac"),
             "kv_per_token_bytes": ledger.get("kv_per_token_bytes"),
+            "speculation": srv.spec_snapshot(),
         }
         srv.close()
         return rep, achieved
@@ -855,6 +895,20 @@ def advisor_backtest(trace: TrafficTrace, engine, serving: dict,
         if predicted is not None and achieved is not None:
             entry["abs_error_pts"] = abs(predicted - achieved) * 100.0
         out["levers"]["kv_quantization"] = entry
+    if "speculative_decoding" in levers:
+        spec_cfg = dict(speculation or {"ngram": 3, "max_draft": 4})
+        predicted, source = _speculation_prediction(
+            trace, int(spec_cfg.get("ngram", 3)))
+        rep, ach = run({"page_size": page_size, "prefix_sharing": True,
+                        "greedy": True, "speculation": spec_cfg})
+        spec_snap = ach.get("speculation") or {}
+        achieved = spec_snap.get("first_accept_rate")
+        entry = {"predicted": predicted, "source": source,
+                 "achieved": achieved, "what_if": ach,
+                 "parity": rep.parity}
+        if predicted is not None and achieved is not None:
+            entry["abs_error_pts"] = abs(predicted - achieved) * 100.0
+        out["levers"]["speculative_decoding"] = entry
     return out
 
 

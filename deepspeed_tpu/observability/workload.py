@@ -134,20 +134,17 @@ def selfspec_acceptance(tokens: np.ndarray, ngram: int) -> Optional[float]:
     fraction of scored positions whose next token is correctly predicted
     by the most recent earlier occurrence of the preceding ``ngram``
     tokens — exactly what an n-gram self-speculator drafts. None when the
-    sequence is too short to score a single position."""
-    toks = tuple(np.asarray(tokens).reshape(-1).tolist())
-    n = len(toks)
-    if n <= ngram:
-        return None
-    table: dict = {}
-    hits = 0
-    for i in range(ngram, n):
-        key = toks[i - ngram:i]
-        pred = table.get(key)
-        if pred is not None and pred == toks[i]:
-            hits += 1
-        table[key] = toks[i]
-    return hits / (n - ngram)
+    sequence is too short to score a single position.
+
+    Runs on the SAME :class:`~..inference.speculation.NGramTable` the
+    live drafter uses, so the estimate and the serving engine's achieved
+    acceptance cannot drift: both are one implementation scored two ways
+    (here unconditionally — a position with no table entry counts as a
+    miss — because the estimator prices the whole stream)."""
+    from ..inference.speculation import acceptance_stats
+
+    stats = acceptance_stats(tokens, ngram)
+    return None if stats is None else stats["rate"]
 
 
 class WorkloadAnalyzer:
@@ -181,6 +178,15 @@ class WorkloadAnalyzer:
         self.shared_tokens = 0          # tokens covered by a seen prefix
         self.resume_tokens = 0          # covered by the SAME session
         self.requests = 0
+        # live self-speculation tallies (``on_spec``): what the drafter
+        # ACHIEVED, exported next to the offline estimate above so
+        # predicted-vs-achieved is one snapshot read.
+        self.spec_steps = 0             # verify steps scored
+        self.spec_proposed = 0          # draft tokens proposed
+        self.spec_accepted = 0          # draft tokens accepted
+        self.spec_emitted = 0           # tokens emitted by verify steps
+        self.spec_first_scored = 0      # slots with a non-empty draft
+        self.spec_first_hits = 0        # ... whose FIRST draft token hit
 
     # ------------------------------------------------------------ admission
     def _match_and_insert(self, bounds: list) -> int:
@@ -264,6 +270,46 @@ class WorkloadAnalyzer:
                 "resume_prefix_tokens": resume,
                 "selfspec_accept": accept}
 
+    # ---------------------------------------------------------- speculation
+    def on_spec(self, proposed: int, accepted: int, emitted: int,
+                first_scored: int = 0, first_hits: int = 0) -> None:
+        """Record one verify step's live outcome (the serving engine's
+        decode lane calls this once per speculative step, summed over
+        slots). ``first_scored`` / ``first_hits`` isolate the FIRST draft
+        token per slot — the live counterpart of the offline estimator's
+        per-position hit rate, which is what the replay backtest compares
+        against the prediction."""
+        self.spec_steps += 1
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
+        self.spec_emitted += int(emitted)
+        self.spec_first_scored += int(first_scored)
+        self.spec_first_hits += int(first_hits)
+        r = self.registry
+        r.counter("Serve/workload_spec_proposed_tokens").inc(int(proposed))
+        r.counter("Serve/workload_spec_accepted_tokens").inc(int(accepted))
+        r.counter("Serve/workload_spec_emitted_tokens").inc(int(emitted))
+        if self.spec_proposed:
+            r.gauge("Serve/workload_spec_accept_rate").set(
+                self.spec_accepted / self.spec_proposed)
+        if self.spec_first_scored:
+            r.gauge("Serve/workload_spec_first_accept_rate").set(
+                self.spec_first_hits / self.spec_first_scored)
+
+    @property
+    def spec_accept_rate(self) -> "float | None":
+        """Achieved draft-token acceptance fraction (live), None before
+        any draft was verified."""
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else None)
+
+    @property
+    def spec_first_accept_rate(self) -> "float | None":
+        """Achieved FIRST-draft-token acceptance (live) — the comparable
+        of the offline estimator's conditional ``hit_rate``."""
+        return (self.spec_first_hits / self.spec_first_scored
+                if self.spec_first_scored else None)
+
     # ----------------------------------------------------------- retirement
     def on_retire(self, request) -> None:
         """Record the decode-side shape of a terminated request (accepts
@@ -304,6 +350,14 @@ class WorkloadAnalyzer:
             "block": self.cfg.block,
             "ngram": self.cfg.ngram,
             "selfspec_accept": accept,
+            "spec_live": {
+                "steps": self.spec_steps,
+                "proposed_tokens": self.spec_proposed,
+                "accepted_tokens": self.spec_accepted,
+                "emitted_tokens": self.spec_emitted,
+                "accept_rate": self.spec_accept_rate,
+                "first_accept_rate": self.spec_first_accept_rate,
+            },
             "prompt_len": h.get("Serve/workload_prompt_len", {}),
             "decode_len": h.get("Serve/workload_decode_len", {}),
             "analysis_s": h.get("Serve/workload_analysis_s", {}),

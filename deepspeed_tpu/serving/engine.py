@@ -38,6 +38,7 @@ from ..inference.decode import (GenCarry, decode_step, forward_with_cache,
                                 init_cache)
 from ..inference.engine import InferenceEngine
 from ..inference.sampling import per_request_keys, split_keys
+from ..inference.speculation import NGramTable
 from ..observability import spans as _spans
 from ..observability.export import request_record
 from ..observability.tracing import ServingStats
@@ -126,6 +127,42 @@ class ServingEngine:
         self._sampler = engine._sampler(self.cfg.temperature, self.cfg.top_k,
                                         self.cfg.top_p, self.cfg.greedy)
         self._mat = engine._materialized if engine.config.quantize else None
+        # ---- self-speculative decoding (inference/speculation.py,
+        # docs/SERVING.md): per-slot n-gram prompt-lookup drafts verified
+        # by ONE fixed-shape length-(max_draft+1) forward per step. None
+        # (default) leaves the decode lane the plain one-token step —
+        # same program set, bit-identical behavior.
+        self._spec = None
+        sp = self.cfg.speculation
+        if sp is not None and sp.enabled:
+            if not (self.cfg.greedy or self.cfg.temperature == 0.0):
+                raise ValueError(
+                    "speculation requires greedy sampling (serving.greedy "
+                    "= True or temperature = 0): the parity guarantee is "
+                    "argmax chaining — stochastic sampling cannot be "
+                    "verified against a draft bit-exactly")
+            if self._flash:
+                raise ValueError(
+                    "speculation requires flash_decode off: the verify "
+                    "forward runs the dense cache attention (T > 1), and "
+                    "greedy parity is guaranteed only when the plain step "
+                    "uses the same kernel")
+            self._spec = sp
+        # slot -> [rid, NGramTable, tokens_fed]: the per-slot drafter
+        # state, lazily (re)built from prompt + emitted history so
+        # placement, fleet adoption, and plain-step fallbacks all stay
+        # in sync without hooks
+        self._spec_tables: dict = {}
+        self._spec_steps = 0           # verify forwards run
+        self._spec_proposed = 0        # draft tokens proposed
+        self._spec_accepted = 0        # draft tokens accepted
+        self._spec_first_scored = 0    # slots with a non-empty draft
+        self._spec_first_hits = 0      # ... whose first draft hit
+        # decode-lane totals, BOTH lanes: emitted/slot-steps is the
+        # accepted-tokens-per-step the goodput rollup and benches report
+        # (exactly 1.0 when speculation is off)
+        self._decode_slot_steps = 0
+        self._decode_emitted = 0
         kw = {"clock": clock} if clock is not None else {}
         self.stats = ServingStats(registry=registry, **kw)
         # quantized TP decode collective (inference.tp_comm_quant): the
@@ -459,6 +496,213 @@ class ServingEngine:
                            eos_token_id=self._eos, flash_decode=self._flash,
                            logit_guard=True, poison_row=poison_row)
 
+    # --------------------------------------------------- self-speculation
+    def _spec_verify_impl(self, params, carry, drafts):
+        """The fixed-shape verify forward: every slot's carried token +
+        its (zero-padded) drafts run as ONE length-(max_draft + 1) call
+        through the same ``forward_with_cache`` the chunked prefill uses
+        — acceptance counts are host-side data, so this is the only
+        decode-side shape speculation ever compiles. ``argmax`` over the
+        fp32 logits IS the greedy sampler (``sample_logits`` with
+        ``greedy=True``), so position j's winner is bit-identical to the
+        token the plain step would sample after committing positions
+        < j. Raw (possibly WOQ-quantized) params, exactly like
+        ``_step_impl`` — the verify logits must match the plain step's
+        bitwise. The per-row finiteness flags ride the same fused
+        read-back as the winners (logit_guard discipline)."""
+        ids = jnp.concatenate([carry.tok[:, None], drafts], axis=1)
+        logits, cache = forward_with_cache(self.model, params, ids,
+                                           carry.cache)
+        m = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ok = jnp.all(jnp.isfinite(logits), axis=(1, 2))
+        return m, ok, carry._replace(cache=cache)
+
+    def _spec_commit_impl(self, carry, packed):
+        """Resolve the host-side acceptance: active rows rewind their
+        cache length to the committed extent (rejected drafts' KV past
+        it is dead by length — every future append overwrites position
+        == committed length first) and take their new carry token / done
+        flag. Inactive rows (idle slots, nonfinite-retired rows) keep
+        the verify step's values, mirroring how plain steps advance idle
+        rows — insert resets them either way.
+
+        ``packed`` is one (4, slots) int32 — active / new_len / new_tok /
+        new_done rows — so the commit costs a single host->device upload
+        per step instead of four."""
+        active = packed[0].astype(bool)
+        new_len, new_tok = packed[1], packed[2]
+        new_done = packed[3].astype(bool)
+        cache = carry.cache
+        length = jnp.where(active, new_len, cache.length)
+        tok = jnp.where(active, new_tok, carry.tok)
+        done = jnp.where(active, new_done, carry.done)
+        return carry._replace(tok=tok, done=done,
+                              cache=cache._replace(length=length))
+
+    def _spec_plan(self):
+        """Build this step's draft matrix from the per-slot n-gram
+        tables, or None to fall back to the plain step. Host-side only.
+
+        Fallbacks: (a) headroom — EVERY occupied slot must fit the full
+        verify write extent (``live + max_draft + 1 <= max_len``),
+        because both cache layouts clamp out-of-range writes in ways
+        that would fold onto live positions; (b) no slot drafted
+        anything — a verify forward with zero drafts is a plain step at
+        (max_draft + 1)x the FLOPs.
+
+        Drafter tables sync lazily against ``prompt + tokens`` (the
+        ``tokens_fed`` watermark), so plain-step fallbacks, fleet
+        adoption, and requeues need no hooks."""
+        spec = self._spec
+        K = spec.max_draft
+        running = self.sched.running
+        tables = self._spec_tables
+        for slot in list(tables):
+            req = running.get(slot)
+            if req is None or tables[slot][0] != req.rid:
+                del tables[slot]
+        drafts = np.zeros((self.cfg.slots, K), np.int32)
+        lens = np.zeros(self.cfg.slots, np.int32)
+        any_draft = False
+        for slot, req in running.items():
+            P = len(req.prompt)
+            total = P + len(req.tokens)
+            if total - 1 + K + 1 > self.cfg.max_len:
+                return None
+            ent = tables.get(slot)
+            if ent is None:
+                tab = NGramTable(spec.ngram)
+                tab.extend(np.asarray(req.prompt).reshape(-1).tolist())
+                tab.extend(req.tokens)
+                tables[slot] = [req.rid, tab, total]
+            else:
+                tab = ent[1]
+                if ent[2] < total:
+                    tab.extend(req.tokens[ent[2] - P:])
+                    ent[2] = total
+            cap = min(K, req.max_new - len(req.tokens) - 1)
+            if cap <= 0:
+                continue
+            d = tables[slot][1].draft(cap)
+            if d:
+                drafts[slot, :len(d)] = d
+                lens[slot] = len(d)
+                any_draft = True
+        return (drafts, lens) if any_draft else None
+
+    def _spec_verify_commit(self, plan):
+        """Run the verify forward, resolve per-slot acceptance host-side,
+        and commit the accepted extents — the speculative decode lane's
+        device work, all inside the caller's watchdog window. ONE fused
+        read-back (winners + finiteness flags), same discipline as the
+        plain step's. Returns ``(emitted, bad, tallies)`` for
+        :meth:`_spec_resolve` to feed the scheduler AFTER the timing
+        bookkeeping, exactly where ``on_step`` runs in the plain lane."""
+        drafts, lens = plan
+        ver = self._prog("spec_verify", lambda: jax.jit(
+            self._spec_verify_impl, donate_argnums=(1,)))
+        m_dev, ok_dev, self._state = ver(self.engine.params, self._state,
+                                         jnp.asarray(drafts))
+        m, vok = jax.device_get((m_dev, ok_dev))
+        eos = self._eos
+        B = self.cfg.slots
+        # rows: active, new_len, new_tok, new_done — one packed upload
+        packed = np.zeros((4, B), np.int32)
+        active, new_len, new_tok, new_done = packed
+        emitted: dict = {}
+        bad: list = []
+        proposed = accepted = first_scored = first_hits = 0
+        for slot, req in self.sched.running.items():
+            if not bool(vok[slot]):
+                bad.append(slot)
+                continue
+            dlen = int(lens[slot])
+            toks = [int(m[slot, 0])]
+            j = 0
+            # the acceptance chain: draft j survives iff it equals the
+            # verified winner at j-1 (then winner j is the next plain
+            # token); stop at the first miss or at eos — emissions past
+            # eos would diverge from the plain lane's retirement
+            while j < dlen and (eos is None or toks[-1] != eos) \
+                    and int(drafts[slot, j]) == toks[-1]:
+                toks.append(int(m[slot, j + 1]))
+                j += 1
+            proposed += dlen
+            accepted += j
+            if dlen:
+                first_scored += 1
+                if int(drafts[slot, 0]) == toks[0]:
+                    first_hits += 1
+            live = len(req.prompt) + len(req.tokens) - 1
+            active[slot] = True
+            new_len[slot] = live + len(toks)
+            new_tok[slot] = toks[-1]
+            new_done[slot] = eos is not None and toks[-1] == eos
+            emitted[slot] = toks
+        com = self._prog("spec_commit", lambda: jax.jit(
+            self._spec_commit_impl, donate_argnums=(0,)))
+        self._state = com(self._state, jnp.asarray(packed))
+        return emitted, bad, (proposed, accepted, first_scored, first_hits)
+
+    def _spec_resolve(self, spec_out) -> list:
+        """Scheduler + metrics half of the speculative lane: retire
+        nonfinite rows first (before their garbage could be appended),
+        commit every surviving slot's emissions (page-table rollback for
+        paged retirements happens inside ``on_spec_step``), and account
+        the step."""
+        emitted, bad, (proposed, accepted, first_scored, first_hits) = \
+            spec_out
+        finished: list = []
+        if bad:
+            finished += self.sched.retire_nonfinite(bad)
+            for slot in bad:
+                self._spec_tables.pop(slot, None)
+        n_emitted = sum(len(t) for t in emitted.values())
+        self._decode_slot_steps += len(emitted) + len(bad)
+        self._decode_emitted += n_emitted
+        finished += self.sched.on_spec_step(emitted)
+        self._spec_steps += 1
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._spec_first_scored += first_scored
+        self._spec_first_hits += first_hits
+        r = self.stats.registry
+        r.counter("Serve/spec_steps").inc()
+        r.counter("Serve/spec_draft_tokens").inc(proposed)
+        r.counter("Serve/spec_accepted_tokens").inc(accepted)
+        r.counter("Serve/spec_emitted_tokens").inc(n_emitted)
+        if self.workload is not None:
+            self.workload.on_spec(proposed, accepted, n_emitted,
+                                  first_scored, first_hits)
+        return finished
+
+    def spec_snapshot(self) -> Optional[dict]:
+        """Live speculation readout (None when the lane is off): the
+        accepted-tokens-per-step multiple over BOTH lanes (plain steps
+        count 1 token per slot, so the ratio is the wall-clock decode
+        multiple), the draft acceptance rates, and the raw tallies the
+        fleet rollup sums."""
+        if self._spec is None:
+            return None
+        steps = self._decode_slot_steps
+        return {
+            "ngram": self._spec.ngram,
+            "max_draft": self._spec.max_draft,
+            "verify_steps": self._spec_steps,
+            "proposed_tokens": self._spec_proposed,
+            "accepted_tokens": self._spec_accepted,
+            "slot_steps": steps,
+            "emitted_tokens": self._decode_emitted,
+            "accepted_tokens_per_step":
+                (self._decode_emitted / steps) if steps else None,
+            "accept_rate":
+                (self._spec_accepted / self._spec_proposed)
+                if self._spec_proposed else None,
+            "first_accept_rate":
+                (self._spec_first_hits / self._spec_first_scored)
+                if self._spec_first_scored else None,
+        }
+
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                seed: int = 0, ttft_deadline_s: Optional[float] = None,
@@ -599,9 +843,14 @@ class ServingEngine:
             if self._prefill is not None:
                 finished += self._prefill_advance()
                 ran_chunk = True
-            # decode lane: every occupied slot advances one token
+            # decode lane: every occupied slot advances one token — or,
+            # with speculation on, up to max_draft + 1 through one
+            # fixed-shape verify forward (chaos keeps the plain step:
+            # poison-row semantics are per-token)
             if self.sched.running:
                 t0 = self.stats.clock()
+                n_slots = len(self.sched.running)
+                plan = spec_out = None
                 if chaos is not None:
                     chaos.maybe_hang(it)
                     poison = chaos.poison_slot(self.sched.running.keys())
@@ -610,23 +859,34 @@ class ServingEngine:
                     self._state, ok = step(self.engine.params, self._state,
                                            jnp.int32(poison))
                 else:
-                    step = self._prog("step", lambda: jax.jit(
-                        self._step_impl, donate_argnums=(1,)))
-                    self._state, ok = step(self.engine.params, self._state)
-                # ONE fused host read-back per iteration (tok + done +
-                # per-row logit finiteness together): the per-iteration
-                # sync is the scheduler's steering cost — don't pay it
-                # twice, and don't let the guard add a second one
-                toks, dones, oks = jax.device_get(
-                    (self._state.tok, self._state.done, ok))
+                    if self._spec is not None:
+                        plan = self._spec_plan()
+                    if plan is None:
+                        step = self._prog("step", lambda: jax.jit(
+                            self._step_impl, donate_argnums=(1,)))
+                        self._state, ok = step(self.engine.params,
+                                               self._state)
+                if plan is not None:
+                    # verify + host acceptance + commit, all inside the
+                    # watchdog window; scheduler effects deferred below
+                    spec_out = self._spec_verify_commit(plan)
+                else:
+                    # ONE fused host read-back per iteration (tok + done +
+                    # per-row logit finiteness together): the
+                    # per-iteration sync is the scheduler's steering cost
+                    # — don't pay it twice, and don't let the guard add a
+                    # second one
+                    toks, dones, oks = jax.device_get(
+                        (self._state.tok, self._state.done, ok))
                 t1 = self.stats.clock()
                 self._last_step_s = t1 - t0
                 if self.spans is not None:
                     # reuses the t0/t1 the watchdog already measures — the
                     # span layer adds no clock reads to the decode window
                     self.spans.emit(_spans.DECODE_STEP, t0, t1,
-                                    step=self._iterations,
-                                    slots=len(self.sched.running))
+                                    step=self._iterations, slots=n_slots,
+                                    **({"spec": True} if plan is not None
+                                       else {}))
                 wd = self.cfg.watchdog_s
                 if wd and self._last_step_s > wd:
                     # rising edge: the previous iteration was healthy. A
@@ -664,14 +924,20 @@ class ServingEngine:
                                          step_s=self._last_step_s,
                                          median_s=med, mad_s=mad,
                                          iteration=self._iterations)
-                if not oks.all():
-                    # retire ONLY the poisoned rows, before on_step can
-                    # append their garbage tokens; every other slot's
-                    # bookkeeping (and output bits) is untouched
-                    bad = [s for s in np.nonzero(~oks)[0]
-                           if int(s) in self.sched.running]
-                    finished += self.sched.retire_nonfinite(bad)
-                finished += self.sched.on_step(toks, dones)
+                if spec_out is not None:
+                    finished += self._spec_resolve(spec_out)
+                else:
+                    if not oks.all():
+                        # retire ONLY the poisoned rows, before on_step
+                        # can append their garbage tokens; every other
+                        # slot's bookkeeping (and output bits) is
+                        # untouched
+                        bad = [s for s in np.nonzero(~oks)[0]
+                               if int(s) in self.sched.running]
+                        finished += self.sched.retire_nonfinite(bad)
+                    self._decode_slot_steps += n_slots
+                    self._decode_emitted += len(self.sched.running)
+                    finished += self.sched.on_step(toks, dones)
                 ran_decode = True
         if self._pending_demotes:
             # off the TTFT path: the gathers dispatched at admission
@@ -1172,6 +1438,9 @@ class ServingEngine:
         out = {"compiles": self.compiles, **self.stats.snapshot()}
         if self.workload is not None:
             out["workload"] = self.workload.snapshot()
+        spec = self.spec_snapshot()
+        if spec is not None:
+            out["speculation"] = spec
         if self._paged:
             out["pages"] = self.pool.snapshot()
         if self.kvscope is not None:

@@ -1091,19 +1091,46 @@ class FleetEngine:
         """The PR-8 rollup math over per-replica goodput ledgers
         (wall-weighted fraction, summed buckets), exported as
         ``Fleet/goodput_*`` gauges. None when no replica has a ledger
-        (``serving.goodput`` off)."""
+        (``serving.goodput`` off).
+
+        With self-speculative decoding on anywhere in the fleet, the
+        rollup also carries the fleet-wide accepted-tokens-per-step
+        multiple (summed emitted tokens over summed slot-steps across
+        replicas running the lane) — the decode-throughput multiplier
+        the goodput fraction alone cannot see, since a verify step is
+        one productive iteration whether it commits 1 token or 5."""
         from ..observability.goodput import rollup_goodput
 
         snaps = [eng.goodput.snapshot() for eng in self.replicas.values()
                  if eng.goodput is not None]
-        if not snaps:
+        spec = [s for s in (eng.spec_snapshot()
+                            for eng in self.replicas.values())
+                if s is not None]
+        if not snaps and not spec:
             return None
-        roll = rollup_goodput(snaps)
+        roll = rollup_goodput(snaps) if snaps else {
+            "wall_s": 0.0, "productive_s": 0.0, "badput_total_s": 0.0,
+            "goodput_frac": None}
         gauges = {"Fleet/goodput_wall_s": roll["wall_s"],
                   "Fleet/goodput_productive_s": roll["productive_s"],
                   "Fleet/goodput_badput_total_s": roll["badput_total_s"]}
         if roll["goodput_frac"] is not None:
             gauges["Fleet/goodput_frac"] = roll["goodput_frac"]
+        if spec:
+            steps = sum(s["slot_steps"] for s in spec)
+            emitted = sum(s["emitted_tokens"] for s in spec)
+            roll["speculation"] = {
+                "replicas": len(spec),
+                "slot_steps": steps,
+                "emitted_tokens": emitted,
+                "accepted_tokens": sum(s["accepted_tokens"] for s in spec),
+                "proposed_tokens": sum(s["proposed_tokens"] for s in spec),
+                "accepted_tokens_per_step":
+                    (emitted / steps) if steps else None,
+            }
+            if steps:
+                gauges["Fleet/spec_accepted_tokens_per_step"] = \
+                    emitted / steps
         self.registry.set_gauges(gauges)
         return roll
 

@@ -787,6 +787,50 @@ class PagePool:
         self.generation += 1
         self._publish()
 
+    def truncate(self, rid: int, new_tokens: int) -> int:
+        """Page-table-aware rollback: rewind ``rid``'s live extent to
+        ``new_tokens`` tokens, freeing the whole pages strictly beyond
+        the (kept, partially-filled) tail block. The speculative-decode
+        lane calls this when a request retires off a verify step whose
+        rejected drafts wrote past the final committed length — the
+        garbage tail's pages drop their slot refs immediately instead of
+        riding to ``release``, and can never be mistaken for live KV by
+        a later demotion sweep.
+
+        Invariants preserved:
+
+        - never rewinds below ``alloc.shared`` (tree-pinned prefix pages
+          and host-restored blocks are admission-time state, not
+          decode-time growth — rollback cannot unshare them);
+        - freed entries go through :meth:`_unref`, so a page the prefix
+          tree still references stays resident for future hits (host-tier
+          demotion candidates included) and only truly unreferenced
+          pages hit the free list;
+        - the table row's freed entries redirect to scratch, so a stale
+          device mirror of this row can only ever write into page 0;
+        - ``generation`` bumps like every other occupancy change, so
+          deferred admissions retry against the freed pages.
+
+        Returns the number of pages freed. Partial-tail rewinds within
+        one block free nothing — the tail block is KEPT and its
+        positions past ``new_tokens`` are dead by length (every future
+        append overwrites position == committed length first)."""
+        alloc = self._alloc.get(rid)
+        if alloc is None:
+            return 0
+        keep = -(-max(0, int(new_tokens)) // self.page_size)
+        keep = min(alloc.pages, max(keep, alloc.shared))
+        freed = alloc.pages - keep
+        if freed <= 0:
+            return 0
+        for page in alloc.row[keep:alloc.pages]:
+            self._unref(int(page))
+        alloc.row[keep:alloc.pages] = _SCRATCH
+        alloc.pages = keep
+        self.generation += 1
+        self._publish()
+        return freed
+
     # -------------------------------------------------------------- readout
     def residency(self, prompt: np.ndarray) -> tuple:
         """``(tree_blocks, host_blocks)`` holding ``prompt``'s leading

@@ -458,6 +458,44 @@ class Scheduler:
                 self._span_retire(req)
         return finished
 
+    def on_spec_step(self, emitted: dict) -> list:
+        """Account one speculative verify step: ``emitted`` maps slot →
+        the tokens that step committed for that slot (the carried token's
+        verification plus every accepted draft — at least one token,
+        already truncated at the first eos by the engine's host-side
+        acceptance). Retirement is the same predicate as :meth:`on_step`
+        applied to the LAST committed token, so a request retires on the
+        exact step the plain lane would have reached that token.
+
+        Paged retirements first roll the page table back to the final
+        committed KV extent (``prompt + tokens - 1``: the last emitted
+        token is the next step's carry, its KV never written) — the
+        rejected drafts' garbage tail drops its pages via
+        :meth:`~.pages.PagePool.truncate` before the ordinary release,
+        so rollback-then-release refcounts stay exact."""
+        finished = []
+        for slot in sorted(emitted):
+            req = self.running.get(slot)
+            toks = emitted[slot]
+            if req is None or not toks:
+                continue
+            req.tokens.extend(int(t) for t in toks)
+            hit_eos = self.eos_token_id is not None \
+                and int(req.tokens[-1]) == self.eos_token_id
+            if hit_eos or len(req.tokens) >= req.max_new:
+                req.status = RequestStatus.OK
+                req.finish_t = self.stats.on_retire(len(req.tokens),
+                                                    req.first_token_t)
+                del self.running[slot]
+                self.free.append(slot)
+                if self.pages is not None:
+                    self.pages.truncate(
+                        req.rid, len(req.prompt) + len(req.tokens) - 1)
+                self._release_pages(req)
+                finished.append(req)
+                self._span_retire(req)
+        return finished
+
     # ------------------------------------------------------------- guards
     def abort(self, req: Request, status: RequestStatus,
               error: str = "") -> Request:
