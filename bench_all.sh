@@ -61,6 +61,13 @@ sleep 20
 # first-draft acceptance, +-10 pt band) — into REPLAY_BENCH.json and
 # BACKTEST_REPORT.json.
 python bench_replay.py || { echo "[bench_all] replay failed"; fails=$((fails+1)); }
+sleep 20
+# Load & scaling observatory: arrival analytics, service-rate / rho
+# estimation, SLO-burn TTV, and the replay-backtested scaling advisor
+# (predicted vs achieved queue-wait and goodput deltas, +-10 pt band
+# at two fleet sizes) into LOADSCOPE_BENCH.json; also refreshes
+# CAPACITY_REPORT.json with the scaling lever + achieved block.
+python bench_loadscope.py || { echo "[bench_all] loadscope failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

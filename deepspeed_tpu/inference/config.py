@@ -151,6 +151,16 @@ class ServingConfig:
     # programs, zero syncs.
     capture: bool = False
     capture_ring: int = 4096
+    # Arrival & scaling observatory
+    # (observability.loadscope.LoadScopeConfig | dict): rolling arrival
+    # rate / burstiness / token-demand / trend estimators on the submit
+    # path, queueing-model utilization from span-measured service rates,
+    # SLO time-to-violation forecasting, and the scaling what-ifs the
+    # capacity advisor's `scaling` lever + GET /scaling report. Host-side
+    # only — zero new compiled programs; readout math runs at scrape
+    # cadence, never per token. None (default) builds nothing: one
+    # `is not None` per submit.
+    loadscope: "object | None" = None
     # Live telemetry & control plane
     # (observability.server.TelemetryConfig | dict): an HTTP ops surface
     # (/metrics /healthz /readyz /requests /capacity /goodput /flight +
@@ -231,6 +241,10 @@ class ServingConfig:
             from .speculation import SpeculationConfig
 
             self.speculation = SpeculationConfig.from_any(self.speculation)
+        if self.loadscope is not None:
+            from ..observability.loadscope import LoadScopeConfig
+
+            self.loadscope = LoadScopeConfig.from_any(self.loadscope)
         if self.telemetry is not None:
             from ..observability.server import TelemetryConfig
 

@@ -49,6 +49,7 @@ LEVER_KV_QUANT = "kv_quantization"
 LEVER_COLLECTIVES = "quantized_collectives"
 LEVER_SPECULATION = "speculative_decoding"
 LEVER_TIERED_KV = "tiered_kv"
+LEVER_SCALING = "scaling"
 
 
 def roofline_peaks(device=None) -> tuple:
@@ -385,7 +386,8 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     meta: Optional[dict] = None,
                     pages: Optional[dict] = None,
                     commscope: Optional[dict] = None,
-                    kvscope: Optional[dict] = None) -> dict:
+                    kvscope: Optional[dict] = None,
+                    loadscope: Optional[dict] = None) -> dict:
     """Compose ledger + census + workload into the ranked what-if advisor.
 
     Every lever's score is the estimated fraction of its bounding
@@ -636,6 +638,50 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                 "no workload analytics measured (serving.workload off)"),
     })
 
+    # Scaling: the arrival & scaling observatory's measured utilization
+    # (loadscope.py) prices capacity moves — add/remove replica and the
+    # prefill↔decode rebalance — by predicted goodput and queue-wait
+    # delta. Only present when the observatory ran (inert-by-default);
+    # any unmeasured input self-demotes the lever with its reason.
+    if loadscope is not None:
+        util = loadscope.get("utilization") or {}
+        rho = util.get("rho")
+        wis = loadscope.get("what_ifs") or []
+        sc_est: dict[str, Any] = {
+            "rho": rho,
+            "rho_decode": util.get("rho_decode"),
+            "rho_prefill": util.get("rho_prefill"),
+            "predicted_queue_wait_s": util.get("predicted_queue_wait_s"),
+            "slo_ttv_s": (loadscope.get("forecast") or {}).get("slo_ttv_s"),
+            "arrival_rate_per_s": (loadscope.get("arrival")
+                                   or {}).get("rate_per_s"),
+            "what_ifs": wis,
+        }
+        reasons = [str(r) for r in (loadscope.get("unmeasured") or [])]
+        if rho is None or not wis:
+            sc_score = 0.0
+            why_sc = ("scaling inputs unmeasured — " + "; ".join(reasons)
+                      if reasons else
+                      "no utilization estimate on this traffic")
+        else:
+            best = max(wis, key=lambda w: w.get("score") or 0.0)
+            # what-if scores are 0–100 urgency; lever scores are 0–1
+            # fractions comparable across the advisor
+            sc_score = float(best.get("score") or 0.0) / 100.0
+            sc_est["recommendation"] = best.get("action")
+            why_sc = (f"measured utilization rho={rho:.3g} prices "
+                      f"{best.get('action')} by predicted goodput and "
+                      "queue-wait delta (loadscope what-ifs)")
+            if reasons:
+                why_sc += "; partial inputs: " + "; ".join(reasons)
+        ach = loadscope.get("achieved")
+        if ach:
+            sc_est["achieved"] = ach
+            why_sc += ("; scaling backtest ACTIVE — achieved queue-wait/"
+                       "goodput deltas reported alongside the prediction")
+        levers.append({"name": LEVER_SCALING, "score": sc_score,
+                       "estimate": sc_est, "why": why_sc})
+
     levers.sort(key=lambda d: d["score"], reverse=True)
     return {
         "schema": CAPACITY_SCHEMA,
@@ -651,6 +697,9 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         "commscope": commscope,
         # the KV residency observatory's measured rows (same contract)
         "kvscope": kvscope,
+        # the arrival & scaling observatory's measured rows (same
+        # contract: None when it didn't run, absent on older artifacts)
+        "loadscope": loadscope,
         "advisor": {"levers": levers,
                     "ranked": [d["name"] for d in levers]},
     }

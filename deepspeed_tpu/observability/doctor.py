@@ -594,6 +594,107 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
     return findings
 
 
+def report_load(d: Path, rho_max: float = 0.9) -> list:
+    """Print the ``[load]`` picture — the arrival & scaling observatory
+    (``observability/loadscope.py``): arrival rate / burstiness / trend,
+    utilization ρ per engine, the SLO time-to-violation horizon, and
+    the ``scaling`` lever verdict from the newest capacity report. Gate
+    finding: SUSTAINED OVERLOAD — utilization at or above ``rho_max``
+    with queue pressure (a non-empty queue or a rising arrival trend)
+    and a finite time-to-violation: the fleet is trending into SLO burn
+    and needs a scale-out (docs/OPERATIONS.md "deciding when to
+    scale")."""
+    from .sinks import parse_prometheus_textfile
+
+    prom = _newest(d, "*.prom")
+    if prom is None:
+        return []
+    vals = parse_prometheus_textfile(prom.read_text())
+    load = {k: v for k, v in vals.items()
+            if k.startswith(("dstpu_serve_arrival_",
+                             "dstpu_serve_offered_tokens_per_s",
+                             "dstpu_serve_utilization",
+                             "dstpu_serve_predicted_queue_wait_s",
+                             "dstpu_serve_slo_ttv_s",
+                             "dstpu_fleet_arrival_",
+                             "dstpu_fleet_offered_",
+                             "dstpu_fleet_utilization_max",
+                             "dstpu_fleet_slo_ttv_min_s"))}
+    if not load:
+        return []          # no observatory ran: no section, no gate
+    print(f"[load] {prom.name}")
+    for key, label in (
+            ("dstpu_serve_arrival_rate_per_s", "arrival_rate_per_s"),
+            ("dstpu_serve_arrival_cv", "interarrival_cv"),
+            ("dstpu_serve_arrival_trend_per_s2", "arrival_trend_per_s2"),
+            ("dstpu_serve_offered_tokens_per_s", "offered_tokens_per_s"),
+            ("dstpu_serve_utilization", "utilization_rho"),
+            ("dstpu_serve_predicted_queue_wait_s", "pred_queue_wait_s"),
+            ("dstpu_serve_slo_ttv_s", "slo_ttv_s"),
+            ("dstpu_fleet_arrival_rate_per_s", "fleet_arrival_per_s"),
+            ("dstpu_fleet_offered_tokens_per_s", "fleet_offered_tok_s"),
+            ("dstpu_fleet_utilization_max", "fleet_utilization_max"),
+            ("dstpu_fleet_slo_ttv_min_s", "fleet_slo_ttv_min_s")):
+        if key in load:
+            print(f"  {label:<24s} {_fmt(load[key])}")
+    # per-replica ρ table + the advisor verdict come from the newest
+    # capacity report's loadscope section / scaling lever
+    rep_path = _newest(d, "CAPACITY_REPORT*.json")
+    if rep_path is not None:
+        try:
+            rep = json.loads(rep_path.read_text(errors="replace"))
+        except (OSError, json.JSONDecodeError):
+            rep = {}
+        rep = rep if isinstance(rep, dict) else {}
+        ls = rep.get("loadscope")
+        ls = ls if isinstance(ls, dict) else {}
+        reps = ls.get("replicas")
+        if isinstance(reps, dict) and reps:
+            print("  per-replica utilization:")
+            for name, row in sorted(reps.items()):
+                row = row if isinstance(row, dict) else {}
+                u = row.get("utilization") or {}
+                rho = u.get("rho")
+                print(f"    {str(name):<12s} "
+                      f"rho={_fmt(rho) if isinstance(rho, (int, float)) else 'unmeasured'} "
+                      f"wait={u.get('predicted_queue_wait_s')}")
+        adv = rep.get("advisor")
+        lvs = adv.get("levers") if isinstance(adv, dict) else None
+        for lv in (lvs if isinstance(lvs, list) else []):
+            lv = lv if isinstance(lv, dict) else {}
+            if lv.get("name") == "scaling":
+                score = lv.get("score")
+                rec = (lv.get("estimate") or {}).get("recommendation") \
+                    if isinstance(lv.get("estimate"), dict) else None
+                print(f"  scaling lever: score="
+                      f"{_fmt(float(score)) if isinstance(score, (int, float)) else score}"
+                      + (f"  recommends {rec}" if rec else "")
+                      + f"  {lv.get('why') or ''}")
+    findings: list = []
+    rho = max((v for k, v in load.items()
+               if k in ("dstpu_serve_utilization",
+                        "dstpu_fleet_utilization_max")
+               and isinstance(v, float)), default=None)
+    ttv = min((v for k, v in load.items()
+               if k in ("dstpu_serve_slo_ttv_s",
+                        "dstpu_fleet_slo_ttv_min_s")
+               and isinstance(v, float)), default=None)
+    trend = load.get("dstpu_serve_arrival_trend_per_s2")
+    qd = vals.get("dstpu_serve_queue_depth")
+    pressure = (isinstance(qd, float) and qd > 0) \
+        or (isinstance(trend, float) and trend > 0)
+    if rho is not None and rho >= rho_max and pressure \
+            and ttv is not None:
+        print(f"  SUSTAINED OVERLOAD: rho {_fmt(rho)} >= {rho_max:g} "
+              f"with queue pressure and TTV {_fmt(ttv)}s")
+        findings.append(
+            f"sustained overload in {prom.name}: utilization {_fmt(rho)} "
+            f">= {rho_max:g} with queue pressure and a finite "
+            f"time-to-violation ({_fmt(ttv)}s) — trending into SLO burn; "
+            "see the scaling lever / deciding-when-to-scale runbook")
+    return findings
+
+
 # ----------------------------------------------------------- live (--url)
 def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
     """(status, body) for a GET; (None, error-repr) when the target is
@@ -790,6 +891,10 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-regret-max", type=float, default=0.5,
                     help="[kv] gate: regretted share of prefill work "
                          "above this trips (default 0.5)")
+    ap.add_argument("--load-rho-max", type=float, default=0.9,
+                    help="[load] gate: utilization rho at/above this "
+                         "with queue pressure and a finite TTV trips "
+                         "(default 0.9)")
     args = ap.parse_args(argv)
     if args.targets:
         findings = report_fleet(
@@ -812,6 +917,7 @@ def main(argv=None) -> int:
         report_capacity(d)
         findings += report_comm(d)
         findings += report_kv(d, regret_max=args.kv_regret_max)
+        findings += report_load(d, rho_max=args.load_rho_max)
         findings += report_replay([d] if fdir == d else [d, fdir])
         ledger = Path(args.ledger) if args.ledger \
             else d / "PERF_LEDGER.json"

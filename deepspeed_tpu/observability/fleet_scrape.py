@@ -153,6 +153,11 @@ class FleetScraper:
 
         pairs = []
         burn_max = None
+        # loadscope rollups (arrival & scaling observatory): offered
+        # load SUMS across replicas, utilization takes the bottleneck
+        # MAX, and time-to-violation the nearest MIN — each None until
+        # some engine exports the gauge (observatory off → absent lines)
+        offered_load = util_max = ttv_min = None
         for e in up:
             frac = wall = None
             for k, v in e["metrics"].items():
@@ -162,6 +167,13 @@ class FleetScraper:
                     wall = v
                 if _SLO_BURN.search(k):
                     burn_max = v if burn_max is None else max(burn_max, v)
+                if k.endswith("_serve_offered_tokens_per_s"):
+                    offered_load = v if offered_load is None \
+                        else offered_load + v
+                elif k.endswith("_serve_utilization"):
+                    util_max = v if util_max is None else max(util_max, v)
+                elif k.endswith("_serve_slo_ttv_s"):
+                    ttv_min = v if ttv_min is None else min(ttv_min, v)
             pairs.append((frac, wall))
         return {
             "engines": engines,
@@ -171,6 +183,9 @@ class FleetScraper:
                 "ready": sum(1 for e in up if e["ready"]),
                 "goodput_frac": weighted_goodput_frac(pairs),
                 "slo_burn_max": burn_max,
+                "offered_load": offered_load,
+                "utilization_max": util_max,
+                "slo_ttv_min_s": ttv_min,
             },
         }
 
@@ -203,6 +218,15 @@ class FleetScraper:
         if fl["slo_burn_max"] is not None:
             lines.append("dstpu_fleet_slo_burn_max "
                          f"{format_prometheus_value(fl['slo_burn_max'])}")
+        if fl.get("offered_load") is not None:
+            lines.append("dstpu_fleet_offered_load "
+                         f"{format_prometheus_value(fl['offered_load'])}")
+        if fl.get("utilization_max") is not None:
+            lines.append("dstpu_fleet_utilization_max "
+                         f"{format_prometheus_value(fl['utilization_max'])}")
+        if fl.get("slo_ttv_min_s") is not None:
+            lines.append("dstpu_fleet_slo_ttv_min_s "
+                         f"{format_prometheus_value(fl['slo_ttv_min_s'])}")
         return "\n".join(lines) + "\n"
 
     def write(self, path, snap: Optional[dict] = None) -> Path:

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import threading
 import time
 from collections import OrderedDict, deque
@@ -918,3 +919,320 @@ def write_backtest_report(report: dict, path) -> Path:
     path.write_text(json.dumps(report, indent=2, default=str),
                     encoding="utf-8")
     return path
+
+
+# -------------------------------------------------- scaling backtest (PR 17)
+SCALING_BACKTEST_SCHEMA = "dstpu.scaling_backtest.v1"
+
+
+def make_diurnal_trace(*, duration_s: float, base_rate: float,
+                       peak_rate: Optional[float] = None,
+                       period_s: Optional[float] = None,
+                       burst_factor: float = 1.0, burst_duty: float = 0.5,
+                       burst_period_s: Optional[float] = None,
+                       prompt_len: int = 8, max_new: int = 8,
+                       vocab: int = 256, seed: int = 0) -> TrafficTrace:
+    """Synthesize a schema-valid diurnal × bursty request stream.
+
+    A non-homogeneous Poisson process (thinning against the rate
+    envelope's peak) whose instantaneous rate is a diurnal sinusoid —
+    ``base_rate`` at the trough, ``peak_rate`` at the crest, one full
+    period per ``period_s`` (default: one period over the whole trace)
+    — multiplied by an on/off burst square wave (``burst_factor`` for
+    the first ``burst_duty`` of every ``burst_period_s``). The default
+    ``burst_factor=1`` degenerates to the pure sinusoid; cranking it
+    raises the interarrival CV above Poisson's 1.0, which is exactly
+    what the loadscope burstiness estimator must detect. Requests carry
+    compact ``gen`` specs (deterministic per-rid prompts), so the trace
+    stays a few bytes per event at any scale. Fully deterministic in
+    ``seed``."""
+    import random as _random
+
+    if duration_s <= 0 or base_rate <= 0:
+        raise ValueError("make_diurnal_trace needs duration_s > 0 and "
+                         f"base_rate > 0, got {duration_s}/{base_rate}")
+    peak = float(peak_rate) if peak_rate is not None else float(base_rate)
+    if peak < base_rate:
+        raise ValueError(f"peak_rate {peak} < base_rate {base_rate}")
+    period = float(period_s) if period_s is not None else float(duration_s)
+    bperiod = float(burst_period_s) if burst_period_s is not None \
+        else float(duration_s) / 6.0
+    duty = min(max(float(burst_duty), 0.0), 1.0)
+
+    def rate(t: float) -> float:
+        diurnal = base_rate + (peak - base_rate) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * t / period))
+        bursting = duty > 0 and (t % bperiod) < duty * bperiod
+        return diurnal * (burst_factor if bursting else 1.0)
+
+    lam_max = peak * max(1.0, float(burst_factor))
+    rng = _random.Random(int(seed))
+    tr = TrafficTrace(meta={
+        "source": "make_diurnal_trace", "duration_s": float(duration_s),
+        "base_rate": float(base_rate), "peak_rate": peak,
+        "period_s": period, "burst_factor": float(burst_factor),
+        "burst_duty": duty, "burst_period_s": bperiod, "seed": int(seed)})
+    t, rid = 0.0, 0
+    while True:
+        t += rng.expovariate(lam_max)      # thinning: candidate at peak rate
+        if t >= duration_s:
+            break
+        if rng.random() * lam_max > rate(t):
+            continue                       # thinned out of the lull
+        tr.add_request(rid, t,
+                       gen={"seed": int(seed) * 100003 + rid,
+                            "len": int(prompt_len), "vocab": int(vocab)},
+                       max_new=int(max_new), seed=rid)
+        rid += 1
+    return tr
+
+
+def _drive_timeline(engine, trace: TrafficTrace, clock: ReplayClock,
+                    max_iterations: int = 2_000_000) \
+        -> "tuple[dict, int]":
+    """Replay ``trace`` on ``engine`` so fake time advances ONLY through
+    the shared ticking clock (``dt`` per read) plus idle jumps to the
+    next arrival. That makes the queueing timeline self-consistent with
+    the span-measured service rates (a step's span duration IS the fake
+    time the step consumed), which is the whole point of the scaling
+    backtest: utilization ρ measured by loadscope and the achieved
+    queue waits live on the same clock. Returns ``(rid → finished
+    Request, shed_count)``."""
+    from ..resilience.guards import QueueFullError
+
+    pending = sorted(trace.requests, key=lambda e: e.get("t_rel", 0.0))
+    done: dict = {}
+    i = submitted = shed = it = 0
+    while i < len(pending) or len(done) < submitted:
+        while i < len(pending) and pending[i]["t_rel"] <= clock.t:
+            ev = pending[i]
+            i += 1
+            try:
+                engine.submit(resolve_prompt(ev), int(ev["max_new"]),
+                              seed=int(ev["seed"]))
+                submitted += 1
+            except (QueueFullError, ValueError):
+                shed += 1                  # a shed is data, not a crash
+        for req in engine.step():
+            done[req.rid] = req
+            engine.pop_result(req.rid)
+        if i < len(pending) and len(done) >= submitted:
+            # nothing in flight and the next arrival is in the future:
+            # jump there (underload must not burn iterations — or fake
+            # seconds — spinning on an empty engine)
+            clock.advance_to(pending[i]["t_rel"])
+        it += 1
+        if it > max_iterations:
+            raise RuntimeError(
+                f"scaling backtest wedged: {len(done)}/{submitted} "
+                f"finished after {max_iterations} iterations")
+    return done, shed
+
+
+def _achieved(done: dict, trace: TrafficTrace, horizon_s: float) -> dict:
+    """Measured outcome of one backtest run: mean queue wait (admit −
+    submit on the shared fake clock) and goodput points — decode tokens
+    of requests that FINISHED inside the trace window, as a percentage
+    of every decode token the trace offered (sheds and late finishers
+    count against it)."""
+    waits = [float(r.admit_t) - float(r.submit_t) for r in done.values()
+             if r.admit_t is not None and r.submit_t is not None]
+    offered = sum(int(e["max_new"]) for e in trace.requests)
+    served = sum(len(r.tokens) for r in done.values()
+                 if r.finish_t is not None and r.finish_t <= horizon_s)
+    return {
+        "finished": len(done),
+        "queue_wait_mean_s": (sum(waits) / len(waits)) if waits else None,
+        "offered_decode_tokens": int(offered),
+        "served_by_horizon": int(served),
+        "goodput_pts": (100.0 * served / offered) if offered else None,
+    }
+
+
+def scaling_backtest(engine, serving: dict, *, sizes=(1, 2),
+                     requests_target: int = 48, prompt_len: int = 6,
+                     max_new: int = 8, overload: float = 1.5,
+                     burst_factor: float = 3.0, seed: int = 0,
+                     tolerance_pts: float = 10.0,
+                     programs=None) -> dict:
+    """Backtest the loadscope scaling advisor against replayed reality.
+
+    Self-calibrating: a probe run on ONE replica measures the fleet's
+    fake-time decode capacity from its span ring, then a diurnal ×
+    bursty trace is synthesized whose offered decode-token rate is
+    ``overload`` × that capacity — so one replica is genuinely
+    saturated and two are comfortably inside the knee, whatever the
+    host's clock granularity. For each fleet size ``n`` in ``sizes``
+    the trace replays at ``n`` and ``n+1`` replicas on a shared
+    :class:`ReplayClock`; the advisor's add-replica what-if from the
+    ``n``-replica run (predicted ρ, queue wait, goodput after scaling)
+    is scored against the MEASURED ``n+1`` outcome:
+
+    - ``goodput_error_pts`` — |predicted − achieved| goodput, in
+      percentage points of offered decode tokens;
+    - ``wait_error_pts`` — |predicted − achieved| post-scale queue
+      wait, normalized by the larger of the pre-scale measured wait and
+      one request's service time (so a near-zero wait on both sides
+      scores near-zero, and an overloaded baseline isn't penalized for
+      absolute seconds).
+
+    The run passes when every size's both errors are within
+    ``tolerance_pts``. Degradation contract: if the probe cannot
+    measure capacity (spans off, no decode steps), the report carries
+    ``unmeasured`` reasons and ``pass: None`` — never an exception."""
+    from collections import OrderedDict as _OD
+
+    from ..serving.fleet import FleetEngine
+
+    progs = programs if programs is not None else _OD()
+    base = {**serving, "spans": True}
+    base.pop("loadscope", None)
+
+    def _fleet(n: int, scope: dict, clock: ReplayClock) -> FleetEngine:
+        return FleetEngine(engine, {**base, "loadscope": scope},
+                           replicas=n, clock=clock, programs=progs)
+
+    # ---- probe: measure fake-time capacity on one saturated replica.
+    # The span ring alone cannot price the fake timeline: on a ticking
+    # clock most reads land OUTSIDE the compute spans (on hardware the
+    # compute dominates wall time; here every read costs dt), so the
+    # probe floods one replica and measures REALIZED tokens per fake
+    # second, then installs that as the loadscope service calibration
+    # (``LoadScope.service_override``) for every backtest run. The
+    # span-vs-realized ratio also rescales the prefill rate.
+    probe_trace = TrafficTrace()
+    probe_n = 24
+    for rid in range(probe_n):
+        probe_trace.add_request(rid, 0.0,
+                                gen={"seed": rid, "len": prompt_len,
+                                     "vocab": 256},
+                                max_new=max_new, seed=rid)
+    clock = ReplayClock(dt=1e-4)
+    fl = _fleet(1, {"window_s": 1e9}, clock)
+    done, _ = _drive_timeline(fl, probe_trace, clock)
+    replica = next(iter(fl.replicas.values()))
+    snap = replica.scaling_snapshot()
+    svc = (snap or {}).get("service") or {}
+    span_per_slot = svc.get("decode_tokens_per_slot_s")
+    span_prefill = svc.get("prefill_tokens_per_s")
+    slots = int(svc.get("slots") or 0)
+    wall = clock.t
+    fl.close()
+    if span_per_slot is None or slots < 1 or wall <= 0 or not done:
+        return {"schema": SCALING_BACKTEST_SCHEMA, "pass": None,
+                "unmeasured": ["probe run measured no decode service rate "
+                               "(spans ring empty?) — backtest degraded"],
+                "sizes": []}
+    serviceable = probe_n * max_new / wall         # tokens/fake-s, 1 replica
+    per_slot = serviceable / slots
+    alpha = per_slot / float(span_per_slot)        # loop time per span time
+    calibration = {
+        "slots": slots,
+        "decode_tokens_per_slot_s": per_slot,
+        "decode_tokens_per_s": serviceable,
+        "prefill_tokens_per_s": (float(span_prefill) * alpha
+                                 if span_prefill is not None else None),
+    }
+    mean_service_s = max_new / per_slot            # one request in a slot
+
+    # ---- the offered stream: mean decode-token rate = `overload` × the
+    # one-replica capacity. The diurnal shape (base 0.6×, peak 1.1× of
+    # the reference rate → mean 0.85×) and the burst square wave (mean
+    # multiplier 1 + duty·(factor−1)) both inflate the mean, so the
+    # reference rate divides them back out.
+    duty = 0.3
+    shape_mean = 0.5 * (0.6 + 1.1) * (1.0 + duty * (burst_factor - 1.0))
+    rate_req = overload * serviceable / max_new / shape_mean
+    duration_s = requests_target / (rate_req * shape_mean)
+    trace = make_diurnal_trace(
+        duration_s=duration_s, base_rate=0.6 * rate_req,
+        peak_rate=1.1 * rate_req, burst_factor=burst_factor,
+        burst_duty=duty, prompt_len=prompt_len, max_new=max_new,
+        seed=seed)
+    problems = trace.validate()
+    if problems:
+        raise ValueError(f"synthesized trace failed validation: {problems}")
+
+    # ---- replay at every needed fleet size (each size once, reused).
+    # One shared clock serializes the replicas' steps, so a round over n
+    # replicas costs n× the reads of one — but real replicas run in
+    # PARALLEL. dt/n makes a full fleet round cost the same fake time as
+    # one replica's step, so fleet capacity scales n× like hardware's.
+    need = sorted({int(n) for n in sizes} | {int(n) + 1 for n in sizes})
+    runs: dict = {}
+    for n in need:
+        clock = ReplayClock(dt=1e-4 / n)
+        fl = _fleet(n, {"window_s": 1e9}, clock)
+        for rep_eng in fl.replicas.values():
+            rep_eng.loadscope.service_override = calibration
+        done, shed = _drive_timeline(fl, trace, clock)
+        rep = fl.scaling_report() or {}
+        runs[n] = {
+            "replicas": n,
+            "rho": (rep.get("fleet") or {}).get("rho"),
+            "what_ifs": rep.get("what_ifs") or [],
+            "shed": shed,
+            **_achieved(done, trace, duration_s),
+        }
+        fl.close()
+
+    # ---- score the advisor: prediction at n vs measurement at n+1
+    out_sizes = []
+    all_pass: Optional[bool] = True
+    for s in sorted({int(n) for n in sizes}):
+        now, after = runs[s], runs[s + 1]
+        wi = next((w for w in now["what_ifs"]
+                   if w.get("action") == "add_replica"), None)
+        entry: dict = {"replicas": s, "measured_now": {
+            "rho": now["rho"], "queue_wait_mean_s": now["queue_wait_mean_s"],
+            "goodput_pts": now["goodput_pts"], "shed": now["shed"]}}
+        if wi is None or wi.get("rho_after") is None:
+            entry["unmeasured"] = ["no add_replica what-if at this size "
+                                   "(utilization unmeasured)"]
+            entry["pass"] = None
+            all_pass = None
+            out_sizes.append(entry)
+            continue
+        pred_good = wi.get("goodput_after")
+        pred_good_pts = 100.0 * pred_good if pred_good is not None else None
+        pred_wait = wi.get("predicted_queue_wait_s_after")
+        meas_good_pts = after["goodput_pts"]
+        meas_wait = after["queue_wait_mean_s"]
+        entry["predicted_after"] = {
+            "rho": wi.get("rho_after"), "queue_wait_s": pred_wait,
+            "goodput_pts": pred_good_pts}
+        entry["measured_after"] = {
+            "rho": after["rho"], "queue_wait_s": meas_wait,
+            "goodput_pts": meas_good_pts, "shed": after["shed"]}
+        t_ref = max(now["queue_wait_mean_s"] or 0.0, mean_service_s)
+        entry["goodput_error_pts"] = (
+            abs(pred_good_pts - meas_good_pts)
+            if pred_good_pts is not None and meas_good_pts is not None
+            else None)
+        entry["wait_error_pts"] = (
+            100.0 * abs(pred_wait - meas_wait) / t_ref
+            if pred_wait is not None and meas_wait is not None else None)
+        errs = [entry["goodput_error_pts"], entry["wait_error_pts"]]
+        if any(e is None for e in errs):
+            entry["pass"] = None
+            all_pass = None
+        else:
+            ok = all(e <= tolerance_pts for e in errs)
+            entry["pass"] = ok
+            if all_pass is True and not ok:
+                all_pass = False
+        out_sizes.append(entry)
+
+    return {
+        "schema": SCALING_BACKTEST_SCHEMA,
+        "serviceable_tokens_per_s": serviceable,
+        "mean_service_s": mean_service_s,
+        "trace": {"requests": len(trace.requests),
+                  "duration_s": duration_s,
+                  "offered_req_per_s_peak": 1.1 * rate_req,
+                  "overload": overload, "seed": seed},
+        "runs": {str(n): r for n, r in runs.items()},
+        "tolerance_pts": float(tolerance_pts),
+        "sizes": out_sizes,
+        "pass": all_pass,
+    }

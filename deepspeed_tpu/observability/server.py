@@ -117,6 +117,10 @@ class TelemetryHooks:
     drain_fn: Optional[Callable[[bool], dict]] = None      # (end) ->
     dump_fn: Optional[Callable[[], Optional[str]]] = None
     slo_reload_fn: Optional[Callable[[dict], dict]] = None
+    # arrival & scaling observatory readout (loadscope.py): the scaling
+    # report JSON — unmeasured inputs arrive as nulls with reasons, the
+    # endpoint stays 200 (degraded-null contract); absent hook → 404
+    scaling_fn: Optional[Callable[[], dict]] = None
 
 
 def flight_summary(flight) -> dict:
@@ -311,6 +315,12 @@ def _make_handler(server: TelemetryServer):
                                               "configured"})
                 else:
                     self._json(200, h.flight_fn())
+            elif path == "/scaling":
+                if h.scaling_fn is None:
+                    self._json(404, {"error": "loadscope disabled "
+                                              "(set serving.loadscope)"})
+                else:
+                    self._json(200, h.scaling_fn())
             elif path == "/trace":
                 if h.trace_fn is None:
                     self._json(404, {"error": "no trace hook"})
@@ -337,6 +347,7 @@ def _make_handler(server: TelemetryServer):
                        "/capacity": h.capacity_fn is not None,
                        "/goodput": h.goodput_fn is not None,
                        "/flight": h.flight_fn is not None,
+                       "/scaling": h.scaling_fn is not None,
                        "/trace": h.trace_fn is not None,
                        "POST /drain": h.drain_fn is not None,
                        "POST /flight/dump": h.dump_fn is not None,

@@ -176,6 +176,7 @@ class ServingStats:
         self._occ_prev: Optional[tuple] = None
         self._occ_time = 0.0
         self._occ_weighted = 0.0
+        self._last_submit_t: Optional[float] = None
 
     def reset(self) -> None:
         """Clear every Serve/* series and restart the goodput window —
@@ -187,13 +188,23 @@ class ServingStats:
         self._occ_prev = None
         self._occ_time = 0.0
         self._occ_weighted = 0.0
+        self._last_submit_t = None
 
     # ---------------------------------------------------- request lifecycle
     def on_submit(self, queue_depth: int) -> float:
         t = self.clock()
         r = self.registry
         r.counter("Serve/submitted").inc()
+        # sampled at SUBMIT time (not only on admission): a flooded queue
+        # between admissions must not read a stale depth on scrape
         r.gauge("Serve/queue_depth").set(queue_depth)
+        if self._last_submit_t is not None:
+            # the arrival-process histogram loadscope's CV estimator
+            # summarizes — kept here so the raw distribution survives
+            # in every sink even with the observatory off
+            r.histogram("Serve/interarrival_s").observe(
+                t - self._last_submit_t)
+        self._last_submit_t = t
         return t
 
     def on_admit(self, queue_depth: int,
@@ -334,5 +345,6 @@ class ServingStats:
             "ttft_s": h.get("Serve/ttft_s", {}),
             "tpot_s": h.get("Serve/tpot_s", {}),
             "queue_wait_s": h.get("Serve/queue_wait_s", {}),
+            "interarrival_s": h.get("Serve/interarrival_s", {}),
             "requeue_delay_s": h.get("Serve/requeue_delay_s", {}),
         }

@@ -229,6 +229,17 @@ class ServingEngine:
             self.goodput = GoodputLedger(clock=self.stats.clock,
                                          registry=self.stats.registry,
                                          prefix="Serve")
+        # arrival & scaling observatory (observability/loadscope.py):
+        # None (default) = one `is not None` per submit; enabled = a
+        # bounded host-side arrival ring + scrape-cadence readout math,
+        # still zero programs/syncs
+        self.loadscope = None
+        if self.cfg.loadscope is not None:
+            from ..observability.loadscope import LoadScope
+
+            self.loadscope = LoadScope(self.cfg.loadscope,
+                                       registry=self.stats.registry,
+                                       clock=self.stats.clock)
         # live telemetry server (observability/server.py): started at the
         # END of __init__ when config-enabled (the state must exist
         # before a scrape can land), or explicitly via serve_telemetry()
@@ -738,6 +749,9 @@ class ServingEngine:
             # replay under the same config reproduces deadline semantics
             self.capture.on_submit(req, ttft_deadline_s=ttft_deadline_s,
                                    total_deadline_s=total_deadline_s)
+        if self.loadscope is not None:
+            self.loadscope.on_submit(len(req.prompt), req.max_new,
+                                     self.sched.queue_depth)
         return req.rid
 
     def requeue(self, req: Request) -> Request:
@@ -1432,6 +1446,10 @@ class ServingEngine:
             }
             # snapshot() already refreshed the Serve/host_tier_* gauges
         self.stats.registry.set_gauges(gauges)
+        if self.loadscope is not None:
+            # refresh Serve/utilization / predicted-wait / TTV at probe
+            # cadence (the report sets its own gauges as a side effect)
+            self.scaling_snapshot()
         return out
 
     def metrics_snapshot(self) -> dict:
@@ -1447,6 +1465,8 @@ class ServingEngine:
             out["kv_residency"] = self.kvscope.snapshot()
         if self.goodput is not None:
             out["goodput"] = self.goodput.snapshot()
+        if self.loadscope is not None:
+            out["loadscope"] = self.scaling_snapshot()
         return out
 
     def requests_table(self) -> list[dict]:
@@ -1562,6 +1582,60 @@ class ServingEngine:
         return {"tokens": toks, "wall_s": wall,
                 "tokens_per_s": toks / wall}
 
+    def _decode_rate(self) -> Optional[dict]:
+        """Measured decode slot-throughput from the span ring's
+        ``decode_step`` spans: emitted tokens (one per active slot per
+        step) over busy slot-seconds — the serviceable-rate side of the
+        loadscope utilization model. None when spans are off or no
+        decode step has run (ρ then degrades to unmeasured, not
+        guessed)."""
+        if self.spans is None:
+            return None
+        from ..observability import spans as _sp
+
+        toks = 0
+        slot_s = 0.0
+        wall = 0.0
+        steps = 0
+        for ev in self.spans.events():
+            if ev.kind == _sp.DECODE_STEP and ev.t1 is not None:
+                n = int(ev.meta.get("slots") or 0)
+                toks += n
+                slot_s += ev.duration * n
+                wall += ev.duration
+                steps += 1
+        if toks <= 0 or slot_s <= 0:
+            return None
+        return {"steps": steps, "tokens": toks, "wall_s": wall,
+                "slot_s": slot_s, "tokens_per_slot_s": toks / slot_s,
+                "tokens_per_s": toks / wall if wall > 0 else None}
+
+    def scaling_snapshot(self) -> Optional[dict]:
+        """The arrival & scaling observatory's readout (``GET /scaling``,
+        the capacity report's ``loadscope`` section, one replica row of
+        ``FleetEngine.scaling_report()``): arrival-process estimates
+        joined with span-measured service rates into utilization ρ,
+        predicted queue wait, SLO time-to-violation, and scored scaling
+        what-ifs. None when loadscope is off; unmeasured inputs degrade
+        field-by-field with stated reasons, never raise."""
+        if self.loadscope is None:
+            return None
+        dec = self._decode_rate()
+        pre = self._prefill_rate()
+        service = {
+            "slots": self.cfg.slots,
+            "decode_tokens_per_slot_s": (dec or {}).get("tokens_per_slot_s"),
+            "decode_tokens_per_s": (dec or {}).get("tokens_per_s"),
+            "effective_concurrency": (
+                dec["tokens_per_s"] / dec["tokens_per_slot_s"]
+                if dec and dec.get("tokens_per_s")
+                and dec.get("tokens_per_slot_s") else None),
+            "prefill_tokens_per_s": (pre or {}).get("tokens_per_s"),
+        }
+        slo_cfg = self.slo.cfg if self.slo is not None else None
+        return self.loadscope.report(service=service, slo=slo_cfg,
+                                     queue_depth=self.sched.queue_depth)
+
     def kv_residency(self) -> Optional[dict]:
         """The KV residency observatory's readout plus the two measured
         host-tier inputs the capacity advisor joins it with: the (cached)
@@ -1654,6 +1728,7 @@ class ServingEngine:
         rep = capacity_report(
             ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
             commscope=commscope, kvscope=self.kv_residency(),
+            loadscope=self.scaling_snapshot(),
             pages=self.pool.snapshot() if self._paged else None,
             meta={"job": "serving", "slots": self.cfg.slots,
                   "max_len": self.cfg.max_len,
@@ -1755,7 +1830,9 @@ class ServingEngine:
             drain_fn=self._drain_control,
             dump_fn=((lambda: self.dump_flight("manual"))
                      if self.flight is not None else None),
-            slo_reload_fn=self.reload_slo)
+            slo_reload_fn=self.reload_slo,
+            scaling_fn=(self.scaling_snapshot
+                        if self.loadscope is not None else None))
         server = TelemetryServer(hooks, host=host, port=port, token=token)
         # bind FIRST: a failed bind (port in use) must not leave a dead
         # server object behind that makes the idempotency guard return

@@ -1134,12 +1134,126 @@ class FleetEngine:
         self.registry.set_gauges(gauges)
         return roll
 
+    def scaling_report(self) -> Optional[dict]:
+        """Fleet-wide arrival & scaling rollup over per-replica loadscope
+        snapshots (``observability/loadscope.py``): summed offered load,
+        the bottleneck utilization, the nearest SLO time-to-violation,
+        and the scaling what-ifs — add_replica / remove_replica / the
+        prefill↔decode rebalance a disaggregated fleet can make —
+        scored at fleet size. Exported as ``Fleet/arrival_*`` /
+        ``Fleet/utilization_max`` / ``Fleet/slo_ttv_min_s`` gauges.
+        None when no replica runs the observatory (``serving.loadscope``
+        off); per-replica unmeasured inputs degrade the dependent
+        aggregates to None, never raise."""
+        from ..observability.loadscope import (SCALING_SCHEMA,
+                                               score_what_ifs)
+
+        per = {}
+        for n, e in self.replicas.items():
+            if getattr(e, "loadscope", None) is None:
+                continue
+            snap = e.scaling_snapshot()
+            if snap is not None:
+                per[n] = snap
+
+        if not per:
+            return None
+
+        def _vals(section, key):
+            vs = [(s.get(section) or {}).get(key) for s in per.values()]
+            return [v for v in vs if v is not None]
+
+        rates = _vals("arrival", "rate_per_s")
+        offered = _vals("arrival", "offered_tokens_per_s")
+        off_dec = _vals("arrival", "decode_tokens_per_s")
+        off_pre = _vals("arrival", "prompt_tokens_per_s")
+        serviceable = _vals("service", "serviceable_decode_tokens_per_s")
+        svc_pre = _vals("service", "prefill_tokens_per_s")
+        rhos = _vals("utilization", "rho")
+        cvs = _vals("arrival", "interarrival_cv")
+        svc_means = _vals("utilization", "mean_service_s")
+        ttvs = _vals("forecast", "slo_ttv_s")
+
+        offered_total = sum(offered) if offered else None
+        serviceable_total = sum(serviceable) if serviceable else None
+        # fleet ρ is PER PHASE over the measured replicas only (honest
+        # when some replica's spans are off — its load is also
+        # excluded), then the bottleneck max: decode demand over decode
+        # capacity, prompt demand over prefill capacity
+        rho_dec_fleet = (sum(off_dec) / serviceable_total
+                         if off_dec and serviceable_total else None)
+        rho_pre_fleet = (sum(off_pre) / sum(svc_pre)
+                         if off_pre and svc_pre and sum(svc_pre) > 0
+                         else None)
+        rho_fleet = (max(v for v in (rho_dec_fleet, rho_pre_fleet)
+                         if v is not None)
+                     if rho_dec_fleet is not None
+                     or rho_pre_fleet is not None else None)
+        rho_prefill = rho_decode = None
+        pr_count = sum(1 for r in self.roles.values()
+                       if r == ROLE_PREFILL)
+        if self._disagg:
+            pre = [(per[n].get("utilization") or {}).get("rho")
+                   for n in per if self.roles.get(n) == ROLE_PREFILL]
+            dec = [(per[n].get("utilization") or {}).get("rho")
+                   for n in per if self.roles.get(n) == ROLE_DECODE]
+            pre = [v for v in pre if v is not None]
+            dec = [v for v in dec if v is not None]
+            rho_prefill = max(pre) if pre else None
+            rho_decode = max(dec) if dec else None
+
+        slots = next(iter(self.replicas.values())).cfg.slots
+        cfg0 = next(iter(per.values()))
+        rho_high = ((cfg0.get("utilization") or {}).get("rho_high")
+                    or 0.85)
+        what_ifs = score_what_ifs(
+            rho=rho_fleet if rho_fleet is not None
+            else (max(rhos) if rhos else None),
+            replicas=len(self.replicas), slots=slots,
+            mean_service_s=(sum(svc_means) / len(svc_means)
+                            if svc_means else None),
+            arrival_cv=(sum(cvs) / len(cvs) if cvs else None),
+            rho_high=rho_high, rho_prefill=rho_prefill,
+            rho_decode=rho_decode, prefill_replicas=pr_count)
+
+        gauges = {}
+        if rates:
+            gauges["Fleet/arrival_rate_per_s"] = sum(rates)
+        if offered_total is not None:
+            gauges["Fleet/offered_tokens_per_s"] = offered_total
+        if rhos:
+            gauges["Fleet/utilization_max"] = max(rhos)
+        if ttvs:
+            gauges["Fleet/slo_ttv_min_s"] = min(ttvs)
+        self.registry.set_gauges(gauges)
+
+        return {
+            "schema": SCALING_SCHEMA,
+            "replicas": per,
+            "fleet": {
+                "replica_count": len(self.replicas),
+                "prefill_replicas": pr_count,
+                "arrival_rate_per_s": sum(rates) if rates else None,
+                "offered_tokens_per_s": offered_total,
+                "serviceable_tokens_per_s": serviceable_total,
+                "rho": rho_fleet,
+                "rho_prefill": (rho_prefill if self._disagg
+                                else rho_pre_fleet),
+                "rho_decode": (rho_decode if self._disagg
+                               else rho_dec_fleet),
+                "utilization_max": max(rhos) if rhos else None,
+                "slo_ttv_min_s": min(ttvs) if ttvs else None,
+            },
+            "what_ifs": what_ifs,
+        }
+
     def metrics_snapshot(self) -> dict:
         # refresh the derived gauges FIRST (publish_metrics order) so
         # the "fleet" section carries current health/goodput, not the
         # previous call's
         self.health()
         gp = self.fleet_goodput()
+        sc = self.scaling_report()
         snap = self.registry.snapshot()
         out = {
             "iterations": self._iterations,
@@ -1151,6 +1265,8 @@ class FleetEngine:
         }
         if gp is not None:
             out["goodput"] = gp
+        if sc is not None:
+            out["scaling"] = sc
         return out
 
     def requests_table(self) -> list:
