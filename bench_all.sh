@@ -68,6 +68,13 @@ sleep 20
 # at two fleet sizes) into LOADSCOPE_BENCH.json; also refreshes
 # CAPACITY_REPORT.json with the scaling lever + achieved block.
 python bench_loadscope.py || { echo "[bench_all] loadscope failed"; fails=$((fails+1)); }
+sleep 20
+# NVMe aio tier microbench: threads x block x O_DIRECT sweep feeding
+# the serving NVMe KV rung and optimizer-offload sizing (read/write
+# MB/s rates are up-is-good; perf_ledger direction-infers *_mb_s).
+# Local-disk only — no tunnel claim.
+python -m deepspeed_tpu.ops.aio_bench --size-mb 64 --json AIO_BENCH.json \
+  || { echo "[bench_all] aio bench failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

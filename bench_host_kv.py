@@ -24,12 +24,22 @@ session's pages), with and without the pinned-host tier
   next to its projection, and the HBM ledger gains
   ``kv_host_tier_bytes``;
 - **doctor** — the ``[kv]`` host-tier verdict trips on fallbacks
-  (corrupt/lost host copies) and stays clean without them.
+  (corrupt/lost host copies) and stays clean without them;
+- **NVMe rung** — a host tier too small for one request spills
+  demoted pages to disk (``serving.nvme_pool_bytes``); resumes
+  promote NVMe→host→HBM bit-identically; torn/corrupt/lost files
+  degrade to counted recompute, never raise; doctor NVMe gates trip
+  on fallbacks and aio errors, stay clean otherwise;
+- **demote-ahead** — ``serving.demote_ahead_idle_s`` stages idle
+  pages tier-ward off the admission path: post-warm evictions are
+  pure fast-frees, the pressure demote-wait meter is EXACTLY zero
+  (vs nonzero on the plain tier), zero new programs, regret stays 0.
 
 ``--smoke`` is the CPU tier-1 gate (wired via
 ``tests/unit/test_host_kv.py``); full mode runs a 10× session
 oversubscription workload (sessions' worst-case pages = 10× the pool)
-and merges host-tier rows — including the headline
+plus the ``nvme_depth_sweep`` (10/30/100× depth with the disk rung +
+demote-ahead on) and merges the rows — including the headline
 ``resume_ttft_restore_vs_recompute`` comparison — into
 ``KV_RESIDENCY_BENCH.json`` for the cross-PR perf ledger.
 """
@@ -56,13 +66,14 @@ _POOL = 1 + (_P + _MAX_NEW - 1 + _PS - 1) // _PS
 _HOST_BYTES = 64 << 20
 
 
-def _mk(host=True, kvscope=True, pool_pages=_POOL, seed=0):
+def _mk(host=True, kvscope=True, pool_pages=_POOL, seed=0, **over):
     extra = {"page_size": _PS, "pool_pages": pool_pages, "spans": True,
              "greedy": True}
     if host:
         extra["host_pool_bytes"] = _HOST_BYTES
     if kvscope:
         extra["kvscope"] = {"dead_after_s": 3600.0}
+    extra.update(over)
     _model, _params, eng, srv = build(
         slots=2, max_len=_MAX_LEN, chunk=16, n_layer=2, d_model=64,
         n_head=4, **extra)
@@ -214,6 +225,102 @@ def smoke():
     assert rc_trip == 1, f"doctor host-tier gate did not trip ({rc_trip})"
     assert rc_clean == 0, f"doctor host-tier gate false-fired ({rc_clean})"
 
+    # (7) NVMe rung round-trip: a host tier too small for one request
+    # (3 pages) spills demoted pages to disk; resumes promote them back
+    # NVMe→host→HBM bit-identically to prefill-recompute and the solo
+    # oracle, with zero CRC fallbacks on the clean path
+    eng_nv, srv_nv = _mk(host=True, host_pool_bytes=9 * 8192,
+                         nvme_pool_bytes=256 << 20)
+    runs_nv, _ = cycle(srv_nv, rounds=3)
+    for (sa, (ta, _)), (sb, (tb, _)) in zip(runs_off, runs_nv):
+        assert sa == sb and ta == tb, "NVMe-restore output diverged " \
+            f"from prefill-recompute ({sa}: {ta} vs {tb})"
+    last_a_nv = next(toks for sid, (toks, _t) in reversed(runs_nv)
+                     if sid == "sess-a")
+    assert solo[:len(last_a_nv)] == last_a_nv, (solo, last_a_nv)
+    ns = srv_nv.nvmekv.snapshot()
+    hs_nv = srv_nv.hostkv.snapshot()
+    assert hs_nv["spills"] > 0, hs_nv          # host LRU overflowed down
+    assert ns["demotes"] > 0 and ns["promotions"] > 0, ns
+    assert ns["fallbacks"] == 0 and ns["aio_errors"] == 0, ns
+    assert srv_nv.kvscope.snapshot()["regret"]["regret_tokens"] == 0
+    kv_res = srv_nv.kv_residency()
+    assert kv_res["nvme_tier"]["pages"] == ns["pages"], kv_res
+
+    # (8) torn/corrupt/missing disk copies degrade to recompute with
+    # counted fallbacks — never an exception, still bit-exact. Truncate
+    # one file (torn write), garbage another (bit rot), unlink a third.
+    import glob as _glob
+
+    srv_nv.nvmekv.flush()                      # settle write-behind
+    files = sorted(_glob.glob(
+        os.path.join(srv_nv.nvmekv.store.dir, "*.bin")))
+    assert len(files) >= 3, files
+    for i, fp in enumerate(files):
+        if i % 2:                              # torn write: short file
+            with open(fp, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(fp) // 2))
+        else:                                  # bit rot: garbage bytes
+            with open(fp, "r+b") as f:
+                f.write(b"\xff" * 64)
+    # and one LOST file (unlink through the store so its fd cache
+    # can't serve the dead inode): the read must miss, not wedge
+    lost_key = next(iter(srv_nv.nvmekv.entries))
+    srv_nv.nvmekv.store.unlink(srv_nv.nvmekv._file(lost_key))
+    A, _B = _prompts()
+    toks_bad, _t = _run_one(srv_nv, A, 1003, "sess-a")
+    toks_ref, _t = _run_one(srv_off, A, 1003, "sess-a")
+    assert toks_bad == toks_ref, "corrupt-NVMe resume diverged"
+    ns2 = srv_nv.nvmekv.snapshot()
+    nvme_fb = ns2["fallbacks"]
+    assert nvme_fb >= 1, ns2                   # counted, never raised
+
+    # (9) demote-ahead: idle sessions' pages staged tier-ward OFF the
+    # admission path — post-warm evictions are pure fast-frees, the
+    # pressure demote-wait meter stays EXACTLY zero (the plain tiered
+    # engine's is nonzero on identical traffic), regret stays zero,
+    # and steady state compiles nothing new (shared demote program)
+    eng_da, srv_da = _mk(host=True, demote_ahead_idle_s=1e-9)
+    runs_da, _ = cycle(srv_da, rounds=2)       # warm: compiles happen
+    warm_da, wait_da0 = srv_da.compiles, srv_da.demote_wait_s
+    runs_da2, _ = cycle(srv_da, rounds=3)
+    for (sa, (ta, _)), (sb, (tb, _)) in zip(runs_off, runs_da2):
+        assert sa == sb and ta == tb, "demote-ahead output diverged"
+    assert srv_da.compiles == warm_da, \
+        f"{srv_da.compiles - warm_da} new compiles under demote-ahead"
+    assert set(srv_da._programs) == set(srv_on._programs), \
+        set(srv_da._programs) ^ set(srv_on._programs)
+    da_wait = srv_da.demote_wait_s - wait_da0
+    assert da_wait == 0.0, \
+        f"demote-ahead left {da_wait:.6f}s of demotion on the " \
+        "admission path"
+    assert srv_on.demote_wait_s > 0.0, srv_on.demote_wait_s
+    c_da = srv_da.stats.registry.snapshot()["counters"]
+    assert c_da.get("Serve/demote_ahead_staged", 0) > 0, c_da
+    assert c_da.get("Serve/demote_ahead_fastfrees", 0) > 0, c_da
+    assert srv_da.kvscope.snapshot()["regret"]["regret_tokens"] == 0
+    assert srv_da.hostkv.fallbacks == 0
+
+    # (10) doctor NVMe-rung verdicts: disk fallbacks and aio transport
+    # errors each trip the gate; a clean spilling tier does not
+    with tempfile.TemporaryDirectory() as td:
+        rc_nv_trip = _doctor_exit(
+            "dstpu_serve_nvme_tier_pages 6\n"
+            "dstpu_serve_nvme_tier_fallbacks 2\n", td)
+    with tempfile.TemporaryDirectory() as td:
+        rc_nv_aio = _doctor_exit(
+            "dstpu_serve_nvme_tier_pages 6\n"
+            "dstpu_serve_nvme_aio_errors 1\n", td)
+    with tempfile.TemporaryDirectory() as td:
+        rc_nv_clean = _doctor_exit(
+            "dstpu_serve_nvme_tier_pages 6\n"
+            "dstpu_serve_nvme_tier_promotions 9\n"
+            "dstpu_serve_nvme_tier_fallbacks 0\n", td)
+    assert rc_nv_trip == 1, f"doctor NVMe fallback gate silent ({rc_nv_trip})"
+    assert rc_nv_aio == 1, f"doctor NVMe aio gate silent ({rc_nv_aio})"
+    assert rc_nv_clean == 0, f"doctor NVMe gate false-fired ({rc_nv_clean})"
+    srv_nv.nvmekv.close()
+
     print(json.dumps({
         "smoke": True,
         "restores": hs["restores"],
@@ -227,20 +334,28 @@ def smoke():
         "restore_beats_recompute": bool(restore_wins),
         "degraded_reason": degrade,
         "compiled_programs": warm,
+        "nvme_spills_in": hs_nv["spills"],
+        "nvme_promotions": ns["promotions"],
+        "nvme_fallbacks_clean": ns["fallbacks"],
+        "nvme_fallbacks_after_corruption": nvme_fb,
+        "demote_ahead_fastfrees": c_da.get(
+            "Serve/demote_ahead_fastfrees", 0),
+        "demote_ahead_admission_wait_s": da_wait,
+        "plain_tier_admission_wait_s": round(srv_on.demote_wait_s, 6),
         "verdict": "smoke-pass",
     }))
 
 
 # ------------------------------------------------------------------- full
 def oversubscribed(host: bool, sessions: int = 20, rounds: int = 3,
-                   seed: int = 11):
-    """10× session oversubscription: ``sessions`` sessions whose
-    worst-case pages total ~10× the pool, resumed round-robin so every
-    resume finds its tree pages evicted. Returns (resume ttfts, engine,
-    per-request worst-case pages)."""
+                   seed: int = 11, depth: int = 10, **over):
+    """``depth``× session oversubscription: ``sessions`` sessions whose
+    worst-case pages total ~``depth``× the pool, resumed round-robin so
+    every resume finds its tree pages evicted. Returns (resume ttfts,
+    engine, per-request worst-case pages)."""
     per_req = (_P + _MAX_NEW - 1 + _PS - 1) // _PS
-    pool = 1 + max(2, (sessions * per_req) // 10)
-    _eng, srv = _mk(host=host, pool_pages=pool)
+    pool = 1 + max(2, (sessions * per_req) // depth)
+    _eng, srv = _mk(host=host, pool_pages=pool, **over)
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(0, 256, (_P,)).astype(np.int32)
                for _ in range(sessions)]
@@ -318,6 +433,36 @@ def bench(sessions: int = 20):
         "achieved_restored_tokens": ach.get("restored_tokens"),
         "achieved_restore_tokens_per_s": ach.get("restore_tokens_per_s"),
     }
+
+    # NVMe rung vs oversubscription depth: sessions scale with depth
+    # against a one-request pool, the host tier holds ~4 sessions, the
+    # rest lives on disk — resume TTFT and regret as the hierarchy
+    # deepens to x100 (the "unbounded" claim, measured). Rates/ratios
+    # only, same ledger discipline as above.
+    res["nvme_depth_sweep"] = []
+    for depth in (10, 30, 100):
+        t_nv, srv_nv, _pr = oversubscribed(
+            host=True, sessions=depth, rounds=2, depth=depth,
+            host_pool_bytes=4 * per_req * 8192,
+            nvme_pool_bytes=1 << 30, demote_ahead_idle_s=1e-9)
+        ns = srv_nv.nvmekv.snapshot()
+        hsd = srv_nv.hostkv.snapshot()
+        ks = srv_nv.kvscope.snapshot()
+        res["nvme_depth_sweep"].append({
+            "oversubscription_x": round(
+                depth * _pr / srv_nv.pool.usable, 1),
+            "sessions": depth,
+            "resume_ttft_s": round(float(np.median(t_nv)), 6),
+            "regret_tokens": ks["regret"]["regret_tokens"],
+            "host_spills_down": hsd["spills"],
+            "nvme_promotions": ns["promotions"],
+            "nvme_read_mb_s": ns["read_mb_s"],
+            "nvme_fallbacks": ns["fallbacks"],
+            "nvme_aio_errors": ns["aio_errors"],
+            "demote_ahead_admission_wait_s": round(
+                srv_nv.demote_wait_s, 6),
+        })
+        srv_nv.nvmekv.close()
     return res
 
 

@@ -66,6 +66,23 @@ class ServingConfig:
     # 0 (default) builds no tier: one `is not None` per admission and
     # per eviction pass, zero new programs (docs/SERVING.md).
     host_pool_bytes: int = 0
+    # ---- NVMe rung below the host tier (serving/tiering.py) ----
+    # nvme_pool_bytes > 0 (requires host_pool_bytes) adds a disk rung:
+    # host-tier prune victims SPILL to swap files via ops/aio.py async
+    # writes instead of vanishing, and admission matches promote
+    # NVMe→host→HBM through the same restore path with the same
+    # CRC/fallback-to-recompute contract — session residency bounded by
+    # disk, not DRAM. nvme_path picks the mount (default $TMPDIR/
+    # dstpu_kv_nvme; each engine gets a private subdirectory).
+    nvme_pool_bytes: int = 0
+    nvme_path: "str | None" = None
+    # demote_ahead_idle_s > 0 (requires host_pool_bytes) turns on the
+    # background demotion lane: tree-held pages idle past this many
+    # seconds are proactively staged into the tier OFF the admission
+    # path, so a later eviction under pressure frees pages already
+    # copied (a refcount drop, not a blocking gather+device_get —
+    # measured in Serve/host_tier_demote_wait_s). 0 = off.
+    demote_ahead_idle_s: float = 0.0
     # engine-wide sampling policy (per-request RNG still makes every
     # request's draws independent of batch composition)
     temperature: float = 1.0
@@ -211,6 +228,20 @@ class ServingConfig:
             raise ValueError("host_pool_bytes (the tiered host KV store) "
                              "requires the paged KV cache (set "
                              "serving.page_size)")
+        if self.nvme_pool_bytes < 0:
+            raise ValueError(f"nvme_pool_bytes must be >= 0, "
+                             f"got {self.nvme_pool_bytes}")
+        if self.nvme_pool_bytes and not self.host_pool_bytes:
+            raise ValueError("nvme_pool_bytes (the NVMe KV rung) requires "
+                             "the host tier above it (set "
+                             "serving.host_pool_bytes)")
+        if self.demote_ahead_idle_s < 0:
+            raise ValueError(f"demote_ahead_idle_s must be >= 0, "
+                             f"got {self.demote_ahead_idle_s}")
+        if self.demote_ahead_idle_s and not self.host_pool_bytes:
+            raise ValueError("demote_ahead_idle_s (background demotion) "
+                             "requires the tiered host KV store (set "
+                             "serving.host_pool_bytes)")
         for knob in ("ttft_deadline_s", "total_deadline_s", "watchdog_s"):
             if getattr(self, knob) < 0:
                 raise ValueError(f"{knob} must be >= 0, "

@@ -621,6 +621,51 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         why_tk += ("; host tier ACTIVE — achieved restores reported "
                    "alongside the projection (remaining regret scores "
                    "what the tier still misses)")
+    # The disk rung's sub-estimate: same regret × advantage shape, but
+    # the restore cost is the NVMe tier's MEASURED read bandwidth (its
+    # verified promotions), falling back to AIO_BENCH numbers would be a
+    # projection — unmeasured means score 0 with the reason stated.
+    nv = ks.get("nvme_tier")
+    if nv is not None:
+        nv_score = 0.0
+        nv_est: dict[str, Any] = {
+            "pages": nv.get("pages"),
+            "bytes": nv.get("bytes"),
+            "capacity_bytes": nv.get("capacity_bytes"),
+            "promotions": nv.get("promotions"),
+            "spilled_in": ht.get("spills"),
+            "fallbacks": nv.get("fallbacks"),
+            "aio_errors": nv.get("aio_errors"),
+            "read_mb_s": nv.get("read_mb_s"),
+            "projected_nvme_restore_s_per_resume": None,
+        }
+        rbw = nv.get("read_mb_s")
+        if not regret_tokens:
+            why_nv = ("no eviction regret on this traffic — the upper "
+                      "rungs cover the working set")
+        elif rbw is None:
+            why_nv = ("NVMe read bandwidth unmeasured (no verified "
+                      "promotions yet) — disk restore cost unknown, "
+                      "sub-estimate degraded; see AIO_BENCH.json for "
+                      "the standalone sweep")
+        elif pr is None or not ptb or mean_tok is None:
+            why_nv = ("prefill/recompute cost unmeasured — cannot "
+                      "price disk restore against recompute")
+        else:
+            nvme_restore_s = mean_tok * ptb / (rbw * 1e6)
+            recompute_s = mean_tok / pr
+            nv_est["projected_nvme_restore_s_per_resume"] = nvme_restore_s
+            adv = max(0.0, 1.0 - nvme_restore_s / recompute_s) \
+                if recompute_s > 0 else 0.0
+            nv_score = float(regret_frac or 0.0) * adv
+            why_nv = ("measured regret share scaled by the measured "
+                      f"NVMe-read-vs-recompute advantage (disk restore "
+                      f"{nvme_restore_s:.3g}s vs recompute "
+                      f"{recompute_s:.3g}s per mean regretted resume, "
+                      f"at the tier's achieved {rbw:.1f} MB/s)")
+        tk_est["nvme"] = nv_est
+        tk_est["nvme_score"] = nv_score
+        tk_est["nvme_why"] = why_nv
     levers.append({"name": LEVER_TIERED_KV, "score": float(tk_score),
                    "estimate": tk_est, "why": why_tk})
 

@@ -506,6 +506,7 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
           if k.startswith(("dstpu_serve_eviction_regret",
                            "dstpu_serve_kv_", "dstpu_serve_session",
                            "dstpu_serve_host_tier",
+                           "dstpu_serve_nvme_", "dstpu_serve_demote_ahead",
                            "dstpu_fleet_affinity_regret",
                            "dstpu_fleet_resume_regret"))}
     if not kv:
@@ -531,7 +532,19 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
             ("dstpu_serve_host_tier_prunes", "host_tier_prunes"),
             ("dstpu_serve_host_tier_fallbacks", "host_tier_fallbacks"),
             ("dstpu_serve_session_host_restored_resumes",
-             "host_restored_resumes")):
+             "host_restored_resumes"),
+            ("dstpu_serve_host_tier_staged_ahead", "staged_ahead_pages"),
+            ("dstpu_serve_host_tier_demote_wait_s", "demote_wait_s"),
+            ("dstpu_serve_demote_ahead_staged", "demote_ahead_staged"),
+            ("dstpu_serve_demote_ahead_fastfrees",
+             "demote_ahead_fastfrees"),
+            ("dstpu_serve_nvme_tier_pages", "nvme_tier_pages"),
+            ("dstpu_serve_nvme_tier_bytes", "nvme_tier_bytes"),
+            ("dstpu_serve_nvme_tier_occupancy", "nvme_tier_occupancy"),
+            ("dstpu_serve_nvme_tier_promotions", "nvme_promotions"),
+            ("dstpu_serve_host_tier_spills", "nvme_spilled_in"),
+            ("dstpu_serve_nvme_tier_fallbacks", "nvme_tier_fallbacks"),
+            ("dstpu_serve_nvme_aio_errors", "nvme_aio_errors")):
         if key in kv:
             print(f"  {label:<24s} {_fmt(kv[key])}")
     # host-tier verdict: restores without fallbacks is the tier working;
@@ -543,6 +556,18 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
                    else "under pressure (next demotion prunes)"
                    if pressed else "clean")
         print(f"  host tier verdict: {verdict}")
+    # NVMe rung verdict beside it: promotions without fallbacks/errors
+    # is the disk rung working (host prune spills instead of losing
+    # history); aio errors mean the transport itself is failing
+    if "dstpu_serve_nvme_tier_pages" in kv:
+        nfb = kv.get("dstpu_serve_nvme_tier_fallbacks") or 0
+        nae = kv.get("dstpu_serve_nvme_aio_errors") or 0
+        npr = kv.get("dstpu_serve_nvme_tier_pressure")
+        verdict = ("DEGRADED: aio transport errors" if nae
+                   else "DEGRADED: torn/corrupt disk copies" if nfb
+                   else "under pressure (next spill prunes)"
+                   if npr else "clean")
+        print(f"  nvme tier verdict: {verdict}")
     # hottest evicted sessions + the lever verdict come from the newest
     # capacity report's kvscope section (per-session data never lands in
     # the scalar exposition)
@@ -591,6 +616,25 @@ def report_kv(d: Path, regret_max: float = 0.5) -> list:
             "copies failed verification and were recomputed — host "
             "memory corruption or a torn demotion; serving degraded "
             "safely but the tier is not trustworthy")
+    nfb = kv.get("dstpu_serve_nvme_tier_fallbacks")
+    if isinstance(nfb, (int, float)) and nfb > 0:
+        print(f"  NVME-TIER FALLBACKS: {_fmt(nfb)} torn/corrupt/missing "
+              "disk copies degraded to recompute")
+        findings.append(
+            f"nvme-tier fallbacks in {prom.name}: {_fmt(nfb)} disk KV "
+            "copies failed CRC/read verification and were recomputed — "
+            "torn writes or a failing device; serving degraded safely "
+            "but the disk rung is not trustworthy")
+    nae = kv.get("dstpu_serve_nvme_aio_errors")
+    if isinstance(nae, (int, float)) and nae > 0:
+        print(f"  NVME AIO ERRORS: {_fmt(nae)} async I/O "
+              "submit/wait failures (ds_aio_errors)")
+        findings.append(
+            f"nvme aio errors in {prom.name}: {_fmt(nae)} async I/O "
+            "operations failed on the swap files — check the "
+            "serving.nvme_path mount (space, permissions, device "
+            "health); the tier degrades to recompute but disk "
+            "bandwidth is being wasted")
     return findings
 
 

@@ -121,6 +121,21 @@ class AsyncIOHandle:
     def sync_read(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
         self.wait(self.submit_read(path, buf, offset))
 
+    def forget(self, path: str) -> None:
+        """Drop (and close) any cached fds for ``path``. Must be called
+        when a swap file is unlinked or replaced on disk: the fd cache is
+        keyed by path string, so a stale descriptor would silently keep
+        serving the deleted inode."""
+        for w in (0, 1):
+            fd = self._fds.pop(f"{path}|{w}", None)
+            if fd is None:
+                continue
+            try:
+                (self._lib.ds_aio_close(fd) if self._lib is not None
+                 else os.close(fd))
+            except OSError:
+                pass
+
     def close(self) -> None:
         for fd in self._fds.values():
             (self._lib.ds_aio_close(fd) if self._lib is not None
@@ -137,6 +152,80 @@ class AsyncIOHandle:
             self.close()
         except Exception:
             pass
+
+
+class AIOFileStore:
+    """One directory of swap files behind a shared :class:`AsyncIOHandle`.
+
+    This is the single NVMe seam: the serving KV disk tier
+    (``serving/tiering.py``) and the optimizer-state offload swap
+    (``runtime/offload.py``) both run their files through this object
+    instead of growing private aio/fd/path disciplines. It owns
+
+    - name→path mapping under one directory (callers speak file *names*),
+    - fd-cache hygiene (``unlink`` closes cached descriptors before
+      removing the inode, so a later re-create never reads a stale fd),
+    - an ``errors`` counter every failed submit/wait increments — the
+      ``ds_aio_errors`` signal surfaced by doctor/health.
+
+    Integrity (CRC) policy intentionally stays one layer up: the KV tier
+    verifies per-entry checksums, the optimizer swap trusts its own
+    fixed-layout files. Both get the same transport discipline here.
+    """
+
+    def __init__(self, directory: str, n_threads: int = 4,
+                 block_size: int = 1 << 20, use_direct: bool = False):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.aio = AsyncIOHandle(n_threads=n_threads, block_size=block_size,
+                                 use_direct=use_direct)
+        self.errors = 0
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    # ---------------------------------------------------------- submit/wait
+    def submit_write(self, name: str, buf: np.ndarray, offset: int = 0) -> int:
+        try:
+            return self.aio.submit_write(self.path(name), buf, offset)
+        except OSError:
+            self.errors += 1
+            raise
+
+    def submit_read(self, name: str, buf: np.ndarray, offset: int = 0) -> int:
+        try:
+            return self.aio.submit_read(self.path(name), buf, offset)
+        except OSError:
+            self.errors += 1
+            raise
+
+    def wait(self, ticket: int) -> None:
+        try:
+            self.aio.wait(ticket)
+        except OSError:
+            self.errors += 1
+            raise
+
+    def sync_write(self, name: str, buf: np.ndarray, offset: int = 0) -> None:
+        self.wait(self.submit_write(name, buf, offset))
+
+    def sync_read(self, name: str, buf: np.ndarray, offset: int = 0) -> None:
+        self.wait(self.submit_read(name, buf, offset))
+
+    # ------------------------------------------------------------ lifecycle
+    def unlink(self, name: str) -> None:
+        p = self.path(name)
+        self.aio.forget(p)
+        try:
+            os.unlink(p)
+        except FileNotFoundError:
+            pass
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self.path(name))
+
+    def close(self) -> None:
+        self.aio.close()
 
 
 def native_available() -> bool:

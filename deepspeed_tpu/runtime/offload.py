@@ -73,11 +73,14 @@ class HostOffloadOptimizer:
             self.aio = None
         else:
             path = offload_cfg.nvme_path or "/tmp/dstpu_nvme"
-            os.makedirs(path, exist_ok=True)
-            self.nvme_dir = path
-            self.aio = aio_mod.AsyncIOHandle(
-                n_threads=max(2, int(offload_cfg.buffer_count)),
+            # the shared NVMe seam (ops/aio.py): the same directory-of-
+            # swap-files discipline the serving KV disk tier runs
+            # through — name-based submits, fd-cache hygiene, counted
+            # transport errors — instead of a private aio/path copy
+            self.aio = aio_mod.AIOFileStore(
+                path, n_threads=max(2, int(offload_cfg.buffer_count)),
                 use_direct=False)
+            self.nvme_dir = self.aio.dir
             # two swap slots of max-leaf size (double buffering)
             max_n = max(x.size for x in self.master)
             n_slots = 2
@@ -102,14 +105,15 @@ class HostOffloadOptimizer:
                      f"tensors in {path}", ranks=[0])
 
     # ------------------------------------------------------------------ files
+    # bare names: the AIOFileStore owns the directory and the paths
     def _mfile(self, i):
-        return os.path.join(self.nvme_dir, f"moment1_{i}.bin")
+        return f"moment1_{i}.bin"
 
     def _vfile(self, i):
-        return os.path.join(self.nvme_dir, f"moment2_{i}.bin")
+        return f"moment2_{i}.bin"
 
     def _pfile(self, i):
-        return os.path.join(self.nvme_dir, f"master_{i}.bin")
+        return f"master_{i}.bin"
 
     def _paged_master(self, i) -> bool:
         return self.nvme and self.master[i] is None

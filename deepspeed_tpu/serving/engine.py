@@ -254,6 +254,9 @@ class ServingEngine:
         self._table = None
         self._table_dirty = False
         _kvs_on = self.cfg.kvscope is not None and self.cfg.kvscope.enabled
+        # demote-ahead needs the same per-entry touch clock kvscope uses
+        # (tree tstamps are the session-idleness signal at block grain)
+        _da_on = self.cfg.demote_ahead_idle_s > 0
         if self._paged:
             self.pool = PagePool(self.cfg.pool_pages, self.cfg.page_size,
                                  self.cfg.max_len,
@@ -262,8 +265,8 @@ class ServingEngine:
                                  # the eviction-pressure ages are the
                                  # residency observatory's opt-in; the
                                  # default pool stays clock-free
-                                 clock=self.stats.clock if _kvs_on
-                                 else None)
+                                 clock=self.stats.clock
+                                 if (_kvs_on or _da_on) else None)
             # host-authoritative page tables, mirrored into the carry on
             # change (insert seats a row, retirement clears one): steady
             # full-slot decode uploads nothing
@@ -282,9 +285,23 @@ class ServingEngine:
         # adds exactly two fixed-shape programs (demote gather, restore
         # scatter) to the bounded set.
         self.hostkv = None
+        # NVMe rung below the host tier + the ranked-store coordinator
+        # (serving/tiering.py): built only when serving.nvme_pool_bytes
+        # is set — otherwise pool.host is the bare host store, exactly
+        # the PR-14 shape
+        self.nvmekv = None
+        self.kvtier = None
         # demote gathers dispatched this iteration, materialized to the
         # tier at the end of step() — see _demote_pages/_drain_demotes
         self._pending_demotes: list = []
+        # demote-ahead lane (cfg.demote_ahead_idle_s): prefixes staged
+        # into the tier while still tree-held, so eviction under
+        # pressure is a refcount drop. demote_wait_s is the measured
+        # admission-path demote-blocking wall the lane exists to zero.
+        self._demote_ahead = (self.cfg.demote_ahead_idle_s
+                              if _da_on else None)
+        self._staged_ahead: set = set()
+        self.demote_wait_s = 0.0
         if self._paged and self.cfg.host_pool_bytes > 0:
             from .hostkv import HostKVTier
 
@@ -293,10 +310,23 @@ class ServingEngine:
                                      registry=self.stats.registry,
                                      clock=self.stats.clock)
             self.pool.host = self.hostkv
+            if self.cfg.nvme_pool_bytes > 0:
+                from .tiering import NVMeKVTier, TieringEngine
+
+                self.nvmekv = NVMeKVTier(self.cfg.nvme_pool_bytes,
+                                         self.cfg.page_size,
+                                         path=self.cfg.nvme_path,
+                                         registry=self.stats.registry,
+                                         clock=self.stats.clock)
+                self.kvtier = TieringEngine([self.hostkv, self.nvmekv])
+                self.pool.host = self.kvtier
             self.pool.on_demote = self._demote_pages
             if self.flight is not None:
                 self.flight.add_snapshot_provider("host_kv",
                                                   self.hostkv.snapshot)
+                if self.nvmekv is not None:
+                    self.flight.add_snapshot_provider(
+                        "nvme_kv", self.nvmekv.snapshot)
         # KV residency observatory (observability/kvscope.py,
         # docs/OBSERVABILITY.md): ghost-tree eviction-regret ledger on
         # the page pool + per-session lifecycle heat tracking + the
@@ -953,6 +983,11 @@ class ServingEngine:
                     self._decode_emitted += len(self.sched.running)
                     finished += self.sched.on_step(toks, dones)
                 ran_decode = True
+        if self._demote_ahead is not None:
+            # background demotion lane: stage idle tree-held pages into
+            # the tier BEFORE pressure (the staged gathers drain with
+            # this same iteration's batch below)
+            self._demote_ahead_tick()
         if self._pending_demotes:
             # off the TTFT path: the gathers dispatched at admission
             # land in the host tier after this iteration's device work
@@ -1119,9 +1154,49 @@ class ServingEngine:
         honors pending reads across donation) — while the blocking
         ``device_get``, the CRC stamp, and the host copies stay OFF the
         admission path, so demotion never bills the resuming request's
-        TTFT."""
+        TTFT.
+
+        With demote-ahead on, pages the background lane already staged
+        into the tier need NO gather at all — their eviction is the
+        refcount drop that already happened in the pool; only the
+        never-staged remainder pays the dispatch. The pressure-tagged
+        gather-dispatch wall (and the matching ``device_get`` wall in
+        :meth:`_drain_demotes`) accumulates into
+        ``Serve/host_tier_demote_wait_s`` — the admission-path
+        demote-blocking time the lane exists to zero (a fully staged
+        eviction adds exactly nothing to it)."""
+        todo = entries
+        if self._demote_ahead is not None:
+            from ..observability.workload import token_hash
+
+            tier, todo, fast = self.pool.host, [], 0
+            for e in entries:
+                key = (len(e["tokens"]), token_hash(e["tokens"]))
+                self._staged_ahead.discard(key)
+                if tier.holds(e["tokens"], key=key):
+                    fast += 1   # pre-staged: eviction is a pure free
+                else:
+                    todo.append(e)
+            if fast:
+                self.stats.registry.counter(
+                    "Serve/demote_ahead_fastfrees").inc(fast)
+                self.stats.registry.set_gauges({
+                    "Serve/host_tier_staged_ahead":
+                        float(len(self._staged_ahead))})
+        if todo:
+            self._dispatch_demote_gather(todo, pressure=True)
+
+    def _dispatch_demote_gather(self, entries: list,
+                                pressure: bool) -> None:
+        """Dispatch fixed-shape gathers of ``entries``' pages (the ONE
+        compiled "demote" program — the eviction path and the
+        demote-ahead lane share it, so the lane adds zero programs).
+        ``pressure`` tags eviction-driven batches: their dispatch wall
+        here and their ``device_get`` wall at drain count as
+        admission-path demote blocking; background staging's do not."""
         from .hostkv import demote_rows
 
+        t0 = self.stats.clock() if pressure else None
         n = self.pool.pages_per_slot
         for off in range(0, len(entries), n):
             batch = entries[off:off + n]
@@ -1130,22 +1205,69 @@ class ServingEngine:
             prog = self._prog("demote", lambda: jax.jit(demote_rows))
             with self.engine.mesh:
                 self._pending_demotes.append(
-                    (prog(self._state, jnp.asarray(row)), batch))
+                    (prog(self._state, jnp.asarray(row)), batch,
+                     pressure))
+        if pressure:
+            self.demote_wait_s += max(0.0, self.stats.clock() - t0)
+            self.stats.registry.set_gauges({
+                "Serve/host_tier_demote_wait_s": self.demote_wait_s})
+
+    def _demote_ahead_tick(self) -> None:
+        """The background demotion lane (cfg.demote_ahead_idle_s):
+        tree-held full blocks idle past the threshold — per-entry touch
+        stamps, the block-grain spelling of the session idleness
+        kvscope's heat ledger tracks — are gathered and staged into the
+        tier OFF the admission path, one ``pages_per_slot`` batch per
+        iteration, oldest first. Staging is a COPY: the pages stay
+        tree-held, a resuming session still takes the normal tree hit
+        (wasting at most the staged copy), and tree-held pages with no
+        slot users are immutable (divergence copies-on-write), so a
+        staged copy can never go stale."""
+        pool = self.pool
+        if pool.tree_held == 0:
+            return
+        from ..observability.workload import token_hash
+
+        tier = pool.host
+        cutoff = self.stats.clock() - self._demote_ahead
+        cand = pool.demote_ahead_candidates(cutoff, pool.pages_per_slot,
+                                            skip=tier.holds)
+        if not cand:
+            return
+        self._dispatch_demote_gather(cand, pressure=False)
+        for e in cand:
+            self._staged_ahead.add(
+                (len(e["tokens"]), token_hash(e["tokens"])))
+        self.stats.registry.counter(
+            "Serve/demote_ahead_staged").inc(len(cand))
+        self.stats.registry.set_gauges({
+            "Serve/host_tier_staged_ahead":
+                float(len(self._staged_ahead))})
 
     def _drain_demotes(self) -> None:
         """Materialize this iteration's dispatched demote gathers into
-        the host tier (one blocking ``device_get`` per eviction event —
-        by now the gather has usually completed under the iteration's
-        other device work). Runs at the end of every ``step()``; the
-        transient device residency is bounded by one gather output per
-        eviction event of one iteration."""
+        the tier (one blocking ``device_get`` per batch — by now the
+        gather has usually completed under the iteration's other device
+        work). Runs at the end of every ``step()``; the transient
+        device residency is bounded by one gather output per batch of
+        one iteration. Pressure-tagged batches (reactive eviction
+        demotes) bill their ``device_get`` wall to the demote-wait
+        meter; demote-ahead's background staging does not."""
         pending, self._pending_demotes = self._pending_demotes, []
-        for out, batch in pending:
+        pressured = False
+        for out, batch, pressure in pending:
+            t0 = self.stats.clock() if pressure else None
             tiles = jax.device_get(out)
+            if pressure:
+                self.demote_wait_s += max(0.0, self.stats.clock() - t0)
+                pressured = True
             for i, e in enumerate(batch):
-                self.hostkv.put(e["tokens"],
-                                {k: np.ascontiguousarray(v[:, i])
-                                 for k, v in tiles.items()})
+                self.pool.host.put(e["tokens"],
+                                   {k: np.ascontiguousarray(v[:, i])
+                                    for k, v in tiles.items()})
+        if pressured:
+            self.stats.registry.set_gauges({
+                "Serve/host_tier_demote_wait_s": self.demote_wait_s})
 
     def _restore_dispatch(self, cache, alloc):
         """Scatter one admission's host-restored tiles into its prefill
@@ -1176,10 +1298,10 @@ class ServingEngine:
             cache = prog(cache, batch, jnp.int32(alloc.shared + off),
                          jnp.int32(cnt))
             off += cnt
-        self.hostkv.on_restore(self.stats.clock() - t0,
-                               pages=alloc.restored,
-                               tokens=alloc.restore_tokens,
-                               nbytes=alloc.restore_bytes)
+        self.pool.host.on_restore(self.stats.clock() - t0,
+                                  pages=alloc.restored,
+                                  tokens=alloc.restore_tokens,
+                                  nbytes=alloc.restore_bytes)
         alloc.restore_tiles = None        # the payload is on device now
         return cache
 
@@ -1444,7 +1566,27 @@ class ServingEngine:
                 "prunes": hs["prunes"],
                 "fallbacks": hs["fallbacks"],
             }
+            if self._demote_ahead is not None:
+                out["host_tier"]["staged_ahead"] = len(self._staged_ahead)
+                out["host_tier"]["demote_wait_s"] = self.demote_wait_s
             # snapshot() already refreshed the Serve/host_tier_* gauges
+        if self.nvmekv is not None:
+            # the disk rung beside it: occupancy, verified promotions,
+            # and the two failure signals ops gates on (counted CRC
+            # fallbacks, aio transport errors)
+            ns = self.nvmekv.snapshot()
+            out["nvme_tier"] = {
+                "pages": ns["pages"],
+                "bytes": ns["bytes"],
+                "capacity_bytes": ns["capacity_bytes"],
+                "occupancy": ns["occupancy"],
+                "pressure": ns["pressure"],
+                "promotions": ns["promotions"],
+                "spilled_in": self.hostkv.spills,
+                "fallbacks": ns["fallbacks"],
+                "aio_errors": ns["aio_errors"],
+                "native_aio": ns["native_aio"],
+            }
         self.stats.registry.set_gauges(gauges)
         if self.loadscope is not None:
             # refresh Serve/utilization / predicted-wait / TTV at probe
@@ -1645,7 +1787,10 @@ class ServingEngine:
             if self.hostkv is None:
                 return None
             # no observatory, but the tier's achieved side still reports
-            return {"enabled": False, "host_tier": self.hostkv.snapshot()}
+            out = {"enabled": False, "host_tier": self.hostkv.snapshot()}
+            if self.nvmekv is not None:
+                out["nvme_tier"] = self.nvmekv.snapshot()
+            return out
         snap = self.kvscope.snapshot()
         snap["copy_bandwidth"] = self.kvscope.copy_bandwidth()
         snap["prefill"] = self._prefill_rate()
@@ -1654,6 +1799,10 @@ class ServingEngine:
             # tier actually restored, at what measured rate — reported
             # next to the advisor's projection (observability/capacity.py)
             snap["host_tier"] = self.hostkv.snapshot()
+        if self.nvmekv is not None:
+            # the disk rung's achieved side (verified promotions +
+            # measured read bandwidth) — the nvme sub-estimate's input
+            snap["nvme_tier"] = self.nvmekv.snapshot()
         return snap
 
     def hbm_ledger(self, temp_bytes: Optional[int] = None) -> dict:

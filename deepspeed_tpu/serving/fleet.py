@@ -1048,6 +1048,8 @@ class FleetEngine:
             s = e.kvscope.snapshot()
             if e.hostkv is not None:
                 s["host_tier"] = e.hostkv.snapshot()
+            if e.nvmekv is not None:
+                s["nvme_tier"] = e.nvmekv.snapshot()
             per[n] = s
         if not per:
             return None
@@ -1069,6 +1071,21 @@ class FleetEngine:
                 for s in per.values()),
             "host_tier_bytes": sum(
                 (s.get("host_tier") or {}).get("bytes", 0)
+                for s in per.values()),
+            # the disk rung, rolled up beside the DRAM rung: verified
+            # promotions (blocks read back), resident bytes, and the
+            # fallbacks/aio-errors ops gates on fleet-wide
+            "nvme_tier_promotions": sum(
+                (s.get("nvme_tier") or {}).get("promotions", 0)
+                for s in per.values()),
+            "nvme_tier_bytes": sum(
+                (s.get("nvme_tier") or {}).get("bytes", 0)
+                for s in per.values()),
+            "nvme_tier_fallbacks": sum(
+                (s.get("nvme_tier") or {}).get("fallbacks", 0)
+                for s in per.values()),
+            "nvme_aio_errors": sum(
+                (s.get("nvme_tier") or {}).get("aio_errors", 0)
                 for s in per.values()),
         }
         totals["regret_frac"] = (

@@ -48,15 +48,11 @@ program LRU (a fleet's replicas reuse them like every other program).
 
 from __future__ import annotations
 
-import zlib
-from collections import OrderedDict
-from typing import Callable, Optional
-
 import jax.numpy as jnp
 import numpy as np
 
 from ..inference.decode import dequantize_kv
-from ..observability.workload import prefix_hashes, token_hash
+from .tiering import TierStore, tiles_crc
 
 __all__ = ["HostKVTier", "demote_rows", "restore_into_cache"]
 
@@ -113,18 +109,14 @@ def restore_into_cache(cache, tiles, start, count):
 
 
 # -------------------------------------------------------------- host side
-def _crc(tiles: dict) -> int:
-    """Integrity checksum over a page's raw host bytes: a corrupt or
-    torn host copy must degrade to recompute, never into the cache."""
-    h = 0
-    for key in sorted(tiles):
-        h = zlib.crc32(np.ascontiguousarray(tiles[key]).tobytes(), h)
-    return h
+# shared integrity checksum (one CRC contract across every rung)
+_crc = tiles_crc
 
 
-class HostKVTier:
+class HostKVTier(TierStore):
     """Bounded host-memory page store: the demotion target and restore
-    source for one engine's :class:`~.pages.PagePool`.
+    source for one engine's :class:`~.pages.PagePool` — the DRAM rung
+    of the :mod:`~.tiering` hierarchy.
 
     Entries are one full tree block each — ``(prefix_len, prefix_hash)``
     key (the ghost-list spelling, via the shared
@@ -134,266 +126,20 @@ class HostKVTier:
     PINS consecutive matches (a concurrent demotion's prune cannot drop
     a block mid-admission); ``consume`` pops pinned matches into one
     stacked payload; ``release`` unpins when the allocation deferred.
-    All ``Serve/host_tier_*`` metrics land in the serving registry."""
+    All ``Serve/host_tier_*`` metrics land in the serving registry.
 
-    def __init__(self, capacity_bytes: int, page_size: int,
-                 registry=None, clock: Optional[Callable] = None):
-        if capacity_bytes < 1:
-            raise ValueError(f"host_pool_bytes must be >= 1, "
-                             f"got {capacity_bytes}")
-        self.capacity_bytes = int(capacity_bytes)
-        self.page_size = int(page_size)
-        self.registry = registry
-        self.clock = clock if clock is not None else (lambda: 0.0)
-        self.entries: OrderedDict = OrderedDict()
-        self.bytes_used = 0
-        # cumulative accounting (the capacity advisor's achieved side)
-        self.demotes = 0            # pages demoted into the tier
-        self.demote_bytes = 0
-        self.demote_skips = 0       # pages too large for the whole budget
-        self.restores = 0           # restore OPERATIONS (one per admission)
-        self.restored_pages = 0
-        self.restored_tokens = 0
-        self.restore_bytes = 0
-        self.restore_wait_s = 0.0   # summed dispatch wall of all restores
-        self.hits = 0               # blocks served from the tier
-        self.misses = 0             # continuation probes that found nothing
-        self.prunes = 0             # entries LRU-dropped for capacity
-        self.pruned_bytes = 0
-        self.fallbacks = 0          # corrupt/mismatched copies -> recompute
-        self._publish()
+    Every store behavior — LRU budget, pins, the match/consume/release
+    handshake, degrade-never-crash — is the shared
+    :class:`~.tiering.TierStore` implementation; this rung's payload
+    transport is trivial: tiles simply stay in RAM on the entry."""
 
-    # ------------------------------------------------------------- metrics
-    def _publish(self) -> None:
-        if self.registry is None:
-            return
-        self.registry.set_gauges({
-            "Serve/host_tier_pages": float(len(self.entries)),
-            "Serve/host_tier_bytes": float(self.bytes_used),
-            "Serve/host_tier_capacity_bytes": float(self.capacity_bytes),
-            "Serve/host_tier_occupancy": (
-                self.bytes_used / self.capacity_bytes),
-            "Serve/host_tier_pressure": float(self.pressure),
-        })
+    kind = "host_tier"
 
-    def _count(self, name: str, n: int = 1) -> None:
-        if self.registry is not None and n:
-            self.registry.counter(name).inc(n)
+    # ---------------------------------------------------- payload transport
+    def _attach(self, key, ent: dict, tiles: dict) -> None:
+        ent["tiles"] = tiles
 
-    @property
-    def pressure(self) -> bool:
-        """True when the tier cannot fit another typical page without
-        pruning a cold one — the next demotion starts losing history."""
-        if not self.entries:
-            return False
-        mean = self.bytes_used / len(self.entries)
-        return self.capacity_bytes - self.bytes_used < mean
+    def _verify(self, ent: dict):
+        return ent["tiles"] if tiles_crc(ent["tiles"]) == ent["crc"] \
+            else None
 
-    # ------------------------------------------------------------- demotion
-    def put(self, tokens, tiles: dict) -> bool:
-        """Store one demoted page: ``tokens`` is the full token prefix
-        the tree entry cached (its identity), ``tiles`` the page's raw
-        host arrays. Over-budget puts prune LRU (unpinned) entries; a
-        page larger than the whole budget is skipped, counted, never an
-        error. Returns whether the page was kept."""
-        toks = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
-        nbytes = sum(int(v.nbytes) for v in tiles.values())
-        if nbytes > self.capacity_bytes:
-            self.demote_skips += 1
-            self._count("Serve/host_tier_demote_skips")
-            return False
-        key = (len(toks), token_hash(toks))
-        old = self.entries.get(key)
-        if old is not None:
-            if old["pinned"]:
-                # an in-flight admission pinned this key (match() →
-                # consume() within the same try_admit; the demotion
-                # running between them is that admission's own eviction
-                # pass) — replacing it would void the pin and let a
-                # later prune drop the entry mid-restore. Keep the
-                # pinned entry; skip the demotion.
-                self.demote_skips += 1
-                self._count("Serve/host_tier_demote_skips")
-                return False
-            self.entries.pop(key)
-            self.bytes_used -= old["nbytes"]
-        self.entries[key] = {
-            "tokens": toks, "tiles": tiles, "nbytes": nbytes,
-            "crc": _crc(tiles), "t": self.clock(), "pinned": False,
-        }
-        self.bytes_used += nbytes
-        self.demotes += 1
-        self.demote_bytes += nbytes
-        self._count("Serve/host_tier_demotes")
-        self._count("Serve/host_tier_demote_bytes", nbytes)
-        self._prune()
-        self._publish()
-        return True
-
-    def _prune(self) -> None:
-        """LRU-drop unpinned entries until the budget holds. Pinned
-        entries (matched, awaiting consume in this very admission) are
-        skipped — at most ``pages_per_slot`` of them exist at a time."""
-        while self.bytes_used > self.capacity_bytes:
-            victim = None
-            for key, ent in self.entries.items():
-                if not ent["pinned"]:
-                    victim = key
-                    break
-            if victim is None:
-                return
-            ent = self.entries.pop(victim)
-            self.bytes_used -= ent["nbytes"]
-            self.prunes += 1
-            self.pruned_bytes += ent["nbytes"]
-            self._count("Serve/host_tier_prunes")
-
-    # -------------------------------------------------------------- restore
-    def _tail_mismatch(self, ent: dict, toks, length: int) -> bool:
-        """Exact verification of the entry's OWN block (its last
-        ``page_size`` tokens) against the prompt. The earlier prefix is
-        covered by induction: blocks below ``start_block`` were matched
-        token-exact by the radix tree, each prior host hit verified its
-        own block, and the ``(prefix_len, rolling_hash)`` key ties the
-        whole prefix (the same identity standard the ghost ledger uses
-        alone). A full-prefix tuple compare per block would be
-        O(P²/page_size) on the admission/routing paths."""
-        ps = self.page_size
-        return ent["tokens"][length - ps:] != tuple(
-            int(t) for t in toks[length - ps:length])
-
-    def match(self, prompt, start_block: int,
-              max_blocks: Optional[int] = None) -> list:
-        """Consecutive full-block continuations of a tree match held
-        here: walk the prompt's block boundaries from ``start_block``,
-        verify each candidate's tokens (hash collisions are misses)
-        and CRC (corruption is a counted fallback, the entry dropped),
-        PIN every hit, and return its keys in block order. The first
-        gap ends the run — a restore must extend the seated prefix
-        contiguously."""
-        toks = np.asarray(prompt).reshape(-1)
-        keys: list = []
-        if not self.entries:
-            return keys
-        for b, (length, h) in enumerate(prefix_hashes(toks,
-                                                      self.page_size)):
-            if b < start_block:
-                continue
-            if max_blocks is not None and len(keys) >= max_blocks:
-                break
-            key = (length, h)
-            ent = self.entries.get(key)
-            if ent is None:
-                if b == start_block:
-                    self.misses += 1
-                    self._count("Serve/host_tier_misses")
-                break
-            if self._tail_mismatch(ent, toks, length):
-                # rolling-hash collision: not this prefix — a miss
-                self.misses += 1
-                self._count("Serve/host_tier_misses")
-                break
-            if _crc(ent["tiles"]) != ent["crc"]:
-                # corrupt host copy: drop it and recompute the block —
-                # the tier degrades, serving never crashes
-                self.entries.pop(key)
-                self.bytes_used -= ent["nbytes"]
-                self.fallbacks += 1
-                self._count("Serve/host_tier_fallbacks")
-                self._publish()
-                break
-            ent["pinned"] = True
-            self.entries.move_to_end(key)
-            keys.append(key)
-        return keys
-
-    def peek_blocks(self, prompt, start_block: int) -> int:
-        """Read-only residency probe for the fleet router: how many
-        consecutive full blocks past ``start_block`` the tier holds. No
-        pins, no LRU touch, no CRC pass — routing must stay cheap."""
-        if not self.entries:
-            return 0
-        toks = np.asarray(prompt).reshape(-1)
-        n = 0
-        for b, (length, h) in enumerate(prefix_hashes(toks,
-                                                      self.page_size)):
-            if b < start_block:
-                continue
-            ent = self.entries.get((length, h))
-            if ent is None or self._tail_mismatch(ent, toks, length):
-                break
-            n += 1
-        return n
-
-    def consume(self, keys: list) -> tuple:
-        """Pop the pinned matches of one admission into a stacked
-        payload ``{k: (L, R, KV, ps, hd), ...}`` (R = len(keys), block
-        order) — the restore scatter's input. Returns ``(tiles, nbytes,
-        tokens)``."""
-        ents = [self.entries.pop(k) for k in keys]
-        nbytes = sum(e["nbytes"] for e in ents)
-        self.bytes_used -= nbytes
-        self.hits += len(ents)
-        self._count("Serve/host_tier_hits", len(ents))
-        tiles = {name: np.stack([e["tiles"][name] for e in ents], axis=1)
-                 for name in ents[0]["tiles"]}
-        self._publish()
-        return tiles, nbytes, len(ents) * self.page_size
-
-    def release(self, keys: list) -> None:
-        """Unpin matched entries without consuming them — the admission
-        deferred (transient pool pressure); the blocks stay restorable
-        for the retry."""
-        for k in keys:
-            ent = self.entries.get(k)
-            if ent is not None:
-                ent["pinned"] = False
-
-    def on_restore(self, wall_s: float, pages: int, tokens: int,
-                   nbytes: int) -> None:
-        """Achieved accounting for one dispatched restore (the engine's
-        measured dispatch window — honest on CPU, a lower bound where
-        the scatter overlaps the async device queue)."""
-        self.restores += 1
-        self.restored_pages += pages
-        self.restored_tokens += tokens
-        self.restore_bytes += nbytes
-        self.restore_wait_s += wall_s
-        self._count("Serve/host_tier_restores")
-        self._count("Serve/host_tier_restored_pages", pages)
-        self._count("Serve/host_tier_restored_tokens", tokens)
-        self._count("Serve/host_tier_restore_bytes", nbytes)
-        if self.registry is not None:
-            self.registry.histogram(
-                "Serve/host_tier_restore_wait_s").observe(wall_s)
-        self._publish()
-
-    # -------------------------------------------------------------- readout
-    def snapshot(self) -> dict:
-        """Flight-recorder provider + the ``host_tier`` section of
-        ``kv_residency()`` / the capacity report's achieved side."""
-        self._publish()
-        return {
-            "pages": len(self.entries),
-            "bytes": self.bytes_used,
-            "capacity_bytes": self.capacity_bytes,
-            "occupancy": self.bytes_used / self.capacity_bytes,
-            "pressure": self.pressure,
-            "page_size": self.page_size,
-            "demotes": self.demotes,
-            "demote_bytes": self.demote_bytes,
-            "demote_skips": self.demote_skips,
-            "restores": self.restores,
-            "restored_pages": self.restored_pages,
-            "restored_tokens": self.restored_tokens,
-            "restore_bytes": self.restore_bytes,
-            "restore_wait_s": self.restore_wait_s,
-            "restore_tokens_per_s": (
-                self.restored_tokens / self.restore_wait_s
-                if self.restore_wait_s > 0 else None),
-            "hits": self.hits,
-            "misses": self.misses,
-            "prunes": self.prunes,
-            "pruned_bytes": self.pruned_bytes,
-            "fallbacks": self.fallbacks,
-        }

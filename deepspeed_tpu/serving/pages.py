@@ -619,6 +619,50 @@ class PagePool:
                 self.on_evict(ghosts)
         return freed >= need
 
+    def demote_ahead_candidates(self, cutoff: float, limit: int,
+                                skip=None) -> list:
+        """Full-block tree entries whose last touch is at or before
+        ``cutoff`` and whose page has no slot users — the demote-ahead
+        lane's feed, shaped exactly like the ``on_demote`` payload
+        (``tokens`` / ``page`` / ``block``). Unlike eviction's
+        leaf-first passes, this walks EVERY block node of an idle chain
+        (an idle session's whole prefix stages in one batch, not one
+        block per pass — inner nodes are full blocks too; shared-prefix
+        nodes another session still touches stay above the cutoff).
+        Read-only: no drops, no stamp touches, no refcount changes —
+        the pages stay tree-held and a resuming session keeps them as a
+        normal tree hit (staging is a COPY, so a resume mid-stage
+        wastes at most that one copy; tree-held pages with no slot
+        users are immutable, so the copy can never go stale). Partial
+        tails stay recompute-only, same as eviction's demote filter.
+        ``skip(tokens)`` filters entries already staged (the tier's
+        ``holds``); oldest first, at most ``limit``. Requires the pool
+        clock (entries without a ``tstamp`` never qualify)."""
+        if self.tree is None or limit <= 0:
+            return []
+        cands: list = []
+
+        def walk(node):
+            for key, child in node.children.items():
+                if (child.tstamp is not None and child.tstamp <= cutoff
+                        and self.slot_refs[child.page] == 0
+                        and self.tree_refs[child.page]):
+                    cands.append((child.tstamp, node, key, child.page))
+                walk(child)
+
+        walk(self.tree.root)
+        cands.sort(key=lambda c: c[0])
+        out = []
+        for _ts, parent, key, page in cands:
+            if len(out) >= limit:
+                break
+            toks = self.tree.entry_tokens(parent, key)
+            if skip is not None and skip(toks):
+                continue
+            out.append({"tokens": toks, "page": int(page),
+                        "block": len(key)})
+        return out
+
     def try_admit(self, prompt: np.ndarray, max_new: int,
                   rid: int, book_savings: bool = True) \
             -> Optional[PageAllocation]:

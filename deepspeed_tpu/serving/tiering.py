@@ -1,0 +1,683 @@
+"""Unified KV tiering engine: one pin/copy/verify/evict discipline
+over ranked page stores (host DRAM above, NVMe below).
+
+PR 14's :class:`~.hostkv.HostKVTier` built the demote-on-evict /
+restore-on-resume loop for ONE rung (pinned host memory). This module
+factors that loop's store discipline into a reusable base so the next
+rung — disk, via the training side's AIO machinery — plugs in without a
+second private copy (ZeRO-Infinity's streaming playbook, PAPERS.md):
+
+- :class:`TierStore` — the ONE implementation of the store contract:
+  ``(prefix_len, token_hash)`` keying (the ghost-list spelling), exact
+  tail-token verification, CRC integrity with fallback-to-recompute,
+  LRU byte budget with pin-aware pruning, pinned match→consume/release
+  admission handshake, and the full ``Serve/<kind>_*`` metric family.
+  Subclasses supply only the payload transport (where tile bytes live).
+- :class:`~.hostkv.HostKVTier` — the DRAM rung: tiles stay in RAM on
+  the entry (a thin subclass; its public surface is unchanged).
+- :class:`NVMeKVTier` — the disk rung: tiles are serialized to one
+  swap file per block through :class:`~..ops.aio.AIOFileStore` (async
+  write-behind on put, synchronous verified read on match), so
+  resumable-session residency is bounded by disk, not DRAM.
+- :class:`TieringEngine` — the coordinator the :class:`~.pages.PagePool`
+  talks to when more than one rung is configured: puts land in the top
+  store and overflow SPILLS downward (host prune → NVMe put), matches
+  probe rungs in rank order per block (host hit beats disk hit), and
+  consumes stack mixed-rung blocks into one restore payload. It speaks
+  the exact ``pool.host`` protocol, so the pool/engine plumbing is
+  rung-count-agnostic.
+
+Degrade-never-crash is uniform: a pruned, collision-shadowed, torn,
+missing, or checksum-corrupt copy at ANY rung is simply not a match —
+the block stays in the chunk plan and is recomputed, with the failure
+counted in ``Serve/<kind>_fallbacks``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import zlib
+from collections import OrderedDict
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..observability.workload import prefix_hashes, token_hash
+
+__all__ = ["TierStore", "NVMeKVTier", "TieringEngine", "tiles_crc"]
+
+
+def tiles_crc(tiles: dict) -> int:
+    """Integrity checksum over a page's raw host bytes: a corrupt or
+    torn copy must degrade to recompute, never into the cache. Chained
+    crc32 in sorted tile-name order — identical to the crc32 of the
+    sorted-order byte concatenation, so the NVMe rung can verify its
+    single flat file read against the same value."""
+    h = 0
+    for key in sorted(tiles):
+        h = zlib.crc32(np.ascontiguousarray(tiles[key]).tobytes(), h)
+    return h
+
+
+class TierStore:
+    """One rung of the KV hierarchy: a bounded LRU store of full-block
+    page payloads keyed by ``(prefix_len, prefix_hash)``.
+
+    All bookkeeping — budgets, pins, CRC contract, metrics, the
+    match/consume/release admission handshake — lives here once.
+    Subclasses implement only payload transport:
+
+    - ``_attach(key, ent, tiles)`` — persist a page's tiles on ``ent``
+      (RAM reference, or an async file write).
+    - ``_verify(ent)`` — produce the tiles back, integrity-checked;
+      ``None`` means corrupt/torn/missing (the caller counts and drops).
+    - ``_unfetch(ent)`` — release any fetch-side staging when an
+      admission defers (entry stays resident).
+    - ``_discard(ent)`` — final payload cleanup when an entry leaves
+      the store (consume/prune/corrupt-drop), keeping any already
+      fetched tiles intact for the in-flight consumer.
+
+    ``kind`` prefixes the metric family: ``Serve/<kind>_*``.
+    """
+
+    kind = "tier"
+
+    def __init__(self, capacity_bytes: int, page_size: int,
+                 registry=None, clock: Optional[Callable] = None):
+        if capacity_bytes < 1:
+            raise ValueError(f"{self.kind} capacity_bytes must be >= 1, "
+                             f"got {capacity_bytes}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.page_size = int(page_size)
+        self.registry = registry
+        self.clock = clock if clock is not None else (lambda: 0.0)
+        self.entries: OrderedDict = OrderedDict()
+        self.bytes_used = 0
+        # the rung below (wired by TieringEngine): prune victims spill
+        # there instead of vanishing
+        self.spill_to: Optional["TierStore"] = None
+        # cumulative accounting (the capacity advisor's achieved side)
+        self.demotes = 0            # pages demoted into the tier
+        self.demote_bytes = 0
+        self.demote_skips = 0       # pages too large for the whole budget
+        self.restores = 0           # restore OPERATIONS (one per admission)
+        self.restored_pages = 0
+        self.restored_tokens = 0
+        self.restore_bytes = 0
+        self.restore_wait_s = 0.0   # summed dispatch wall of all restores
+        self.hits = 0               # blocks served from the tier
+        self.misses = 0             # continuation probes that found nothing
+        self.prunes = 0             # entries LRU-dropped for capacity
+        self.pruned_bytes = 0
+        self.spills = 0             # prune victims handed to the rung below
+        self.fallbacks = 0          # corrupt/mismatched copies -> recompute
+        self._publish()
+
+    # ---------------------------------------------------- payload transport
+    def _attach(self, key, ent: dict, tiles: dict) -> None:
+        raise NotImplementedError
+
+    def _verify(self, ent: dict):
+        raise NotImplementedError
+
+    def _unfetch(self, ent: dict) -> None:
+        pass
+
+    def _discard(self, ent: dict) -> None:
+        pass
+
+    # ------------------------------------------------------------- metrics
+    def _publish(self) -> None:
+        if self.registry is None:
+            return
+        self.registry.set_gauges({
+            f"Serve/{self.kind}_pages": float(len(self.entries)),
+            f"Serve/{self.kind}_bytes": float(self.bytes_used),
+            f"Serve/{self.kind}_capacity_bytes": float(self.capacity_bytes),
+            f"Serve/{self.kind}_occupancy": (
+                self.bytes_used / self.capacity_bytes),
+            f"Serve/{self.kind}_pressure": float(self.pressure),
+        })
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.registry is not None and n:
+            self.registry.counter(name).inc(n)
+
+    @property
+    def pressure(self) -> bool:
+        """True when the tier cannot fit another typical page without
+        pruning a cold one — the next demotion starts losing history."""
+        if not self.entries:
+            return False
+        mean = self.bytes_used / len(self.entries)
+        return self.capacity_bytes - self.bytes_used < mean
+
+    # ------------------------------------------------------------- demotion
+    def put(self, tokens, tiles: dict) -> bool:
+        """Store one demoted page: ``tokens`` is the full token prefix
+        the tree entry cached (its identity), ``tiles`` the page's raw
+        host arrays. Over-budget puts prune LRU (unpinned) entries; a
+        page larger than the whole budget is skipped, counted, never an
+        error. Returns whether the page was kept."""
+        toks = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+        nbytes = sum(int(v.nbytes) for v in tiles.values())
+        if nbytes > self.capacity_bytes:
+            self.demote_skips += 1
+            self._count(f"Serve/{self.kind}_demote_skips")
+            return False
+        key = (len(toks), token_hash(toks))
+        old = self.entries.get(key)
+        if old is not None:
+            if old["pinned"]:
+                # an in-flight admission pinned this key (match() →
+                # consume() within the same try_admit; the demotion
+                # running between them is that admission's own eviction
+                # pass) — replacing it would void the pin and let a
+                # later prune drop the entry mid-restore. Keep the
+                # pinned entry; skip the demotion.
+                self.demote_skips += 1
+                self._count(f"Serve/{self.kind}_demote_skips")
+                return False
+            self.entries.pop(key)
+            self.bytes_used -= old["nbytes"]
+            self._discard(old)
+        ent = {
+            "tokens": toks, "tiles": None, "nbytes": nbytes,
+            "crc": tiles_crc(tiles), "t": self.clock(), "pinned": False,
+        }
+        self._attach(key, ent, tiles)
+        self.entries[key] = ent
+        self.bytes_used += nbytes
+        self.demotes += 1
+        self.demote_bytes += nbytes
+        self._count(f"Serve/{self.kind}_demotes")
+        self._count(f"Serve/{self.kind}_demote_bytes", nbytes)
+        self._prune()
+        self._publish()
+        return True
+
+    def holds(self, tokens, key=None) -> bool:
+        """Exact membership probe (key + tail-token verification, no
+        payload touch): is this full prefix already resident here? The
+        demote-ahead lane uses it to skip re-staging and to turn a
+        later eviction of a staged page into a pure refcount drop.
+        Callers that already computed the ghost-list key pass it via
+        ``key`` to skip the token re-hash on the admission path."""
+        toks = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+        if key is None:
+            key = (len(toks), token_hash(toks))
+        ent = self.entries.get(key)
+        return ent is not None and ent["tokens"] == toks
+
+    def _prune(self) -> None:
+        """LRU-drop unpinned entries until the budget holds. Pinned
+        entries (matched, awaiting consume in this very admission) are
+        skipped — at most ``pages_per_slot`` of them exist at a time.
+        With a rung below wired (``spill_to``), each victim that still
+        verifies is handed DOWN instead of vanishing — host prune
+        becomes the NVMe rung's demotion feed."""
+        while self.bytes_used > self.capacity_bytes:
+            victim = None
+            for key, ent in self.entries.items():
+                if not ent["pinned"]:
+                    victim = key
+                    break
+            if victim is None:
+                return
+            ent = self.entries.pop(victim)
+            self.bytes_used -= ent["nbytes"]
+            self.prunes += 1
+            self.pruned_bytes += ent["nbytes"]
+            self._count(f"Serve/{self.kind}_prunes")
+            if self.spill_to is not None:
+                tiles = self._verify(ent)
+                if tiles is not None and self.spill_to.put(ent["tokens"],
+                                                           tiles):
+                    self.spills += 1
+                    self._count(f"Serve/{self.kind}_spills")
+            self._discard(ent)
+
+    # -------------------------------------------------------------- restore
+    def _tail_mismatch(self, ent: dict, toks, length: int) -> bool:
+        """Exact verification of the entry's OWN block (its last
+        ``page_size`` tokens) against the prompt. The earlier prefix is
+        covered by induction: blocks below ``start_block`` were matched
+        token-exact by the radix tree, each prior tier hit verified its
+        own block, and the ``(prefix_len, rolling_hash)`` key ties the
+        whole prefix (the same identity standard the ghost ledger uses
+        alone). A full-prefix tuple compare per block would be
+        O(P²/page_size) on the admission/routing paths."""
+        ps = self.page_size
+        return ent["tokens"][length - ps:] != tuple(
+            int(t) for t in toks[length - ps:length])
+
+    def match_one(self, key, toks, length: int) -> str:
+        """Probe ONE block key. ``"hit"`` pins the entry (payload
+        verified, tiles staged for consume); ``"absent"`` /
+        ``"collision"`` are misses; ``"corrupt"`` means the payload
+        failed verification — the entry is dropped and the fallback
+        counted, the caller recomputes the block."""
+        ent = self.entries.get(key)
+        if ent is None:
+            return "absent"
+        if self._tail_mismatch(ent, toks, length):
+            # rolling-hash collision: not this prefix — a miss
+            return "collision"
+        if self._verify(ent) is None:
+            # corrupt/torn/missing copy: drop it and recompute the
+            # block — the tier degrades, serving never crashes
+            self.entries.pop(key, None)
+            self.bytes_used -= ent["nbytes"]
+            self.fallbacks += 1
+            self._count(f"Serve/{self.kind}_fallbacks")
+            self._discard(ent)
+            self._publish()
+            return "corrupt"
+        ent["pinned"] = True
+        self.entries.move_to_end(key)
+        return "hit"
+
+    def peek_one(self, key, toks, length: int) -> bool:
+        """Read-only single-block residency probe (no pins, no LRU
+        touch, no payload verification — routing must stay cheap)."""
+        ent = self.entries.get(key)
+        return ent is not None and not self._tail_mismatch(ent, toks,
+                                                           length)
+
+    def match(self, prompt, start_block: int,
+              max_blocks: Optional[int] = None) -> list:
+        """Consecutive full-block continuations of a tree match held
+        here: walk the prompt's block boundaries from ``start_block``,
+        verify each candidate's tokens (hash collisions are misses)
+        and payload CRC (corruption is a counted fallback, the entry
+        dropped), PIN every hit, and return its keys in block order.
+        The first gap ends the run — a restore must extend the seated
+        prefix contiguously."""
+        toks = np.asarray(prompt).reshape(-1)
+        keys: list = []
+        if not self.entries:
+            return keys
+        for b, (length, h) in enumerate(prefix_hashes(toks,
+                                                      self.page_size)):
+            if b < start_block:
+                continue
+            if max_blocks is not None and len(keys) >= max_blocks:
+                break
+            r = self.match_one((length, h), toks, length)
+            if r == "hit":
+                keys.append((length, h))
+                continue
+            if r == "collision" or (r == "absent" and b == start_block):
+                self.misses += 1
+                self._count(f"Serve/{self.kind}_misses")
+            break
+        return keys
+
+    def peek_blocks(self, prompt, start_block: int) -> int:
+        """Read-only residency probe for the fleet router: how many
+        consecutive full blocks past ``start_block`` the tier holds. No
+        pins, no LRU touch, no CRC pass — routing must stay cheap."""
+        if not self.entries:
+            return 0
+        toks = np.asarray(prompt).reshape(-1)
+        n = 0
+        for b, (length, h) in enumerate(prefix_hashes(toks,
+                                                      self.page_size)):
+            if b < start_block:
+                continue
+            if not self.peek_one((length, h), toks, length):
+                break
+            n += 1
+        return n
+
+    def _pop(self, key) -> dict:
+        """Pop one pinned match for consumption: the entry leaves the
+        store (its payload storage reclaimed) but its verified tiles —
+        staged by ``match_one`` — ride out on the returned entry."""
+        ent = self.entries.pop(key)
+        self.bytes_used -= ent["nbytes"]
+        self.hits += 1
+        self._count(f"Serve/{self.kind}_hits")
+        self._discard(ent)
+        return ent
+
+    def consume(self, keys: list) -> tuple:
+        """Pop the pinned matches of one admission into a stacked
+        payload ``{k: (L, R, KV, ps, hd), ...}`` (R = len(keys), block
+        order) — the restore scatter's input. Returns ``(tiles, nbytes,
+        tokens)``."""
+        ents = [self._pop(k) for k in keys]
+        nbytes = sum(e["nbytes"] for e in ents)
+        tiles = {name: np.stack([e["tiles"][name] for e in ents], axis=1)
+                 for name in ents[0]["tiles"]}
+        self._publish()
+        return tiles, nbytes, len(ents) * self.page_size
+
+    def release(self, keys: list) -> None:
+        """Unpin matched entries without consuming them — the admission
+        deferred (transient pool pressure); the blocks stay restorable
+        for the retry."""
+        for k in keys:
+            ent = self.entries.get(k)
+            if ent is not None:
+                ent["pinned"] = False
+                self._unfetch(ent)
+
+    def on_restore(self, wall_s: float, pages: int, tokens: int,
+                   nbytes: int) -> None:
+        """Achieved accounting for one dispatched restore (the engine's
+        measured dispatch window — honest on CPU, a lower bound where
+        the scatter overlaps the async device queue)."""
+        self.restores += 1
+        self.restored_pages += pages
+        self.restored_tokens += tokens
+        self.restore_bytes += nbytes
+        self.restore_wait_s += wall_s
+        self._count(f"Serve/{self.kind}_restores")
+        self._count(f"Serve/{self.kind}_restored_pages", pages)
+        self._count(f"Serve/{self.kind}_restored_tokens", tokens)
+        self._count(f"Serve/{self.kind}_restore_bytes", nbytes)
+        if self.registry is not None:
+            self.registry.histogram(
+                f"Serve/{self.kind}_restore_wait_s").observe(wall_s)
+        self._publish()
+
+    # -------------------------------------------------------------- readout
+    def _snapshot_extra(self) -> dict:
+        return {}
+
+    def snapshot(self) -> dict:
+        """Flight-recorder provider + this rung's section of
+        ``kv_residency()`` / the capacity report's achieved side."""
+        self._publish()
+        out = {
+            "pages": len(self.entries),
+            "bytes": self.bytes_used,
+            "capacity_bytes": self.capacity_bytes,
+            "occupancy": self.bytes_used / self.capacity_bytes,
+            "pressure": self.pressure,
+            "page_size": self.page_size,
+            "demotes": self.demotes,
+            "demote_bytes": self.demote_bytes,
+            "demote_skips": self.demote_skips,
+            "restores": self.restores,
+            "restored_pages": self.restored_pages,
+            "restored_tokens": self.restored_tokens,
+            "restore_bytes": self.restore_bytes,
+            "restore_wait_s": self.restore_wait_s,
+            "restore_tokens_per_s": (
+                self.restored_tokens / self.restore_wait_s
+                if self.restore_wait_s > 0 else None),
+            "hits": self.hits,
+            "misses": self.misses,
+            "prunes": self.prunes,
+            "pruned_bytes": self.pruned_bytes,
+            "spills": self.spills,
+            "fallbacks": self.fallbacks,
+        }
+        out.update(self._snapshot_extra())
+        return out
+
+
+class NVMeKVTier(TierStore):
+    """The disk rung: demoted pages persist as one swap file per block
+    under an :class:`~..ops.aio.AIOFileStore` (the same seam the
+    optimizer-state offload swaps through).
+
+    - **put** serializes the page's tiles into one flat buffer (sorted
+      tile-name order, so the whole-file crc32 equals the shared
+      :func:`tiles_crc`) and submits an ASYNC write — write-behind
+      depth ``write_behind`` bounds in-flight buffers, so demotion
+      spills stream to disk without blocking the serving iteration.
+      Dtype/shape specs stay in RAM (bytes on disk, layout in the
+      index) — a few hundred bytes per resident block.
+    - **match** performs the verified read: wait the entry's own
+      pending write (if any), read the file into a zeroed staging
+      buffer (a torn/short file therefore deterministically fails the
+      CRC), verify, and slice the tiles back as views. Any I/O error or
+      checksum mismatch is a counted fallback — recompute, never crash.
+    - Each tier instance owns a UNIQUE subdirectory (two replicas
+      sharing one NVMe mount never collide), created under
+      ``serving.nvme_path`` (default ``$TMPDIR/dstpu_kv_nvme``).
+    """
+
+    kind = "nvme_tier"
+
+    def __init__(self, capacity_bytes: int, page_size: int,
+                 path: Optional[str] = None, registry=None,
+                 clock: Optional[Callable] = None, n_threads: int = 2,
+                 write_behind: int = 1, use_direct: bool = False):
+        from ..ops import aio as aio_mod
+        root = path or os.path.join(tempfile.gettempdir(), "dstpu_kv_nvme")
+        os.makedirs(root, exist_ok=True)
+        self.dir = tempfile.mkdtemp(prefix="kv_", dir=root)
+        self.store = aio_mod.AIOFileStore(self.dir, n_threads=n_threads,
+                                          use_direct=use_direct)
+        self.write_behind = max(0, int(write_behind))
+        self._pending: List[dict] = []   # entries with in-flight writes
+        self.promotions = 0              # blocks read back (disk -> host)
+        self.read_bytes = 0
+        self.read_wait_s = 0.0
+        self.write_bytes = 0
+        super().__init__(capacity_bytes, page_size, registry=registry,
+                         clock=clock)
+
+    # ---------------------------------------------------- payload transport
+    @staticmethod
+    def _file(key) -> str:
+        return f"b{key[0]}_{key[1]:016x}.bin"
+
+    def _attach(self, key, ent: dict, tiles: dict) -> None:
+        specs, arrs, off = [], [], 0
+        for name in sorted(tiles):
+            a = np.ascontiguousarray(tiles[name])
+            specs.append((name, a.dtype, a.shape, off, int(a.nbytes)))
+            arrs.append(a)
+            off += int(a.nbytes)
+        buf = np.empty(off, np.uint8)
+        for (name, dt, shp, o, nb), a in zip(specs, arrs):
+            buf[o:o + nb] = a.view(np.uint8).reshape(-1)
+        ent["file"] = self._file(key)
+        ent["specs"] = specs
+        ent["buf"] = buf   # MUST outlive the async write (native aio
+        #                    holds the raw pointer until waited)
+        try:
+            ent["ticket"] = self.store.submit_write(ent["file"], buf)
+        except OSError:
+            # submit failure (store counted it): leave no ticket — the
+            # read path will miss the file and degrade to recompute
+            ent.pop("buf", None)
+            return
+        self.write_bytes += int(buf.nbytes)
+        self._count(f"Serve/{self.kind}_write_bytes", int(buf.nbytes))
+        self._pending.append(ent)
+        self._settle(self.write_behind)
+
+    def _settle_ent(self, ent: dict) -> None:
+        t = ent.pop("ticket", None)
+        if t is not None:
+            try:
+                self.store.wait(t)
+            except OSError:
+                pass   # counted by the store; the read path verifies
+        ent.pop("buf", None)
+
+    def _settle(self, keep: int) -> None:
+        while len(self._pending) > keep:
+            self._settle_ent(self._pending.pop(0))
+
+    def flush(self) -> None:
+        """Wait out every in-flight write (tests / shutdown)."""
+        self._settle(0)
+
+    def _verify(self, ent: dict):
+        tiles = ent.get("tiles")
+        if tiles is not None:
+            return tiles
+        if "ticket" in ent or "buf" in ent:
+            self._pending = [p for p in self._pending if p is not ent]
+            self._settle_ent(ent)
+        if "file" not in ent:
+            return None
+        # zeroed staging: a torn/short file leaves trailing zeros and
+        # deterministically fails the CRC below
+        buf = np.zeros(ent["nbytes"], np.uint8)
+        t0 = self.clock()
+        try:
+            self.store.sync_read(ent["file"], buf)
+        except OSError:
+            return None
+        wall = max(0.0, self.clock() - t0)
+        if zlib.crc32(buf) != ent["crc"]:
+            return None
+        tiles = {name: buf[off:off + nb].view(dt).reshape(shp)
+                 for name, dt, shp, off, nb in ent["specs"]}
+        ent["tiles"] = tiles
+        self.promotions += 1
+        self.read_bytes += int(ent["nbytes"])
+        self.read_wait_s += wall
+        self._count(f"Serve/{self.kind}_promotions")
+        self._count(f"Serve/{self.kind}_read_bytes", int(ent["nbytes"]))
+        return tiles
+
+    def _unfetch(self, ent: dict) -> None:
+        ent["tiles"] = None   # drop the staged read; the file remains
+
+    def _discard(self, ent: dict) -> None:
+        self._pending = [p for p in self._pending if p is not ent]
+        self._settle_ent(ent)
+        f = ent.pop("file", None)
+        if f is not None:
+            self.store.unlink(f)
+
+    # ------------------------------------------------------------- metrics
+    def _publish(self) -> None:
+        super()._publish()
+        if self.registry is not None:
+            self.registry.set_gauges({
+                "Serve/nvme_aio_errors": float(self.store.errors),
+            })
+
+    def _snapshot_extra(self) -> dict:
+        return {
+            "promotions": self.promotions,
+            "read_bytes": self.read_bytes,
+            "read_wait_s": self.read_wait_s,
+            "read_mb_s": (self.read_bytes / self.read_wait_s / 1e6
+                          if self.read_wait_s > 0 and self.read_bytes
+                          else None),
+            "write_bytes": self.write_bytes,
+            "pending_writes": len(self._pending),
+            "aio_errors": self.store.errors,
+            "native_aio": bool(self.store.aio._lib is not None),
+        }
+
+    def close(self) -> None:
+        self.flush()
+        self.store.close()
+
+
+class TieringEngine:
+    """Ranked-store coordinator speaking the exact ``pool.host``
+    protocol (put / match / peek_blocks / consume / release /
+    on_restore / holds / snapshot), so :class:`~.pages.PagePool` and
+    :class:`~.engine.ServingEngine` stay rung-count-agnostic.
+
+    - Demotions **put** into the top rung; its pin-aware LRU prune
+      spills victims downward (``spill_to`` chain wired here) — cold
+      history cascades HBM → host → NVMe instead of vanishing.
+    - **match** walks the prompt's block boundaries once and probes
+      rungs in rank order per block (a host hit beats a disk hit; a
+      corrupt copy at one rung still lets a lower rung serve the same
+      block). Hits are pinned where they live; keys are ``(rank,
+      store_key)`` so consume/release dispatch without a search.
+    - **consume** stacks mixed-rung blocks into ONE restore payload —
+      an NVMe block's verified read happened at match time, so the
+      restore scatter is the same single program regardless of where
+      each block slept.
+    """
+
+    def __init__(self, stores: List[TierStore]):
+        if not stores:
+            raise ValueError("TieringEngine needs at least one store")
+        self.stores = list(stores)
+        for up, down in zip(self.stores, self.stores[1:]):
+            up.spill_to = down
+        self.page_size = self.stores[0].page_size
+
+    @property
+    def pressure(self) -> bool:
+        return self.stores[0].pressure
+
+    def put(self, tokens, tiles: dict) -> bool:
+        return self.stores[0].put(tokens, tiles)
+
+    def holds(self, tokens, key=None) -> bool:
+        return any(st.holds(tokens, key=key) for st in self.stores)
+
+    def match(self, prompt, start_block: int,
+              max_blocks: Optional[int] = None) -> list:
+        toks = np.asarray(prompt).reshape(-1)
+        keys: list = []
+        if not any(st.entries for st in self.stores):
+            return keys
+        for b, (length, h) in enumerate(prefix_hashes(toks,
+                                                      self.page_size)):
+            if b < start_block:
+                continue
+            if max_blocks is not None and len(keys) >= max_blocks:
+                break
+            hit_rank = None
+            for rank, st in enumerate(self.stores):
+                if st.match_one((length, h), toks, length) == "hit":
+                    hit_rank = rank
+                    break
+            if hit_rank is None:
+                if b == start_block:
+                    top = self.stores[0]
+                    top.misses += 1
+                    top._count(f"Serve/{top.kind}_misses")
+                break
+            keys.append((hit_rank, (length, h)))
+        return keys
+
+    def peek_blocks(self, prompt, start_block: int) -> int:
+        if not any(st.entries for st in self.stores):
+            return 0
+        toks = np.asarray(prompt).reshape(-1)
+        n = 0
+        for b, (length, h) in enumerate(prefix_hashes(toks,
+                                                      self.page_size)):
+            if b < start_block:
+                continue
+            if not any(st.peek_one((length, h), toks, length)
+                       for st in self.stores):
+                break
+            n += 1
+        return n
+
+    def consume(self, keys: list) -> tuple:
+        ents = [self.stores[rank]._pop(key) for rank, key in keys]
+        nbytes = sum(e["nbytes"] for e in ents)
+        tiles = {name: np.stack([e["tiles"][name] for e in ents], axis=1)
+                 for name in ents[0]["tiles"]}
+        for st in self.stores:
+            st._publish()
+        return tiles, nbytes, len(ents) * self.page_size
+
+    def release(self, keys: list) -> None:
+        for rank, key in keys:
+            self.stores[rank].release([key])
+
+    def on_restore(self, wall_s: float, pages: int, tokens: int,
+                   nbytes: int) -> None:
+        self.stores[0].on_restore(wall_s, pages, tokens, nbytes)
+
+    def snapshot(self) -> dict:
+        """Top rung's snapshot with each lower rung nested under its
+        ``kind`` — the shape ``kv_residency()``/health() attach."""
+        out = self.stores[0].snapshot()
+        for st in self.stores[1:]:
+            out[st.kind] = st.snapshot()
+        return out
