@@ -69,6 +69,14 @@ sleep 20
 # CAPACITY_REPORT.json with the scaling lever + achieved block.
 python bench_loadscope.py || { echo "[bench_all] loadscope failed"; fails=$((fails+1)); }
 sleep 20
+# Elastic autoscaler chaos bench: fake-clock scale-up (warm join),
+# drain-before-remove (zero loss, bit parity), mid-traffic kill with
+# the incident latch, flap-bait self-freeze, SLO-green gauges through
+# every scale event, doctor [autoscale] gates, and a capture->replay
+# round-trip of the autoscaled run — into AUTOSCALE_BENCH.json
+# (perf_ledger tracks scale-event latency and stranded work).
+python bench_autoscale.py || { echo "[bench_all] autoscale failed"; fails=$((fails+1)); }
+sleep 20
 # NVMe aio tier microbench: threads x block x O_DIRECT sweep feeding
 # the serving NVMe KV rung and optimizer-offload sizing (read/write
 # MB/s rates are up-is-good; perf_ledger direction-infers *_mb_s).

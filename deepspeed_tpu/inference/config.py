@@ -178,6 +178,14 @@ class ServingConfig:
     # cadence, never per token. None (default) builds nothing: one
     # `is not None` per submit.
     loadscope: "object | None" = None
+    # Elastic fleet autoscaler (serving.autoscaler.AutoscaleConfig |
+    # dict): the actuation loop over the loadscope scaling report —
+    # hysteresis-guarded add/drain-then-remove/rebalance with a flap
+    # budget, incident cooldown latch, drain-before-remove, and a typed
+    # decision audit ring (GET/POST /autoscale). Fleet-level: a solo
+    # ServingEngine ignores it. None (default) builds nothing — the
+    # fleet pays one `is not None` per step, zero threads/programs.
+    autoscale: "object | None" = None
     # Live telemetry & control plane
     # (observability.server.TelemetryConfig | dict): an HTTP ops surface
     # (/metrics /healthz /readyz /requests /capacity /goodput /flight +
@@ -280,6 +288,10 @@ class ServingConfig:
             from ..observability.server import TelemetryConfig
 
             self.telemetry = TelemetryConfig.from_any(self.telemetry)
+        if self.autoscale is not None:
+            from ..serving.autoscaler import AutoscaleConfig
+
+            self.autoscale = AutoscaleConfig.from_any(self.autoscale)
 
     @classmethod
     def from_any(cls, cfg: "ServingConfig | dict | None") -> "ServingConfig":

@@ -739,6 +739,70 @@ def report_load(d: Path, rho_max: float = 0.9) -> list:
     return findings
 
 
+def report_autoscale(d: Path, frozen_max: float = 900.0) -> list:
+    """Print the ``[autoscale]`` picture — the elastic autoscaler's
+    control-loop state (``serving/autoscaler.py``) from the newest
+    ``Fleet/autoscale_*`` gauges. Gate findings: FLAP BUDGET EXHAUSTED
+    (the loop hit its reversal budget and froze itself — traffic is
+    oscillating around a threshold; widen the hysteresis or cooldowns,
+    docs/OPERATIONS.md "running the autoscaler") and FROZEN STALE (the
+    loop has been frozen longer than ``frozen_max`` seconds — a deploy
+    freeze somebody forgot to lift, or a flap freeze nobody triaged)."""
+    from .sinks import parse_prometheus_textfile
+
+    prom = _newest(d, "*.prom")
+    if prom is None:
+        return []
+    vals = parse_prometheus_textfile(prom.read_text())
+    auto = {k: v for k, v in vals.items()
+            if k.startswith("dstpu_fleet_autoscale_")}
+    if not auto:
+        return []          # no autoscaler ran: no section, no gate
+    print(f"[autoscale] {prom.name}")
+    for key, label in (
+            ("dstpu_fleet_autoscale_evals", "evaluations"),
+            ("dstpu_fleet_autoscale_adds", "adds"),
+            ("dstpu_fleet_autoscale_removes", "removes"),
+            ("dstpu_fleet_autoscale_rebalances", "rebalances"),
+            ("dstpu_fleet_autoscale_drains", "drains_started"),
+            ("dstpu_fleet_autoscale_drain_aborts", "drain_aborts"),
+            ("dstpu_fleet_autoscale_alarms", "alarms"),
+            ("dstpu_fleet_autoscale_suppressed", "suppressed"),
+            ("dstpu_fleet_autoscale_flaps", "flaps"),
+            ("dstpu_fleet_autoscale_flap_budget_remaining",
+             "flap_budget_remaining"),
+            ("dstpu_fleet_autoscale_frozen", "frozen"),
+            ("dstpu_fleet_autoscale_frozen_stale_s", "frozen_stale_s"),
+            ("dstpu_fleet_autoscale_incident_latched",
+             "incident_latched"),
+            ("dstpu_fleet_autoscale_draining", "drain_in_flight")):
+        if key in auto:
+            print(f"  {label:<24s} {_fmt(auto[key])}")
+    findings: list = []
+    remaining = auto.get("dstpu_fleet_autoscale_flap_budget_remaining")
+    frozen = auto.get("dstpu_fleet_autoscale_frozen")
+    stale = auto.get("dstpu_fleet_autoscale_frozen_stale_s")
+    if isinstance(remaining, float) and remaining <= 0 \
+            and isinstance(frozen, float) and frozen >= 1:
+        print("  FLAP BUDGET EXHAUSTED: the loop froze itself after "
+              "too many scale reversals")
+        findings.append(
+            f"autoscaler flap budget exhausted in {prom.name}: the "
+            "control loop froze itself — traffic oscillates around a "
+            "threshold; widen hysteresis/cooldowns and unfreeze via "
+            "POST /autoscale (docs/OPERATIONS.md)")
+    elif isinstance(frozen, float) and frozen >= 1 \
+            and isinstance(stale, float) and stale > frozen_max:
+        print(f"  FROZEN STALE: frozen {_fmt(stale)}s "
+              f"> {frozen_max:g}s")
+        findings.append(
+            f"autoscaler frozen-stale in {prom.name}: frozen for "
+            f"{_fmt(stale)}s (> {frozen_max:g}s) — a forgotten deploy "
+            "freeze or untriaged flap freeze; the fleet is not "
+            "elastic while frozen")
+    return findings
+
+
 # ----------------------------------------------------------- live (--url)
 def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
     """(status, body) for a GET; (None, error-repr) when the target is
@@ -939,6 +1003,10 @@ def main(argv=None) -> int:
                     help="[load] gate: utilization rho at/above this "
                          "with queue pressure and a finite TTV trips "
                          "(default 0.9)")
+    ap.add_argument("--autoscale-frozen-max", type=float, default=900.0,
+                    help="[autoscale] gate: a control loop frozen "
+                         "longer than this (seconds) trips "
+                         "(default 900)")
     args = ap.parse_args(argv)
     if args.targets:
         findings = report_fleet(
@@ -962,6 +1030,8 @@ def main(argv=None) -> int:
         findings += report_comm(d)
         findings += report_kv(d, regret_max=args.kv_regret_max)
         findings += report_load(d, rho_max=args.load_rho_max)
+        findings += report_autoscale(
+            d, frozen_max=args.autoscale_frozen_max)
         findings += report_replay([d] if fdir == d else [d, fdir])
         ledger = Path(args.ledger) if args.ledger \
             else d / "PERF_LEDGER.json"

@@ -164,9 +164,14 @@ class TrafficTrace:
         self.events.append(ev)
         return ev
 
-    def add_chaos(self, event: str, t_rel: float, replica: str = "") -> dict:
+    def add_chaos(self, event: str, t_rel: float, replica: str = "",
+                  role: str = "") -> dict:
         ev = {"kind": _KIND_CHAOS, "t_rel": float(t_rel),
               "event": str(event), "replica": str(replica)}
+        if role:
+            # disaggregated joins record the phase so an autoscaled run
+            # replays its add_replica edges into the right role
+            ev["role"] = str(role)
         self.events.append(ev)
         return ev
 
@@ -391,11 +396,17 @@ class TrafficCapture:
                       "tokens": [int(t) for t in req.tokens],
                       "attempts": int(getattr(req, "attempts", 0))})
 
-    def on_chaos(self, event: str, replica: str = "") -> None:
+    def on_chaos(self, event: str, replica: str = "",
+                 role: str = "") -> None:
         """One fleet chaos event (replica kill/join, drain edge) — the
-        chaos script replay co-replays at the recorded position."""
-        self._append({"kind": _KIND_CHAOS,
-                      "event": str(event), "replica": str(replica)})
+        chaos script replay co-replays at the recorded position.
+        ``role`` (joins on a disaggregated fleet) rides along so replay
+        re-adds the replica into the right phase."""
+        ev = {"kind": _KIND_CHAOS,
+              "event": str(event), "replica": str(replica)}
+        if role:
+            ev["role"] = str(role)
+        self._append(ev)
 
     # -------------------------------------------------------------- readout
     @property
@@ -574,11 +585,27 @@ class ReplayDriver:
             elif event == "add_replica":
                 if not self._fleet:
                     raise LookupError("add_replica needs a fleet engine")
-                self.engine.add_replica(name or None)
+                # recorded role (disaggregated autoscaled joins) rides
+                # along; a role the target fleet rejects is a topology
+                # mismatch → counted-skip below
+                self.engine.add_replica(name or None,
+                                        role=ev.get("role") or None)
             elif event == "begin_drain":
-                self.engine.begin_drain()
+                if name:
+                    # replica-scoped drain edge (autoscaler-recorded):
+                    # unknown name / non-fleet → counted-skip
+                    if not self._fleet:
+                        raise LookupError("replica drain needs a fleet")
+                    self.engine.begin_drain_replica(name)
+                else:
+                    self.engine.begin_drain()
             elif event == "end_drain":
-                self.engine.end_drain()
+                if name:
+                    if not self._fleet:
+                        raise LookupError("replica drain needs a fleet")
+                    self.engine.end_drain_replica(name)
+                else:
+                    self.engine.end_drain()
             else:
                 raise LookupError(f"unknown chaos event {event!r}")
         except (LookupError, RuntimeError, KeyError, ValueError) as e:

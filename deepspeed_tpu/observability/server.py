@@ -121,6 +121,11 @@ class TelemetryHooks:
     # report JSON — unmeasured inputs arrive as nulls with reasons, the
     # endpoint stays 200 (degraded-null contract); absent hook → 404
     scaling_fn: Optional[Callable[[], dict]] = None
+    # autoscaler control loop (serving/autoscaler.py): GET status +
+    # decision audit tail; POST freeze/pin override (token-gated like
+    # every control POST; ValueError → 400)
+    autoscale_fn: Optional[Callable[[], dict]] = None
+    autoscale_control_fn: Optional[Callable[[dict], dict]] = None
 
 
 def flight_summary(flight) -> dict:
@@ -321,6 +326,12 @@ def _make_handler(server: TelemetryServer):
                                               "(set serving.loadscope)"})
                 else:
                     self._json(200, h.scaling_fn())
+            elif path == "/autoscale":
+                if h.autoscale_fn is None:
+                    self._json(404, {"error": "no autoscaler "
+                                              "(set serving.autoscale)"})
+                else:
+                    self._json(200, h.autoscale_fn())
             elif path == "/trace":
                 if h.trace_fn is None:
                     self._json(404, {"error": "no trace hook"})
@@ -348,10 +359,13 @@ def _make_handler(server: TelemetryServer):
                        "/goodput": h.goodput_fn is not None,
                        "/flight": h.flight_fn is not None,
                        "/scaling": h.scaling_fn is not None,
+                       "/autoscale": h.autoscale_fn is not None,
                        "/trace": h.trace_fn is not None,
                        "POST /drain": h.drain_fn is not None,
                        "POST /flight/dump": h.dump_fn is not None,
-                       "POST /slo/reload": h.slo_reload_fn is not None}
+                       "POST /slo/reload": h.slo_reload_fn is not None,
+                       "POST /autoscale":
+                           h.autoscale_control_fn is not None}
                 self._json(200, {"endpoints": {k: v for k, v in eps.items()
                                                if v}})
             else:
@@ -372,7 +386,8 @@ def _make_handler(server: TelemetryServer):
         def _post(self):
             h = server.hooks
             path = urlparse(self.path).path.rstrip("/")
-            if path not in ("/drain", "/flight/dump", "/slo/reload"):
+            if path not in ("/drain", "/flight/dump", "/slo/reload",
+                            "/autoscale"):
                 self._json(404, {"error": f"unknown endpoint {path!r}"})
                 return
             if not self._authorized():
@@ -410,6 +425,15 @@ def _make_handler(server: TelemetryServer):
                     return
                 try:
                     self._json(200, h.slo_reload_fn(body))
+                except (ValueError, TypeError) as e:
+                    self._json(400, {"error": str(e)})
+            elif path == "/autoscale":
+                if h.autoscale_control_fn is None:
+                    self._json(404, {"error": "no autoscaler "
+                                              "(set serving.autoscale)"})
+                    return
+                try:
+                    self._json(200, h.autoscale_control_fn(body))
                 except (ValueError, TypeError) as e:
                     self._json(400, {"error": str(e)})
 

@@ -11,6 +11,7 @@ bit-identical to single-request ``generate()`` with the same request seed
 
 from ..resilience.guards import PagePoolExhausted, QueueFullError, \
     RequestStatus
+from .autoscaler import AutoscaleConfig, AutoscaleDecision, Autoscaler
 from .engine import ServingEngine
 from .fleet import FleetEngine
 from .hostkv import HostKVTier
@@ -23,4 +24,5 @@ __all__ = ["ServingEngine", "FleetEngine", "Scheduler", "Request",
            "ChunkPlan", "plan_chunks", "init_slots", "insert_request",
            "PagePool", "RadixPrefixTree", "init_paged_slots",
            "export_slot", "import_slot", "HostKVTier",
+           "Autoscaler", "AutoscaleConfig", "AutoscaleDecision",
            "RequestStatus", "QueueFullError", "PagePoolExhausted"]

@@ -276,6 +276,16 @@ class FleetEngine:
         cc = FleetChaosConfig.from_any(chaos)
         if cc is not None and cc.enabled:
             self.chaos = FleetChaosMonkey(cc)
+        # ---- elastic autoscaler (serving/autoscaler.py): the actuation
+        # loop over scaling_report(). Off (the default) builds nothing —
+        # step() pays one `is not None`, zero threads/programs/syncs
+        # (the bench_autoscale --smoke compile freeze is the oracle).
+        self.autoscaler = None
+        acfg = cfg0.autoscale
+        if acfg is not None and getattr(acfg, "enabled", True):
+            from .autoscaler import Autoscaler
+
+            self.autoscaler = Autoscaler(self, acfg)
         self._iterations = 0
 
     # ------------------------------------------------------------ replicas
@@ -352,7 +362,9 @@ class FleetEngine:
         self._build_replica(name, role)
         self.registry.counter("Fleet/replica_joins").inc()
         if self.capture is not None:
-            self.capture.on_chaos("add_replica", name)
+            # role recorded so a disaggregated autoscaled run replays
+            # its joins into the right phase
+            self.capture.on_chaos("add_replica", name, role=role)
         return name
 
     def remove_replica(self, name: str) -> list:
@@ -374,6 +386,10 @@ class FleetEngine:
         without counting: dashboards never show a phantom incident."""
         out = self._remove(name)
         self.registry.counter("Fleet/replica_kills").inc()
+        if self.autoscaler is not None:
+            # latch scale-down: the failover's requeue burst and arrival
+            # dip must never be read as a remove signal
+            self.autoscaler.on_incident("kill_replica", name)
         if self.capture is not None:
             # the chaos script half of the trace: replay re-kills this
             # replica at the same position in the stream
@@ -420,6 +436,17 @@ class FleetEngine:
                           lost_replica=name)
             requeued.append(req.rid)
         requeued.reverse()
+        # pending handoffs the victim EXPORTED are host-held payloads —
+        # they survive its removal — but their owner map still points at
+        # it. Clear the ghost entries and re-pump NOW, before the
+        # scheduler is gone, so they land on survivors this call instead
+        # of waiting (possibly forever, if the fleet idles) for the next
+        # step's pump.
+        if self._handoffs:
+            for req, _payload in self._handoffs:
+                if self._owner.get(req.rid) == name:
+                    self._owner.pop(req.rid, None)
+            self._pump_handoffs()
         eng.close()
         return requeued
 
@@ -755,6 +782,11 @@ class FleetEngine:
                 eng.pop_result(req.rid)
                 self._adopt_result(req, name)
                 out.append(req)
+        if self.autoscaler is not None:
+            # after the replica loop (safe to mutate the replicas dict)
+            # and before the inline-retire drain, so anything a removal
+            # sheds rides THIS step's return
+            self.autoscaler.on_step()
         if self._retired_inline:
             # retirements the fleet layer itself produced (handoff
             # timeouts, requeue sheds) ride the same return channel
@@ -828,8 +860,18 @@ class FleetEngine:
         pending request (handoffs pile up exactly when this loop runs
         hottest)."""
         remaining = []
-        ranked = [i["name"]
-                  for i in self._ranked(ROLE_DECODE, admission=False)]
+
+        def _targets() -> list:
+            # prefer replicas still accepting intake: an import onto a
+            # DRAINING decode replica gives it new work exactly when a
+            # scale-down is waiting for it to idle. Fall back to the
+            # draining pool only when EVERY decode replica drains
+            # (fleet-wide drain: handoffs are backlog and must finish).
+            infos = self._ranked(ROLE_DECODE, admission=False)
+            open_ = [i["name"] for i in infos if not i["draining"]]
+            return open_ if open_ else [i["name"] for i in infos]
+
+        ranked = _targets()
         for req, payload in self._handoffs:
             now = self._clock()
             if req.deadline_total is not None and now >= req.deadline_total:
@@ -875,8 +917,7 @@ class FleetEngine:
                                             rid=req.rid, replica=name,
                                             **att)
                     placed = True
-                    ranked = [i["name"] for i in
-                              self._ranked(ROLE_DECODE, admission=False)]
+                    ranked = _targets()
                     break
             if not placed:
                 remaining.append((req, payload))
@@ -936,6 +977,33 @@ class FleetEngine:
             eng.end_drain()
         if self.capture is not None:
             self.capture.on_chaos("end_drain")
+
+    def begin_drain_replica(self, name: str) -> None:
+        """Drain ONE replica (the scale-down prelude): its intake
+        closes — the router stops admitting to it, handoffs route to
+        its siblings — while its queued/running backlog finishes.
+        Recorded as a replica-scoped chaos event so an autoscaled run
+        replays its drain edges deterministically."""
+        if name not in self.replicas:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(have {list(self.replicas)})")
+        self.replicas[name].begin_drain()
+        self.registry.counter("Fleet/replica_drains").inc()
+        if self.capture is not None:
+            self.capture.on_chaos("begin_drain", name)
+
+    def end_drain_replica(self, name: str) -> None:
+        """Reopen one replica's intake (drain aborted: load reversed,
+        or an operator changed their mind). No-op on a fleet-wide
+        drain — that outranks per-replica state."""
+        if name not in self.replicas:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(have {list(self.replicas)})")
+        if self._draining:
+            return
+        self.replicas[name].end_drain()
+        if self.capture is not None:
+            self.capture.on_chaos("end_drain", name)
 
     @property
     def draining(self) -> bool:
@@ -1502,6 +1570,11 @@ class FleetEngine:
             (fd / "traffic_trace.jsonl").write_text(
                 self.capture.tail_text(), encoding="utf-8")
 
+        def _w_autoscale():
+            fd.mkdir(exist_ok=True)
+            (fd / "autoscale_audit.jsonl").write_text(
+                self.autoscaler.audit_jsonl(), encoding="utf-8")
+
         _w("incident.json", _w_manifest)
         if self.spans is not None:
             _w("events.jsonl", _w_fleet_events)
@@ -1511,6 +1584,10 @@ class FleetEngine:
             # the capture ring's tail: the incident is replayable
             # standing alone (docs/OPERATIONS.md incident-replay runbook)
             _w("traffic_trace.jsonl", _w_capture)
+        if self.autoscaler is not None:
+            # the decision ring: WHY the fleet was the size it was when
+            # the incident hit (docs/OPERATIONS.md autoscaler runbook)
+            _w("autoscale_audit.jsonl", _w_autoscale)
 
     def publish_metrics(self, monitor, step: Optional[int] = None) -> int:
         """Push ``Fleet/*`` (health rollup + goodput refreshed first)
@@ -1522,8 +1599,55 @@ class FleetEngine:
         return publish_registry(self.registry, monitor, step,
                                 default_step_counter="Fleet/iterations")
 
+    def autoscale_audit(self) -> list:
+        """The autoscaler's decision ring (oldest first, plain dicts);
+        empty when no autoscaler is attached."""
+        if self.autoscaler is None:
+            return []
+        return self.autoscaler.audit_entries()
+
+    def serve_telemetry(self, host: str = "127.0.0.1", port: int = 0,
+                        token: str = "") -> int:
+        """Start the FLEET's ops surface (the router's view — distinct
+        from any per-replica server): ``/metrics`` (``Fleet/*``),
+        ``/healthz``-``/readyz`` (the health rollup), ``/scaling`` (the
+        fleet scaling report), and — when the autoscaler is on —
+        ``GET /autoscale`` (status + decision audit tail) and the
+        token-gated ``POST /autoscale`` freeze/pin override. Returns
+        the bound port; idempotent while running."""
+        from ..observability.server import TelemetryHooks, TelemetryServer
+
+        if getattr(self, "telemetry", None) is not None:
+            return self.telemetry.port
+        reg = self.registry
+
+        def refresh():
+            self.health()
+            self.fleet_goodput()
+
+        asc = self.autoscaler
+        hooks = TelemetryHooks(
+            registry=reg,
+            step_fn=lambda: int(reg.counter("Fleet/iterations").value),
+            refresh_fn=refresh,
+            health_fn=self.health,
+            scaling_fn=self.scaling_report,
+            dump_fn=((lambda: self.dump_incident("manual"))
+                     if self._incident_base is not None else None),
+            autoscale_fn=(asc.status if asc is not None else None),
+            autoscale_control_fn=(asc.control if asc is not None
+                                  else None))
+        server = TelemetryServer(hooks, host=host, port=port, token=token)
+        bound = server.start()
+        self.telemetry = server
+        return bound
+
     def close(self) -> None:
-        """Teardown every replica (telemetry listeners etc.); the fleet
-        object is not reusable afterwards."""
+        """Teardown every replica (telemetry listeners etc.) and the
+        fleet's own telemetry server; the fleet object is not reusable
+        afterwards."""
+        if getattr(self, "telemetry", None) is not None:
+            self.telemetry.close()
+            self.telemetry = None
         for eng in self.replicas.values():
             eng.close()

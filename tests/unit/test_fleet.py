@@ -757,6 +757,92 @@ def test_doctor_targets_fleet_gate(setup, capsys):
         b.close()
 
 
+# ------------------------------------------- replica-scoped drain & removal
+def test_draining_decode_replica_stops_receiving_handoffs(setup):
+    """A decode replica whose intake is closed (``begin_drain_replica``
+    — the autoscaler's drain-before-remove seam) must stop receiving
+    NEW handoff imports while a non-draining sibling exists: an import
+    onto the drain victim gives it fresh work exactly when the
+    scale-down is waiting for it to idle."""
+    _, _, _, eng = setup
+    fleet = _fleet(eng, replicas=3, prefill_replicas=1,
+                   serving={"page_size": 8})
+    try:
+        d0, d1 = [n for n, r in fleet.roles.items() if r == "decode"]
+        prompts = _prompts(3, seed=21)
+        # choke BOTH decode pools so every finished prefill piles up in
+        # the pending-handoff buffer instead of importing
+        saved = {}
+        for n in (d0, d1):
+            saved[n] = fleet.replicas[n].pool.free[:]
+            fleet.replicas[n].pool.free[:] = []
+        rids = [fleet.submit(p, 5, seed=60 + i)
+                for i, p in enumerate(prompts)]
+        it = 0
+        while len(fleet._handoffs) < len(rids):
+            fleet.step()
+            it += 1
+            assert it < 200, "handoffs never reached the pending buffer"
+        fleet.begin_drain_replica(d0)
+        for n in (d0, d1):
+            fleet.replicas[n].pool.free[:] = saved[n]
+        done = _drive(fleet, rids)
+        assert fleet.replicas[d0].stats.snapshot()["decode_steps"] == 0, \
+            "draining decode replica received a handoff import"
+        for i, rid in enumerate(rids):
+            want = _solo(eng, prompts[i], 5, 60 + i)
+            got = np.asarray(done[rid].tokens, np.int32)
+            assert np.array_equal(got, want[:len(got)])
+        # the drain victim is now idle and legally removable
+        e = fleet.replicas[d0]
+        assert e.sched.idle and e._prefill is None
+        fleet.remove_replica(d0)
+        assert d0 not in fleet.replicas
+    finally:
+        fleet.close()
+
+
+def test_remove_replica_repumps_victim_owned_handoffs(setup):
+    """Removing the replica that EXPORTED a still-pending handoff must
+    clear its ghost owner entry and re-pump the payload onto a survivor
+    in the same call — before the victim's scheduler is gone — not
+    strand it until some later step (or forever, if the fleet idles)."""
+    _, _, _, eng = setup
+    fleet = _fleet(eng, replicas=3, prefill_replicas=2,
+                   serving={"page_size": 8})
+    try:
+        dec = [n for n, r in fleet.roles.items() if r == "decode"][0]
+        prompt = _prompts(1, seed=22)[0]
+        saved = fleet.replicas[dec].pool.free[:]
+        fleet.replicas[dec].pool.free[:] = []
+        rid = fleet.submit(prompt, 5, seed=70)
+        it = 0
+        while not fleet._handoffs:
+            fleet.step()
+            it += 1
+            assert it < 200, "handoff never reached the pending buffer"
+        owner = fleet._owner[rid]
+        assert fleet.roles[owner] == "prefill"
+        # reopen the decode pool FIRST: the removal's re-pump has a
+        # live destination, so the import must happen inside the call
+        fleet.replicas[dec].pool.free[:] = saved
+        requeued = fleet.remove_replica(owner)
+        assert rid not in requeued, \
+            "an exported payload survives its exporter — not a requeue"
+        assert not fleet._handoffs, \
+            "remove_replica left the victim-owned handoff stranded"
+        assert fleet._owner.get(rid) == dec, \
+            f"ghost owner entry: {fleet._owner.get(rid)!r}"
+        done = _drive(fleet, [rid])
+        want = _solo(eng, prompt, 5, 70)
+        got = np.asarray(done[rid].tokens, np.int32)
+        assert np.array_equal(got, want[:len(got)])
+        assert done[rid].status is RequestStatus.OK \
+            and done[rid].attempts == 0
+    finally:
+        fleet.close()
+
+
 # ------------------------------------------------------------------- smoke
 def test_fleet_bench_smoke_gate():
     """Tier-1 wiring of ``bench_fleet.py --smoke``: chaos-kill zero-loss
