@@ -83,6 +83,13 @@ sleep 20
 # Local-disk only — no tunnel claim.
 python -m deepspeed_tpu.ops.aio_bench --size-mb 64 --json AIO_BENCH.json \
   || { echo "[bench_all] aio bench failed"; fails=$((fails+1)); }
+sleep 20
+# Tenant attribution observatory: exact-conservation checks (tokens,
+# page-seconds, tier bytes vs the fleet's own meters), fairness index
+# on even vs skewed multi-tenant traffic, and the injected
+# noisy-neighbor round-trip — into TENANT_BENCH.json (the fairness
+# rows are up-is-good in the perf ledger).
+python bench_tenantscope.py || { echo "[bench_all] tenantscope failed"; fails=$((fails+1)); }
 echo "=== perf ledger ==="
 # Fold every bench JSON this chain just rewrote into the cross-PR
 # trajectory and gate on regressions vs each series' rolling best

@@ -128,7 +128,7 @@ def ttft_by_turn(ttfts, turns):
 
 
 def build(slots, max_len, chunk, temperature=0.8, top_k=20,
-          n_layer=4, d_model=128, n_head=4, **serving_extra):
+          n_layer=4, d_model=128, n_head=4, clock=None, **serving_extra):
     import jax
     import jax.numpy as jnp
 
@@ -140,10 +140,11 @@ def build(slots, max_len, chunk, temperature=0.8, top_k=20,
     model = build_model(cfg)
     params = model.init(jax.random.PRNGKey(0))
     eng = ds.init_inference(model, params, {"dtype": "float32"})
+    kw = {"clock": clock} if clock is not None else {}
     srv = ds.ServingEngine(eng, {"slots": slots, "max_len": max_len,
                                  "prefill_chunk": chunk,
                                  "temperature": temperature, "top_k": top_k,
-                                 **serving_extra})
+                                 **serving_extra}, **kw)
     return model, params, eng, srv
 
 

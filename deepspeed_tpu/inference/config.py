@@ -178,6 +178,18 @@ class ServingConfig:
     # cadence, never per token. None (default) builds nothing: one
     # `is not None` per submit.
     loadscope: "object | None" = None
+    # Per-tenant cost attribution, fairness & noisy-neighbor observatory
+    # (observability.tenantscope.TenantScopeConfig | dict): a ledger
+    # keyed by Request.tenant_id on the injectable clock — tokens,
+    # queue-wait/TTFT/TPOT reservoirs, KV page-seconds (PagePool hook),
+    # resident tier bytes (TierStore owner accounting), per-tenant
+    # prefix overlap, Jain fairness, and an edge-triggered
+    # noisy-neighbor detector that marks the flight ring and dumps a
+    # per-tenant breakdown into incident dirs. Host-side only — zero
+    # new compiled programs; per-tenant sums conserve the fleet totals
+    # exactly. None (default) builds nothing: one `is not None` per
+    # submit/admission/retirement.
+    tenantscope: "object | None" = None
     # Elastic fleet autoscaler (serving.autoscaler.AutoscaleConfig |
     # dict): the actuation loop over the loadscope scaling report —
     # hysteresis-guarded add/drain-then-remove/rebalance with a flap
@@ -284,6 +296,10 @@ class ServingConfig:
             from ..observability.loadscope import LoadScopeConfig
 
             self.loadscope = LoadScopeConfig.from_any(self.loadscope)
+        if self.tenantscope is not None:
+            from ..observability.tenantscope import TenantScopeConfig
+
+            self.tenantscope = TenantScopeConfig.from_any(self.tenantscope)
         if self.telemetry is not None:
             from ..observability.server import TelemetryConfig
 

@@ -19,7 +19,8 @@ from .capacity import (ProgramCensus, capacity_report, hbm_ledger,
 from .commscope import (CommScope, CommScopeConfig, StragglerDetector,
                         bandwidth_ledger, classify_op, decompose,
                         step_anatomy)
-from .expfmt import exposition_from_events, render_exposition
+from .expfmt import (exposition_from_events, labeled_name, parse_labels,
+                     prometheus_series, render_exposition, split_series)
 from .export import (HOP_NAMES, RequestLogSink, hop_trace,
                      merge_fleet_trace, request_record, to_chrome_trace,
                      validate_chrome_trace, write_chrome_trace)
@@ -45,6 +46,7 @@ from .server import (TelemetryConfig, TelemetryHooks, TelemetryServer,
 from .slo import (CompileStormDetector, MedianMADDetector, SLOConfig,
                   SLOScorer)
 from .spans import SpanEvent, SpanRecorder
+from .tenantscope import TenantScope, TenantScopeConfig
 from .tracing import RequestRecord, RequestTracer, ServingStats
 from .workload import WorkloadAnalyzer, WorkloadConfig
 from .xla import TraceWindow, sample_memory
@@ -53,7 +55,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Reservoir",
     "get_registry",
     "JsonlSink", "PrometheusTextfileSink", "parse_prometheus_textfile",
-    "prometheus_name", "format_prometheus_value",
+    "prometheus_name", "prometheus_series", "format_prometheus_value",
+    "labeled_name", "split_series", "parse_labels",
     "render_exposition", "exposition_from_events",
     "GoodputLedger", "BADPUT_BUCKETS",
     "TelemetryConfig", "TelemetryHooks", "TelemetryServer",
@@ -75,4 +78,5 @@ __all__ = [
     "TrafficCapture", "TrafficTrace", "ReplayClock", "ReplayDriver",
     "ReplayReport", "advisor_backtest", "trace_from_request_log",
     "write_backtest_report", "TRACE_SCHEMA",
+    "TenantScope", "TenantScopeConfig",
 ]

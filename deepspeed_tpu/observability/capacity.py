@@ -50,6 +50,7 @@ LEVER_COLLECTIVES = "quantized_collectives"
 LEVER_SPECULATION = "speculative_decoding"
 LEVER_TIERED_KV = "tiered_kv"
 LEVER_SCALING = "scaling"
+LEVER_TENANT = "tenant_affinity"
 
 
 def roofline_peaks(device=None) -> tuple:
@@ -387,7 +388,8 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
                     pages: Optional[dict] = None,
                     commscope: Optional[dict] = None,
                     kvscope: Optional[dict] = None,
-                    loadscope: Optional[dict] = None) -> dict:
+                    loadscope: Optional[dict] = None,
+                    tenantscope: Optional[dict] = None) -> dict:
     """Compose ledger + census + workload into the ranked what-if advisor.
 
     Every lever's score is the estimated fraction of its bounding
@@ -727,6 +729,57 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         levers.append({"name": LEVER_SCALING, "score": sc_score,
                        "estimate": sc_est, "why": why_sc})
 
+    # Tenant affinity / adapter locality: the per-tenant observatory
+    # (tenantscope.py) prices tenant-affine routing — keeping each
+    # tenant's requests (and, once the S-LoRA build lands, its adapters)
+    # on few replicas preserves exactly the prefix sharing the tenant's
+    # OWN traffic exhibits, and matters in proportion to how unevenly
+    # tenants consume the fleet (cross-tenant interference). Only
+    # present when the observatory ran; single-tenant traffic
+    # self-demotes with its reason.
+    if tenantscope is not None:
+        rows = tenantscope.get("tenants") or {}
+        fair = tenantscope.get("fairness") or {}
+        noisy = tenantscope.get("noisy") or {}
+        jain = fair.get("jain")
+        ptoks = sum(r.get("prompt_tokens") or 0 for r in rows.values())
+        # token-weighted mean of each tenant's OWN prefix overlap — the
+        # sharing a tenant-affine replica keeps hot
+        t_overlap = (sum((r.get("prefix_overlap") or 0.0)
+                         * (r.get("prompt_tokens") or 0)
+                         for r in rows.values()) / ptoks
+                     if ptoks else None)
+        dom = fair.get("dominant_shares") or {}
+        top = max(dom, key=dom.get) if dom else None
+        tn_est: dict[str, Any] = {
+            "per_tenant_overlap": t_overlap,
+            "fairness_jain": jain,
+            "n_tenants": len(rows),
+            "noisy_episodes": noisy.get("episodes"),
+            "top_tenant": top,
+            "top_dominant_share": dom.get(top) if top else None,
+        }
+        if len(rows) < 2 or jain is None or t_overlap is None:
+            tn_score = 0.0
+            why_tn = ("single-tenant traffic (or nothing retired yet) — "
+                      "tenant-affine routing has nothing to separate")
+        else:
+            # interference: 1 - Jain is 0 when tenants consume evenly
+            # and → 1 as one tenant dominates; the affinity win is the
+            # tenant-local overlap that routing can preserve, scaled by
+            # how much there is to isolate
+            tn_score = max(0.0, min(1.0, t_overlap * (1.0 - jain)))
+            why_tn = (f"measured per-tenant overlap {t_overlap:.3g} × "
+                      f"interference (1 - jain {jain:.3g}) prices "
+                      "tenant-affine routing / adapter locality on this "
+                      "traffic")
+            if noisy.get("episodes"):
+                why_tn += (f"; {noisy['episodes']} noisy-neighbor "
+                           "episode(s) observed — isolation also buys "
+                           "SLO protection")
+        levers.append({"name": LEVER_TENANT, "score": tn_score,
+                       "estimate": tn_est, "why": why_tn})
+
     levers.sort(key=lambda d: d["score"], reverse=True)
     return {
         "schema": CAPACITY_SCHEMA,
@@ -745,6 +798,8 @@ def capacity_report(*, ledger: dict, census: Optional[dict] = None,
         # the arrival & scaling observatory's measured rows (same
         # contract: None when it didn't run, absent on older artifacts)
         "loadscope": loadscope,
+        # the per-tenant observatory's measured rows (same contract)
+        "tenantscope": tenantscope,
         "advisor": {"levers": levers,
                     "ranked": [d["name"] for d in levers]},
     }

@@ -803,6 +803,76 @@ def report_autoscale(d: Path, frozen_max: float = 900.0) -> list:
     return findings
 
 
+def report_tenants(d: Path, fairness_min: float = 0.0) -> list:
+    """Print the ``[tenants]`` picture — the per-tenant cost attribution
+    observatory (``observability/tenantscope.py``) from the newest
+    .prom's labeled ``dstpu_serve_tenant_*`` series: top consumers by
+    completed tokens, the Jain fairness index, and any active
+    noisy-neighbor episode. Gate finding: FAIRNESS FLOOR BREACHED —
+    the fairness index below ``fairness_min`` (0 disables; Jain's
+    index is 1.0 when every tenant gets an equal token share,
+    approaching 1/n under full capture by one tenant)."""
+    from .expfmt import parse_labels, split_series
+    from .sinks import parse_prometheus_textfile
+
+    prom = _newest(d, "*.prom")
+    if prom is None:
+        return []
+    vals = parse_prometheus_textfile(prom.read_text())
+    tnt = {k: v for k, v in vals.items()
+           if k.startswith("dstpu_serve_tenant_")}
+    if not tnt:
+        return []          # no tenantscope ran: no section, no gate
+    # fold the labeled series into per-tenant rows
+    per: dict = {}
+    for k, v in tnt.items():
+        base, block = split_series(k)
+        if not block:
+            continue
+        tid = parse_labels(block).get("tenant")
+        if tid is None:
+            continue
+        per.setdefault(tid, {})[base] = v
+    print(f"[tenants] {prom.name} ({len(per)} tenant(s))")
+    top = sorted(per.items(),
+                 key=lambda kv: kv[1].get(
+                     "dstpu_serve_tenant_completed_tokens", 0.0),
+                 reverse=True)
+    for tid, row in top[:8]:
+        toks = row.get("dstpu_serve_tenant_completed_tokens")
+        share = row.get("dstpu_serve_tenant_goodput_share")
+        dom = row.get("dstpu_serve_tenant_dominant_share")
+        ps = row.get("dstpu_serve_tenant_page_seconds")
+        sheds = row.get("dstpu_serve_tenant_sheds")
+        print(f"  {tid:<16s} "
+              f"tokens={_fmt(toks) if toks is not None else '-'} "
+              f"share={_fmt(share) if share is not None else '-'} "
+              f"dominant={_fmt(dom) if dom is not None else '-'} "
+              f"page_s={_fmt(ps) if ps is not None else '-'}"
+              + (f" sheds={_fmt(sheds)}" if sheds else ""))
+    jain = tnt.get("dstpu_serve_tenant_fairness_jain")
+    if jain is not None:
+        print(f"  fairness_jain          {_fmt(jain)}")
+    episodes = tnt.get("dstpu_serve_tenant_noisy_episodes")
+    active = tnt.get("dstpu_serve_tenant_noisy_active")
+    if episodes:
+        state = "ACTIVE" if isinstance(active, float) and active >= 1 \
+            else "ended"
+        print(f"  noisy_neighbor         {_fmt(episodes)} episode(s), "
+              f"{state} (triage: docs/OPERATIONS.md)")
+    findings: list = []
+    if fairness_min > 0 and isinstance(jain, float) \
+            and jain < fairness_min:
+        print(f"  FAIRNESS FLOOR BREACHED: jain {_fmt(jain)} "
+              f"< {fairness_min:g}")
+        findings.append(
+            f"tenant fairness floor breached in {prom.name}: Jain "
+            f"index {_fmt(jain)} < {fairness_min:g} — one tenant is "
+            "capturing the fleet; see the noisy-neighbor runbook "
+            "(docs/OPERATIONS.md)")
+    return findings
+
+
 # ----------------------------------------------------------- live (--url)
 def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
     """(status, body) for a GET; (None, error-repr) when the target is
@@ -824,11 +894,12 @@ def _http_get(url: str, timeout: float) -> "tuple[Optional[int], str]":
         return None, repr(e)
 
 
-def report_live(url: str, timeout: float = 3.0) -> list:
+def report_live(url: str, timeout: float = 3.0,
+                fairness_min: float = 0.0) -> list:
     """Triage one LIVE engine over its telemetry endpoints; returns gate
     findings with the same semantics as the file mode (burning SLO
-    gauges, why-markers in the newest flight record, plus: target
-    unreachable)."""
+    gauges, a breached tenant-fairness floor, why-markers in the newest
+    flight record, plus: target unreachable)."""
     from .expfmt import parse_prometheus_textfile
 
     url = url.rstrip("/")
@@ -876,6 +947,48 @@ def report_live(url: str, timeout: float = 3.0) -> list:
     elif code is not None:
         print(f"[goodput] endpoint absent ({code}) — goodput ledger "
               "disabled on this engine")
+    # ---- /tenants: the live analog of the [tenants] file section
+    code, body = _http_get(url + "/tenants", timeout)
+    if code == 200:
+        try:
+            tr = json.loads(body)
+        except json.JSONDecodeError:
+            tr = {}
+        rows = tr.get("tenants")
+        rows = rows if isinstance(rows, dict) else {}
+        print(f"[tenants] {len(rows)} tenant(s)")
+        top = sorted(rows.items(),
+                     key=lambda kv: (kv[1] or {}).get(
+                         "completed_tokens", 0) or 0, reverse=True)
+        for tid, row in top[:8]:
+            row = row if isinstance(row, dict) else {}
+            share = row.get("goodput_share")
+            print(f"  {str(tid):<16s} "
+                  f"tokens={row.get('completed_tokens')} "
+                  f"share={_fmt(share) if isinstance(share, float) else '-'} "
+                  f"sheds={row.get('sheds')}")
+        fair = tr.get("fairness")
+        jain = fair.get("jain") if isinstance(fair, dict) else None
+        if jain is not None:
+            print(f"  fairness_jain          {_fmt(float(jain))}")
+        noisy = tr.get("noisy")
+        noisy = noisy if isinstance(noisy, dict) else {}
+        if noisy.get("episodes"):
+            state = "ACTIVE" if noisy.get("active") else "ended"
+            print(f"  noisy_neighbor         {noisy['episodes']} "
+                  f"episode(s), {state}")
+        if fairness_min > 0 and isinstance(jain, (int, float)) \
+                and jain < fairness_min:
+            print(f"  FAIRNESS FLOOR BREACHED: jain {_fmt(float(jain))} "
+                  f"< {fairness_min:g}")
+            findings.append(
+                f"tenant fairness floor breached at {url}: Jain index "
+                f"{_fmt(float(jain))} < {fairness_min:g} — one tenant "
+                "is capturing the fleet; see the noisy-neighbor "
+                "runbook (docs/OPERATIONS.md)")
+    elif code is not None:
+        print(f"[tenants] endpoint absent ({code}) — tenantscope "
+              "disabled on this engine (set serving.tenantscope)")
     # ---- /flight: newest manifest + why-markers (the live flight gate)
     code, body = _http_get(url + "/flight", timeout)
     if code == 200:
@@ -1007,6 +1120,10 @@ def main(argv=None) -> int:
                     help="[autoscale] gate: a control loop frozen "
                          "longer than this (seconds) trips "
                          "(default 900)")
+    ap.add_argument("--tenant-fairness-min", type=float, default=0.0,
+                    help="[tenants] gate: a Jain fairness index below "
+                         "this floor trips (default 0 = disabled; 1.0 "
+                         "is perfectly even token shares)")
     args = ap.parse_args(argv)
     if args.targets:
         findings = report_fleet(
@@ -1018,7 +1135,8 @@ def main(argv=None) -> int:
             # than were live) trips CI even when every target is up
             findings += report_incidents(Path(args.flight_dir))
     elif args.url:
-        findings = report_live(args.url, timeout=args.timeout)
+        findings = report_live(args.url, timeout=args.timeout,
+                               fairness_min=args.tenant_fairness_min)
     else:
         d = Path(args.dir)
         findings = report_prometheus(d)
@@ -1032,6 +1150,8 @@ def main(argv=None) -> int:
         findings += report_load(d, rho_max=args.load_rho_max)
         findings += report_autoscale(
             d, frozen_max=args.autoscale_frozen_max)
+        findings += report_tenants(
+            d, fairness_min=args.tenant_fairness_min)
         findings += report_replay([d] if fdir == d else [d, fdir])
         ledger = Path(args.ledger) if args.ledger \
             else d / "PERF_LEDGER.json"

@@ -13,16 +13,24 @@ they *cannot* drift (the round-trip is regression-pinned in
 Contents:
 
 - :func:`prometheus_name` — metric name → legal Prometheus identifier;
+- :func:`labeled_name` / :func:`split_series` / :func:`parse_labels` —
+  multi-label series support: registry names may carry a canonical
+  ``{k="v",...}`` label block (sorted keys, escaped values), and
+  relabelers COMPOSE into it (``{engine=...}`` merges with
+  ``{tenant=...}``) instead of clobbering it;
+- :func:`prometheus_series` — full series name (base sanitized, label
+  block canonicalized) — what every renderer keys samples by;
 - :func:`format_prometheus_value` — exposition scalar spelling
   (``+Inf`` / ``-Inf`` / ``NaN`` for non-finite values);
 - :func:`render_exposition` — the full textfile/scrape body (step gauge
-  first, then sorted metrics, each with ``# HELP`` / ``# TYPE`` lines);
+  first, then sorted metrics; ``# HELP`` / ``# TYPE`` lines emitted once
+  per BASE name, since a labeled series shares its base's type);
 - :func:`exposition_from_events` — ``(name, value, step)`` event tuples
   (``MetricsRegistry.to_events``) → exposition text, the one-call path
   the HTTP endpoint uses;
 - :func:`parse_prometheus_textfile` — the tiny reader (tests + the
   doctor CLI), label-tolerant so it also reads the fleet aggregator's
-  relabeled output.
+  relabeled output (samples key as ``name{labels}``).
 """
 
 from __future__ import annotations
@@ -43,6 +51,65 @@ def prometheus_name(name: str, prefix: str = "dstpu") -> str:
     if not _PROM_NAME_OK.match(full):
         full = "_" + full
     return full
+
+
+# ------------------------------------------------------- labeled series
+# A registry name may end in a label block: `Serve/tenant_tokens
+# {tenant="acme"}` (no space — shown split here for line width). The
+# block must survive sanitization verbatim (prometheus_name would squash
+# `{="}` to underscores), so every series-aware path splits the name
+# first, sanitizes only the base, and re-attaches the CANONICAL block
+# (sorted label keys) — which is what makes render→parse round-trip
+# stable and lets relabelers compose rather than clobber.
+_SERIES_RE = re.compile(r"^(.*?)(\{.*\})$", re.S)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def split_series(name: str) -> tuple[str, str]:
+    """``base{labels}`` → ``(base, "{labels}")``; plain names →
+    ``(name, "")``."""
+    m = _SERIES_RE.match(name)
+    return (m.group(1), m.group(2)) if m else (name, "")
+
+
+def parse_labels(block: str) -> dict[str, str]:
+    """``'{a="x",b="y"}'`` → ``{"a": "x", "b": "y"}`` (values kept in
+    their escaped spelling, so re-emission is byte-stable)."""
+    return {k: v for k, v in _LABEL_RE.findall(block or "")}
+
+
+def _escape_label_value(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def labeled_name(name: str, **labels) -> str:
+    """Attach (or merge) labels onto a metric name, canonically: label
+    keys sorted, values escaped. Existing labels on ``name`` are kept;
+    a key passed here overrides the same key already present — the
+    COMPOSE rule the fleet relabeler relies on (``engine=`` merges with
+    a tenant label instead of clobbering the block)."""
+    base, block = split_series(name)
+    merged = parse_labels(block)
+    for k, v in labels.items():
+        merged[k] = _escape_label_value(v)
+    if not merged:
+        return base
+    body = ",".join(f'{k}="{merged[k]}"' for k in sorted(merged))
+    return f"{base}{{{body}}}"
+
+
+def prometheus_series(name: str, prefix: str = "dstpu") -> str:
+    """Full series name → legal exposition key: the base goes through
+    :func:`prometheus_name`, the label block (if any) is re-emitted in
+    canonical sorted-key order. The identity every renderer and the
+    parser agree on."""
+    base, block = split_series(name)
+    if not block:
+        return prometheus_name(base, prefix)
+    labels = parse_labels(block)
+    body = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return prometheus_name(base, prefix) + "{" + body + "}"
 
 
 def format_prometheus_value(v: float) -> str:
@@ -71,10 +138,19 @@ def render_exposition(values: dict[str, float],
     lines = [f"# HELP {step_name} deepspeed_tpu metric 'step'",
              f"# TYPE {step_name} gauge",
              f"{step_name} {int(step)}"]
+    seen_bases: set = set()
     for name in sorted(values):
-        lines.append(f"# HELP {name} deepspeed_tpu metric "
-                     f"{source.get(name, name)!r}")
-        lines.append(f"# TYPE {name} gauge")
+        base, block = split_series(name)
+        if base not in seen_bases:
+            # HELP/TYPE describe the BASE metric once — a `# TYPE
+            # name{labels}` line is illegal exposition format, and for
+            # unlabeled names this emits the exact bytes it always did
+            seen_bases.add(base)
+            src = source.get(name, name)
+            if block:
+                src = split_series(src)[0]
+            lines.append(f"# HELP {base} deepspeed_tpu metric {src!r}")
+            lines.append(f"# TYPE {base} gauge")
         lines.append(f"{name} {format_prometheus_value(values[name])}")
     return "\n".join(lines) + "\n"
 
@@ -90,7 +166,7 @@ def exposition_from_events(events: Sequence[tuple],
     source: dict[str, str] = {}
     step = 0
     for name, value, s in events:
-        pn = prometheus_name(name, prefix)
+        pn = prometheus_series(name, prefix)
         values[pn] = float(value)
         source[pn] = name
         step = max(step, int(s))

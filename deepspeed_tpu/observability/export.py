@@ -512,9 +512,11 @@ def hop_trace(req) -> dict:
 # trace_from_request_log): prompt token ids, sampling seed, session id,
 # and the per-request deadline BUDGETS (relative seconds, recomputed from
 # the absolute stamps) — an existing request log upgrades cleanly into a
-# TrafficTrace. v1 rows (no schema key) still parse everywhere; they just
-# cannot replay.
-REQUEST_RECORD_SCHEMA = "dstpu.request_record.v2"
+# TrafficTrace. v3 adds `tenant_id` (the cost-attribution dimension,
+# observability/tenantscope.py). Old rows still parse everywhere: v2 rows
+# upgrade with tenant_id="default" (counted, never a crash); v1 rows (no
+# schema key) just cannot replay.
+REQUEST_RECORD_SCHEMA = "dstpu.request_record.v3"
 
 
 def request_record(req, queue_wait_s: Optional[float] = None) -> dict:
@@ -549,6 +551,7 @@ def request_record(req, queue_wait_s: Optional[float] = None) -> dict:
                     .tolist()] if prompt is not None else None),
         "seed": int(getattr(req, "seed", 0)),
         "session_id": sid,
+        "tenant_id": str(getattr(req, "tenant_id", "default") or "default"),
         "ttft_deadline_s": (dl_ttft - req.submit_t
                             if dl_ttft is not None else None),
         "total_deadline_s": (dl_total - req.submit_t

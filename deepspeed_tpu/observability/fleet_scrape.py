@@ -41,7 +41,8 @@ from pathlib import Path
 from typing import Callable, Optional
 from urllib.request import urlopen
 
-from .expfmt import format_prometheus_value, parse_prometheus_textfile
+from .expfmt import (format_prometheus_value, labeled_name,
+                     parse_prometheus_textfile)
 
 _SLO_BURN = re.compile(r"_slo_.*_burn$")
 _LABEL_SAFE = re.compile(r"[^a-zA-Z0-9_.-]")
@@ -203,9 +204,18 @@ class FleetScraper:
             lines.append(f"dstpu_scrape_latency_s{lab} "
                          f"{format_prometheus_value(e['latency_s'])}")
             for name, value in sorted(e["metrics"].items()):
-                if "{" in name:     # already-labeled sample (an engine
-                    continue        # proxying a fleet file): skip, never
-                    # nest label sets
+                if "{" in name:
+                    # already-labeled sample (tenant-labeled series, or
+                    # an engine proxying a fleet file): COMPOSE — merge
+                    # the engine label into the existing set instead of
+                    # nesting/clobbering. An engine="..." label already
+                    # present wins (proxied fleet files keep their own
+                    # attribution).
+                    merged = labeled_name(name, engine=e["engine"]) \
+                        if 'engine="' not in name else name
+                    lines.append(f"{merged} "
+                                 f"{format_prometheus_value(value)}")
+                    continue
                 lines.append(f"{name}{lab} "
                              f"{format_prometheus_value(value)}")
         fl = snap["fleet"]

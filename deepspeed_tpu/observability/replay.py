@@ -135,7 +135,7 @@ class TrafficTrace:
     # ------------------------------------------------------------ building
     def add_request(self, rid: int, t_rel: float, prompt=None,
                     gen: Optional[dict] = None, max_new: int = 1,
-                    seed: int = 0, session_id=None,
+                    seed: int = 0, session_id=None, tenant_id=None,
                     ttft_deadline_s: Optional[float] = None,
                     total_deadline_s: Optional[float] = None) -> dict:
         ev: dict = {"kind": _KIND_REQUEST, "t_rel": float(t_rel),
@@ -148,6 +148,10 @@ class TrafficTrace:
             ev["gen"] = {k: int(v) for k, v in gen.items()}
         if session_id is not None:
             ev["session_id"] = session_id
+        if tenant_id is not None and str(tenant_id) != "default":
+            # stored only when attribution is real: tenant-free traces
+            # (and their byte layout) are unchanged
+            ev["tenant_id"] = str(tenant_id)
         if ttft_deadline_s is not None:
             ev["ttft_deadline_s"] = float(ttft_deadline_s)
         if total_deadline_s is not None:
@@ -375,6 +379,11 @@ class TrafficCapture:
             else getattr(req, "session_id", None)
         if sid is not None:
             ev["session_id"] = sid
+        tid = getattr(req, "tenant_id", None)
+        if tid is not None and str(tid) != "default":
+            # verbatim tenant attribution; the inert value stays
+            # unrecorded so pre-tenant captures are byte-identical
+            ev["tenant_id"] = str(tid)
         if ttft_deadline_s is not None:
             ev["ttft_deadline_s"] = float(ttft_deadline_s)
         if total_deadline_s is not None:
@@ -453,13 +462,16 @@ def trace_from_request_log(rows: Iterable[dict]) \
     """Upgrade request-log records into a replayable
     :class:`TrafficTrace` — ``(trace, skipped)``.
 
-    v2 request records (``observability/export.py``) carry the fields
+    v2+ request records (``observability/export.py``) carry the fields
     replay needs: prompt token ids, sampling seed, session id, and the
-    per-request deadline budgets. Rows missing any of them (v1 logs, or
-    torn lines parsed to partial objects) are SKIPPED and counted, never
-    guessed at. The request log does not carry output token ids (only
-    counts), so the upgraded trace has no recorded outputs — replay runs
-    but the parity oracle reports ``parity=None``."""
+    per-request deadline budgets; v3 adds ``tenant_id``. Rows missing
+    the replay fields (v1 logs, or torn lines parsed to partial
+    objects) are SKIPPED and counted, never guessed at. v2 rows (no
+    tenant_id) upgrade to ``"default"`` — counted in the trace meta
+    (``tenantless_rows``), never a crash. The request log does not
+    carry output token ids (only counts), so the upgraded trace has no
+    recorded outputs — replay runs but the parity oracle reports
+    ``parity=None``."""
     usable = []
     skipped = 0
     for r in rows:
@@ -472,13 +484,18 @@ def trace_from_request_log(rows: Iterable[dict]) \
             skipped += 1
     usable.sort(key=lambda r: (r["submit_t"], r["rid"]))
     t0 = usable[0]["submit_t"] if usable else 0.0
+    tenantless = sum(1 for r in usable if r.get("tenant_id") is None)
     tr = TrafficTrace(meta={"source": "request_log",
                             "upgraded_rows": len(usable),
-                            "skipped_rows": skipped})
+                            "skipped_rows": skipped,
+                            # v2 rows carrying no tenant dimension —
+                            # upgraded to "default", never dropped
+                            "tenantless_rows": tenantless})
     for r in usable:
         tr.add_request(rid=r["rid"], t_rel=r["submit_t"] - t0,
                        prompt=r["prompt"], max_new=int(r["max_new"]),
                        seed=int(r["seed"]), session_id=r.get("session_id"),
+                       tenant_id=r.get("tenant_id", "default"),
                        ttft_deadline_s=r.get("ttft_deadline_s"),
                        total_deadline_s=r.get("total_deadline_s"))
     return tr, skipped
@@ -644,6 +661,10 @@ class ReplayDriver:
                 kw["total_deadline_s"] = ev["total_deadline_s"]
             if self._fleet and ev.get("session_id") is not None:
                 kw["session_id"] = ev["session_id"]
+            if ev.get("tenant_id") is not None:
+                # engine and fleet submit both take tenant_id; absent
+                # (pre-tenant trace) → scheduler default "default"
+                kw["tenant_id"] = ev["tenant_id"]
             try:
                 rid = self.engine.submit(resolve_prompt(ev),
                                          int(ev["max_new"]),

@@ -18,6 +18,8 @@ Read endpoints (GET):
 - ``/capacity`` — the capacity report (PR 6); ``?census=1`` adds the
   AOT program census (expensive — off by default per scrape);
 - ``/goodput``  — the goodput/badput decomposition (``goodput.py``);
+- ``/tenants``  — per-tenant cost/fairness breakdown (``tenantscope.py``
+  report: attribution rows, Jain index, noisy-neighbor state);
 - ``/flight``   — newest flight-record summary (manifest + why-marker
   names), the live analog of the doctor's file-mode flight section;
 - ``/trace``    — the engine's span ring as a Chrome/Perfetto trace
@@ -121,6 +123,10 @@ class TelemetryHooks:
     # report JSON — unmeasured inputs arrive as nulls with reasons, the
     # endpoint stays 200 (degraded-null contract); absent hook → 404
     scaling_fn: Optional[Callable[[], dict]] = None
+    # per-tenant observatory readout (tenantscope.py): the per-tenant
+    # breakdown — cost attribution rows, fairness block, noisy-neighbor
+    # state (the doctor's --url [tenants] section); absent hook → 404
+    tenants_fn: Optional[Callable[[], dict]] = None
     # autoscaler control loop (serving/autoscaler.py): GET status +
     # decision audit tail; POST freeze/pin override (token-gated like
     # every control POST; ValueError → 400)
@@ -326,6 +332,12 @@ def _make_handler(server: TelemetryServer):
                                               "(set serving.loadscope)"})
                 else:
                     self._json(200, h.scaling_fn())
+            elif path == "/tenants":
+                if h.tenants_fn is None:
+                    self._json(404, {"error": "tenantscope disabled "
+                                              "(set serving.tenantscope)"})
+                else:
+                    self._json(200, h.tenants_fn())
             elif path == "/autoscale":
                 if h.autoscale_fn is None:
                     self._json(404, {"error": "no autoscaler "
@@ -359,6 +371,7 @@ def _make_handler(server: TelemetryServer):
                        "/goodput": h.goodput_fn is not None,
                        "/flight": h.flight_fn is not None,
                        "/scaling": h.scaling_fn is not None,
+                       "/tenants": h.tenants_fn is not None,
                        "/autoscale": h.autoscale_fn is not None,
                        "/trace": h.trace_fn is not None,
                        "POST /drain": h.drain_fn is not None,

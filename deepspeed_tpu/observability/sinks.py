@@ -28,7 +28,7 @@ from typing import Callable, Sequence
 # live /metrics endpoint in server.py — the two outputs are
 # byte-compatible by construction); re-exported here for compatibility.
 from .expfmt import (format_prometheus_value, parse_prometheus_textfile,
-                     prometheus_name, render_exposition)
+                     prometheus_name, prometheus_series, render_exposition)
 
 __all__ = ["JsonlSink", "PrometheusTextfileSink", "prometheus_name",
            "format_prometheus_value", "parse_prometheus_textfile"]
@@ -127,7 +127,10 @@ class PrometheusTextfileSink:
         # buffered: the textfile is rewritten at flush() (report boundaries
         # / close), not per event batch
         for name, value, step in events:
-            pn = prometheus_name(name, self.prefix)
+            # series-aware: a labeled registry name (Serve/tenant_*
+            # {tenant="..."}) keeps its label block; plain names render
+            # exactly as before
+            pn = prometheus_series(name, self.prefix)
             self._values[pn] = float(value)
             self._source[pn] = name
             self._step = max(self._step, int(step))

@@ -1,0 +1,574 @@
+"""tenantscope: per-tenant cost attribution, fairness & noisy-neighbor
+observatory.
+
+Every prior observatory metered a fleet-wide resource (kvscope → KV
+eviction regret, commscope → collective anatomy, loadscope → arrival
+process); this one splits the SAME totals along the `Request.tenant_id`
+dimension so the multi-tenant build (S-LoRA adapter serving, ROADMAP)
+lands against its own meter. Design rules:
+
+- **Conservation, not estimation.** Per-tenant cells are incremented at
+  the exact call sites (and with the exact arithmetic) that move the
+  fleet totals: completed tokens at the retirement funnel with
+  ``len(req.tokens)`` (the same expression ``ServingStats.on_retire``
+  counts into ``Serve/completed_tokens``), KV pages through the
+  ``PagePool.on_pages(rid, ±pages)`` hook whose deltas net to zero per
+  request, resident tier bytes through ``TierStore.owner_bytes`` which
+  moves with ``bytes_used`` at every path. So Σ per-tenant == fleet
+  total *exactly* (integer token counts; page-second integrals agree
+  interval-by-interval on the same injectable clock).
+- **Inert by default.** The engine builds this only when
+  ``serving.tenantscope`` is set; enabled, it is host-side arithmetic
+  on the submit/admission/retirement paths — zero new compiled
+  programs, zero syncs (the bench compile-freeze gates stay the
+  oracle). Requests that never set a tenant bill to ``"default"``.
+- **Bounded cardinality.** At most ``max_tenants`` label values; later
+  tenants fold into ``"(overflow)"`` so a tenant-id-per-request abuse
+  cannot mint unbounded Prometheus series. Reservoirs and the
+  block-owner map are bounded deques/LRU.
+
+Exports label-aware series (``Serve/tenant_*{tenant="..."}`` — see
+``expfmt.labeled_name``), Jain's fairness index + dominant-resource
+shares, and an edge-triggered noisy-neighbor detector: one tenant's
+arrival burst correlated with fleet SLO burn marks the flight ring
+(``noisy_neighbor`` why-marker) and dumps a per-tenant breakdown
+artifact (``tenant_breakdown.json``) into the incident dir.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import OrderedDict, deque
+from typing import Callable, Optional
+
+from .expfmt import labeled_name
+from .workload import prefix_hashes, token_hash
+
+OVERFLOW_TENANT = "(overflow)"
+UNOWNED = "(unowned)"
+
+
+@dataclasses.dataclass
+class TenantScopeConfig:
+    """Knobs for the per-tenant observatory (``serving.tenantscope``)."""
+
+    enabled: bool = True
+    # label-cardinality bound: distinct tenants beyond this fold into
+    # OVERFLOW_TENANT (their costs still conserve — just unsplit)
+    max_tenants: int = 64
+    # per-tenant latency reservoir depth (queue-wait / TTFT / TPOT)
+    reservoir: int = 256
+    # block-prefix → first-writer tenant map bound (tier-byte owners)
+    block_owner_cap: int = 16384
+    # noisy-neighbor detector: arrival window, minimum burst evidence,
+    # the arrival share that makes one tenant "dominant", the SLO burn
+    # that makes the fleet "hurting", the re-trigger cooldown, and the
+    # detector's own tick rate-limit (all on the injectable clock)
+    window_s: float = 30.0
+    min_burst_arrivals: int = 8
+    burst_share: float = 0.5
+    burn_threshold: float = 1.0
+    cooldown_s: float = 30.0
+    check_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1, "
+                             f"got {self.max_tenants}")
+        if self.reservoir < 1:
+            raise ValueError(f"reservoir must be >= 1, "
+                             f"got {self.reservoir}")
+        for knob in ("window_s", "cooldown_s", "check_interval_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0, "
+                                 f"got {getattr(self, knob)}")
+        if not (0.0 < self.burst_share <= 1.0):
+            raise ValueError(f"burst_share must be in (0, 1], "
+                             f"got {self.burst_share}")
+
+    @classmethod
+    def from_any(cls, cfg) -> "TenantScopeConfig":
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, cls):
+            return cfg
+        if cfg is True:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(cfg) - known
+        if unknown:
+            raise ValueError(
+                f"unknown tenantscope config keys: {sorted(unknown)}")
+        return cls(**cfg)
+
+
+class _Cell:
+    """One tenant's ledger row. Plain attributes — every field is either
+    an exact conserved integer or a bounded reservoir."""
+
+    __slots__ = ("submitted", "admitted", "completed_tokens",
+                 "prompt_tokens", "shared_prefix_tokens", "sheds",
+                 "timeouts", "cancelled", "nonfinite", "requeues",
+                 "retired_ok", "pages_held", "page_seconds",
+                 "last_page_t", "queue_wait", "ttft", "tpot", "arrivals")
+
+    def __init__(self, reservoir: int):
+        self.submitted = 0
+        self.admitted = 0
+        self.completed_tokens = 0
+        self.prompt_tokens = 0
+        self.shared_prefix_tokens = 0
+        self.sheds = 0
+        self.timeouts = 0
+        self.cancelled = 0
+        self.nonfinite = 0
+        self.requeues = 0
+        self.retired_ok = 0
+        self.pages_held = 0
+        self.page_seconds = 0.0
+        self.last_page_t: Optional[float] = None
+        self.queue_wait: deque = deque(maxlen=reservoir)
+        self.ttft: deque = deque(maxlen=reservoir)
+        self.tpot: deque = deque(maxlen=reservoir)
+        self.arrivals: deque = deque(maxlen=4096)
+
+
+def _pct(values, q: float) -> Optional[float]:
+    if not values:
+        return None
+    s = sorted(values)
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[i]
+
+
+def jain_index(xs) -> Optional[float]:
+    """Jain's fairness index over per-tenant allocations: 1.0 when all
+    equal, → 1/n when one tenant holds everything. None when nothing
+    was allocated yet."""
+    xs = [float(x) for x in xs if x > 0]
+    if not xs:
+        return None
+    sq = sum(x * x for x in xs)
+    if sq <= 0:
+        return None
+    return (sum(xs) ** 2) / (len(xs) * sq)
+
+
+class TenantScope:
+    """The per-tenant cost/fairness ledger (see module docstring).
+
+    Wiring (all done by ``ServingEngine.__init__`` when
+    ``serving.tenantscope`` is set):
+
+    - ``on_submit(req)`` / ``on_shed(tid)`` on the intake path;
+    - ``on_admit(req, workload=...)`` at admission (the PR-6 workload
+      estimator's per-request dict partitions prefix overlap by tenant);
+    - ``on_retire(req)`` at the terminal funnel (``_store_result``);
+    - ``on_requeue(req)`` / ``on_adopt(req)`` on the fleet seams, so a
+      moved request keeps billing its tenant on the new replica;
+    - ``PagePool.on_pages = ts.on_pages`` for the page-second integral;
+    - ``on_blocks(req)`` beside ``pool.on_inserted`` so demoted blocks
+      can be billed to the tenant that first wrote them
+      (``block_owner(tokens)`` at the demote-drain ``put``).
+    """
+
+    def __init__(self, cfg: TenantScopeConfig, registry,
+                 clock: Callable[[], float], flight=None,
+                 page_size: int = 0):
+        self.cfg = cfg
+        self.registry = registry
+        self.clock = clock
+        self.flight = flight
+        self.page_size = int(page_size)
+        self.tenants: "OrderedDict[str, _Cell]" = OrderedDict()
+        self._rid_tenant: dict = {}
+        self._rid_pages: dict = {}
+        # (prefix_len, rolling_hash) → tenant, first-writer-wins: the
+        # same identity TierStore keys entries by, so a demoted block
+        # resolves its owner without carrying a rid through the tree
+        self._block_owner: OrderedDict = OrderedDict()
+        # page-second integral of the whole pool, updated at the same
+        # events (same clock reads) as the per-tenant integrals — the
+        # independent side of the conservation test
+        self.pool_pages_held = 0
+        self.pool_page_seconds = 0.0
+        self._pool_last_t: Optional[float] = None
+        # noisy-neighbor episode state (edge-triggered)
+        self.episodes = 0
+        self.active_episode: Optional[dict] = None
+        self.last_episode: Optional[dict] = None
+        self._last_check = -float("inf")
+        self._last_end = -float("inf")
+
+    # ------------------------------------------------------------ plumbing
+    def _cell(self, tenant_id: str) -> _Cell:
+        tid = str(tenant_id)
+        cell = self.tenants.get(tid)
+        if cell is None:
+            if len(self.tenants) >= self.cfg.max_tenants:
+                tid = OVERFLOW_TENANT
+                cell = self.tenants.get(tid)
+                if cell is None:
+                    cell = self.tenants[tid] = _Cell(self.cfg.reservoir)
+            else:
+                cell = self.tenants[tid] = _Cell(self.cfg.reservoir)
+        return cell
+
+    def _resolve(self, tenant_id: str) -> str:
+        tid = str(tenant_id)
+        if tid in self.tenants:
+            return tid
+        if len(self.tenants) >= self.cfg.max_tenants:
+            return OVERFLOW_TENANT
+        return tid
+
+    def _count(self, name: str, tenant: str, n: int = 1) -> None:
+        self.registry.counter(
+            labeled_name(name, tenant=tenant)).inc(n)
+
+    # ----------------------------------------------------------- intake
+    def on_submit(self, req) -> None:
+        tid = self._resolve(getattr(req, "tenant_id", "default"))
+        cell = self._cell(tid)
+        now = self.clock()
+        cell.submitted += 1
+        cell.arrivals.append(now)
+        self._rid_tenant[req.rid] = tid
+        self._count("Serve/tenant_submitted", tid)
+        if now - self._last_check >= self.cfg.check_interval_s:
+            self._last_check = now
+            self._detect(now)
+
+    def on_shed(self, tenant_id) -> None:
+        tid = self._resolve("default" if tenant_id is None else tenant_id)
+        self._cell(tid).sheds += 1
+        self._count("Serve/tenant_sheds", tid)
+
+    def on_admit(self, req, workload: Optional[dict] = None) -> None:
+        tid = self._rid_tenant.get(req.rid)
+        if tid is None:
+            tid = self._resolve(getattr(req, "tenant_id", "default"))
+            self._rid_tenant[req.rid] = tid
+        cell = self._cell(tid)
+        cell.admitted += 1
+        cell.prompt_tokens += int(req.prompt_len)
+        self._count("Serve/tenant_admitted", tid)
+        self._count("Serve/tenant_prompt_tokens", tid,
+                    int(req.prompt_len))
+        if workload is not None:
+            shared = int(workload.get("shared_prefix_tokens") or 0)
+            cell.shared_prefix_tokens += shared
+            self._count("Serve/tenant_shared_prefix_tokens", tid, shared)
+
+    def on_requeue(self, req) -> None:
+        tid = self._rid_tenant.get(req.rid)
+        if tid is None:
+            tid = self._resolve(getattr(req, "tenant_id", "default"))
+            self._rid_tenant[req.rid] = tid
+        self._cell(tid).requeues += 1
+        self._count("Serve/tenant_requeues", tid)
+
+    def on_adopt(self, req) -> None:
+        """A request imported from another replica (disaggregated
+        handoff / failover): learn its rid → tenant binding BEFORE the
+        pool admission fires the pages hook."""
+        self._rid_tenant[req.rid] = self._resolve(
+            getattr(req, "tenant_id", "default"))
+
+    # -------------------------------------------------------- retirement
+    def on_retire(self, req) -> None:
+        """Terminal attribution at the engine's ``_store_result``
+        funnel. OK retirements credit ``len(req.tokens)`` — the same
+        expression ``ServingStats.on_retire`` adds to
+        ``Serve/completed_tokens`` — so Σ per-tenant completed tokens
+        equals that counter exactly."""
+        tid = self._rid_tenant.pop(req.rid, None)
+        if tid is None:
+            tid = self._resolve(getattr(req, "tenant_id", "default"))
+        cell = self._cell(tid)
+        status = getattr(req.status, "value", str(req.status))
+        if status == "ok":
+            n = len(req.tokens)
+            cell.retired_ok += 1
+            cell.completed_tokens += n
+            self._count("Serve/tenant_completed_tokens", tid, n)
+            self._count("Serve/tenant_retired", tid)
+        elif status == "timeout":
+            cell.timeouts += 1
+            self._count("Serve/tenant_timeouts", tid)
+        elif status == "cancelled":
+            cell.cancelled += 1
+            self._count("Serve/tenant_cancelled", tid)
+        elif status == "shed":
+            cell.sheds += 1
+            self._count("Serve/tenant_sheds", tid)
+        else:
+            cell.nonfinite += 1
+            self._count("Serve/tenant_nonfinite", tid)
+        at = getattr(req, "admit_t", None)
+        if at is not None:
+            cell.queue_wait.append(at - req.submit_t)
+        ft = getattr(req, "first_token_t", None)
+        if ft is not None:
+            cell.ttft.append(ft - req.submit_t)
+            n = len(req.tokens)
+            if req.finish_t is not None and n > 1:
+                cell.tpot.append((req.finish_t - ft) / (n - 1))
+        self._publish_shares()
+
+    # ------------------------------------------------------ KV attribution
+    def on_pages(self, rid: int, delta: int) -> None:
+        """``PagePool`` hook: integrate page-seconds per tenant AND for
+        the whole pool at the same clock read, so the two integrals
+        agree interval-by-interval (the conservation test's two sides).
+        Deltas net to zero per rid (admit +n, truncate −k, release
+        −(n−k)), so a drained pool always integrates at its true
+        occupancy."""
+        now = self.clock()
+        tid = self._rid_tenant.get(rid, "default")
+        cell = self._cell(tid)
+        if cell.last_page_t is not None and cell.pages_held > 0:
+            cell.page_seconds += cell.pages_held * (now - cell.last_page_t)
+        cell.pages_held = max(0, cell.pages_held + int(delta))
+        cell.last_page_t = now
+        if self._pool_last_t is not None and self.pool_pages_held > 0:
+            self.pool_page_seconds += (
+                self.pool_pages_held * (now - self._pool_last_t))
+        self.pool_pages_held = max(0, self.pool_pages_held + int(delta))
+        self._pool_last_t = now
+        held = self._rid_pages.get(rid, 0) + int(delta)
+        if held <= 0:
+            self._rid_pages.pop(rid, None)
+        else:
+            self._rid_pages[rid] = held
+
+    def on_blocks(self, req) -> None:
+        """Register ``req``'s full prompt blocks as owned by its tenant
+        (first writer wins — the prefix tree's own sharing rule), keyed
+        exactly like ``TierStore`` entries, so a later demotion of any
+        of these blocks bills its resident bytes to this tenant."""
+        if self.page_size <= 0:
+            return
+        tid = self._rid_tenant.get(req.rid)
+        if tid is None:
+            tid = self._resolve(getattr(req, "tenant_id", "default"))
+        for key in prefix_hashes(req.prompt, self.page_size):
+            if key not in self._block_owner:
+                self._block_owner[key] = tid
+                if len(self._block_owner) > self.cfg.block_owner_cap:
+                    self._block_owner.popitem(last=False)
+
+    def block_owner(self, tokens) -> Optional[str]:
+        """Owner tenant of one demoted block's full token prefix (the
+        demote-drain's ``TierStore.put(..., owner=...)`` argument)."""
+        toks = tuple(int(t) for t in tokens)
+        return self._block_owner.get((len(toks), token_hash(toks)))
+
+    # ------------------------------------------------------------ fairness
+    def _flush_integrals(self, now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        for cell in self.tenants.values():
+            if cell.last_page_t is not None and cell.pages_held > 0:
+                cell.page_seconds += (
+                    cell.pages_held * (now - cell.last_page_t))
+            cell.last_page_t = now
+        if self._pool_last_t is not None and self.pool_pages_held > 0:
+            self.pool_page_seconds += (
+                self.pool_pages_held * (now - self._pool_last_t))
+        self._pool_last_t = now
+
+    def _publish_shares(self) -> None:
+        total = sum(c.completed_tokens for c in self.tenants.values())
+        g = self.registry.gauge
+        j = jain_index(
+            c.completed_tokens for c in self.tenants.values())
+        if j is not None:
+            g("Serve/tenant_fairness_jain").set(j)
+        g("Serve/tenant_count").set(float(len(self.tenants)))
+        if total > 0:
+            for tid, cell in self.tenants.items():
+                g(labeled_name("Serve/tenant_goodput_share",
+                               tenant=tid)).set(
+                    cell.completed_tokens / total)
+
+    def fairness(self, tiers: Optional[dict] = None) -> dict:
+        """Jain's index over completed tokens plus each tenant's
+        dominant-resource share: max of its token share, current
+        HBM-page share, and resident tier-byte share."""
+        self._flush_integrals()
+        tok_total = sum(c.completed_tokens for c in self.tenants.values())
+        page_total = sum(c.pages_held for c in self.tenants.values())
+        tier_by_tenant: dict = {}
+        tier_total = 0
+        for store in (tiers or {}).values():
+            ob = getattr(store, "owner_bytes", None) or {}
+            for tid, b in ob.items():
+                tier_by_tenant[tid] = tier_by_tenant.get(tid, 0) + b
+            tier_total += getattr(store, "bytes_used", 0)
+        dom = {}
+        for tid, cell in self.tenants.items():
+            shares = []
+            if tok_total > 0:
+                shares.append(cell.completed_tokens / tok_total)
+            if page_total > 0:
+                shares.append(cell.pages_held / page_total)
+            if tier_total > 0:
+                shares.append(tier_by_tenant.get(tid, 0) / tier_total)
+            dom[tid] = max(shares) if shares else 0.0
+        return {
+            "jain": jain_index(
+                c.completed_tokens for c in self.tenants.values()),
+            "dominant_shares": dom,
+            "n_tenants": len(self.tenants),
+        }
+
+    # -------------------------------------------------- noisy neighbor
+    def _burn_max(self) -> float:
+        worst = 0.0
+        for which in ("ttft", "tpot", "error"):
+            gauge = self.registry.gauge(f"Serve/slo_{which}_burn")
+            if gauge.updated and gauge.value == gauge.value:
+                worst = max(worst, gauge.value)
+        return worst
+
+    def _detect(self, now: float) -> None:
+        """Edge-triggered: a single tenant dominating the arrival window
+        while the fleet burns SLO budget opens one episode (flight
+        why-marker + incident dump); the episode closes when either
+        signal clears. Needs >= 2 tenants — a noisy *neighbor* needs a
+        neighbor."""
+        cut = now - self.cfg.window_s
+        counts = {}
+        for tid, cell in self.tenants.items():
+            while cell.arrivals and cell.arrivals[0] < cut:
+                cell.arrivals.popleft()
+            if cell.arrivals:
+                counts[tid] = len(cell.arrivals)
+        total = sum(counts.values())
+        burst_tid, share = None, 0.0
+        if total > 0 and len(self.tenants) >= 2:
+            burst_tid = max(counts, key=counts.get)
+            share = counts[burst_tid] / total
+            if (counts[burst_tid] < self.cfg.min_burst_arrivals
+                    or share < self.cfg.burst_share):
+                burst_tid = None
+        burn = self._burn_max()
+        firing = (burst_tid is not None
+                  and burn >= self.cfg.burn_threshold)
+        g = self.registry.gauge
+        if firing and self.active_episode is None:
+            if now - self._last_end < self.cfg.cooldown_s:
+                return
+            self.episodes += 1
+            self.active_episode = {
+                "tenant": burst_tid, "t0": now, "share": share,
+                "burn": burn, "arrivals": counts.get(burst_tid, 0),
+            }
+            self.registry.counter("Serve/tenant_noisy_episodes").inc()
+            g("Serve/tenant_noisy_active").set(1.0)
+            if self.flight is not None:
+                self.flight.note("noisy_neighbor", t=now,
+                                 tenant=burst_tid,
+                                 share=round(share, 4),
+                                 burn=round(burn, 4))
+                self.flight.dump("noisy_neighbor")
+        elif not firing and self.active_episode is not None:
+            ep = dict(self.active_episode)
+            ep["t1"] = now
+            ep["duration_s"] = now - ep["t0"]
+            self.last_episode = ep
+            self.active_episode = None
+            self._last_end = now
+            g("Serve/tenant_noisy_active").set(0.0)
+
+    # ------------------------------------------------------------- readout
+    def report(self, tiers: Optional[dict] = None) -> dict:
+        """The full per-tenant breakdown: one row per tenant, totals
+        that are sums of the rows (conservation by construction — the
+        tests pin them against the fleet's own counters), the fairness
+        block, and the noisy-neighbor state. ``tiers`` maps tier kind →
+        TierStore so resident bytes split by owner."""
+        self._flush_integrals()
+        tier_rows: dict = {}
+        for kind, store in (tiers or {}).items():
+            ob = dict(getattr(store, "owner_bytes", None) or {})
+            used = getattr(store, "bytes_used", 0)
+            unowned = used - sum(ob.values())
+            if unowned > 0:
+                ob[UNOWNED] = unowned
+            tier_rows[kind] = ob
+        rows = {}
+        for tid, c in self.tenants.items():
+            rows[tid] = {
+                "submitted": c.submitted, "admitted": c.admitted,
+                "retired_ok": c.retired_ok,
+                "completed_tokens": c.completed_tokens,
+                "prompt_tokens": c.prompt_tokens,
+                "shared_prefix_tokens": c.shared_prefix_tokens,
+                "prefix_overlap": (
+                    c.shared_prefix_tokens / c.prompt_tokens
+                    if c.prompt_tokens else None),
+                "sheds": c.sheds, "timeouts": c.timeouts,
+                "cancelled": c.cancelled, "nonfinite": c.nonfinite,
+                "requeues": c.requeues,
+                "pages_held": c.pages_held,
+                "page_seconds": c.page_seconds,
+                "tier_bytes": {k: v.get(tid, 0)
+                               for k, v in tier_rows.items()},
+                "queue_wait_p50_s": _pct(c.queue_wait, 0.50),
+                "queue_wait_p95_s": _pct(c.queue_wait, 0.95),
+                "ttft_p50_s": _pct(c.ttft, 0.50),
+                "ttft_p95_s": _pct(c.ttft, 0.95),
+                "tpot_p50_s": _pct(c.tpot, 0.50),
+                "tpot_p95_s": _pct(c.tpot, 0.95),
+            }
+        tok_total = sum(r["completed_tokens"] for r in rows.values())
+        for tid, r in rows.items():
+            r["goodput_share"] = (
+                r["completed_tokens"] / tok_total if tok_total else None)
+        totals = {
+            "submitted": sum(r["submitted"] for r in rows.values()),
+            "admitted": sum(r["admitted"] for r in rows.values()),
+            "completed_tokens": tok_total,
+            "prompt_tokens": sum(r["prompt_tokens"]
+                                 for r in rows.values()),
+            "sheds": sum(r["sheds"] for r in rows.values()),
+            "requeues": sum(r["requeues"] for r in rows.values()),
+            "page_seconds": sum(r["page_seconds"]
+                                for r in rows.values()),
+            "pool_page_seconds": self.pool_page_seconds,
+        }
+        fair = self.fairness(tiers=tiers)
+        g = self.registry.gauge
+        if fair["jain"] is not None:
+            g("Serve/tenant_fairness_jain").set(fair["jain"])
+        for tid, share in fair["dominant_shares"].items():
+            g(labeled_name("Serve/tenant_dominant_share",
+                           tenant=tid)).set(share)
+        for tid, r in rows.items():
+            g(labeled_name("Serve/tenant_page_seconds",
+                           tenant=tid)).set(r["page_seconds"])
+            for kind, b in r["tier_bytes"].items():
+                g(labeled_name(f"Serve/tenant_{kind}_bytes",
+                               tenant=tid)).set(float(b))
+        self._publish_shares()
+        return {
+            "schema": "dstpu.tenantscope.v1",
+            "tenants": rows,
+            "totals": totals,
+            "fairness": fair,
+            "noisy": {
+                "episodes": self.episodes,
+                "active": self.active_episode,
+                "last": self.last_episode,
+            },
+        }
+
+    def snapshot(self) -> dict:
+        return self.report()
+
+    def breakdown_text(self) -> str:
+        """Flight artifact provider (``tenant_breakdown.json``): every
+        flight/incident dump carries the current per-tenant breakdown."""
+        return json.dumps(self.report(), indent=1, default=str)

@@ -362,6 +362,32 @@ class ServingEngine:
             if self.flight is not None:
                 self.flight.add_snapshot_provider("kv_residency",
                                                   self.kvscope.snapshot)
+        # Per-tenant cost attribution & fairness observatory
+        # (observability/tenantscope.py, docs/OBSERVABILITY.md): a
+        # ledger keyed by Request.tenant_id on the injectable clock —
+        # tokens/latency at the retirement funnel, KV page-seconds
+        # through the pool's on_pages hook, resident tier bytes through
+        # TierStore owner accounting, Jain fairness + the edge-triggered
+        # noisy-neighbor detector (flight why-marker + incident
+        # breakdown artifact). None (default) builds nothing — one
+        # `is not None` per submit/admission/retirement, zero programs,
+        # zero syncs (the compile-freeze gates stay the oracle).
+        self.tenantscope = None
+        if self.cfg.tenantscope is not None and self.cfg.tenantscope.enabled:
+            from ..observability.tenantscope import TenantScope
+
+            self.tenantscope = TenantScope(
+                self.cfg.tenantscope, registry=self.stats.registry,
+                clock=self.stats.clock, flight=self.flight,
+                page_size=self.cfg.page_size)
+            if self.pool is not None:
+                self.pool.on_pages = self.tenantscope.on_pages
+            if self.flight is not None:
+                # every flight/incident dump carries the per-tenant
+                # breakdown — the noisy-neighbor episode's evidence
+                self.flight.add_artifact_provider(
+                    "tenant_breakdown.json",
+                    self.tenantscope.breakdown_text)
         self.sched = Scheduler(self.cfg.slots, self.cfg.max_len,
                                self.cfg.prefill_chunk,
                                max_queue=self.cfg.max_queue,
@@ -748,7 +774,7 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                seed: int = 0, ttft_deadline_s: Optional[float] = None,
                total_deadline_s: Optional[float] = None,
-               session_id=None) -> int:
+               session_id=None, tenant_id=None) -> int:
         """Queue one request; returns its request id. Tokens sample with
         a per-request RNG folded from ``seed`` — bit-identical (up to eos
         truncation) to ``engine.generate(prompt[None], max_new,
@@ -759,19 +785,31 @@ class ServingEngine:
         ``ttft_deadline_s`` / ``total_deadline_s`` override the config
         defaults for this request (0 disables); ``session_id`` (opaque,
         hashable) keys session-lifecycle tracking (kvscope / workload)
-        and fleet affinity. Raises
+        and fleet affinity; ``tenant_id`` (optional string, default
+        ``"default"``) is the cost-attribution dimension
+        (observability/tenantscope.py). Raises
         :class:`~..resilience.guards.QueueFullError` (status ``SHED``)
         when the queue is at ``max_queue`` or the engine is draining."""
         if self._draining:
             self.stats.on_shed(self.sched.queue_depth)
+            if self.tenantscope is not None:
+                self.tenantscope.on_shed(tenant_id)
             raise QueueFullError("serving engine is draining; request shed",
                                  queue_depth=self.sched.queue_depth,
                                  max_queue=self.cfg.max_queue)
         max_new = int(max_new_tokens or self.engine.config.max_out_tokens)
-        req = self.sched.submit(prompt, max_new, seed,
-                                ttft_deadline_s=ttft_deadline_s,
-                                total_deadline_s=total_deadline_s,
-                                session_id=session_id)
+        try:
+            req = self.sched.submit(prompt, max_new, seed,
+                                    ttft_deadline_s=ttft_deadline_s,
+                                    total_deadline_s=total_deadline_s,
+                                    session_id=session_id,
+                                    tenant_id=tenant_id)
+        except QueueFullError:
+            # typed shed (queue full / pool can never fit it): billed to
+            # the tenant even though no Request object exists yet
+            if self.tenantscope is not None:
+                self.tenantscope.on_shed(tenant_id)
+            raise
         if req.deadline_ttft is not None or req.deadline_total is not None:
             self._any_deadlines = True
         if self.capture is not None:
@@ -782,6 +820,8 @@ class ServingEngine:
         if self.loadscope is not None:
             self.loadscope.on_submit(len(req.prompt), req.max_new,
                                      self.sched.queue_depth)
+        if self.tenantscope is not None:
+            self.tenantscope.on_submit(req)
         return req.rid
 
     def requeue(self, req: Request) -> Request:
@@ -795,6 +835,8 @@ class ServingEngine:
         self.sched.requeue(req)
         if req.deadline_ttft is not None or req.deadline_total is not None:
             self._any_deadlines = True
+        if self.tenantscope is not None:
+            self.tenantscope.on_requeue(req)
         return req
 
     def cancel(self, rid: int) -> Optional[Request]:
@@ -852,11 +894,16 @@ class ServingEngine:
             if self._prefill is None:
                 req = self.sched.pop_next()
                 if req is not None:
+                    wa = None
                     if self.workload is not None:
                         # admission hook: score the prompt's prefix overlap
                         # / self-speculation potential (host-side only)
-                        self.workload.on_admit(req.prompt,
-                                               session_id=req.session_id)
+                        wa = self.workload.on_admit(req.prompt,
+                                                    session_id=req.session_id)
+                    if self.tenantscope is not None:
+                        # partition the same estimate by tenant (prompt
+                        # tokens, shared-prefix overlap)
+                        self.tenantscope.on_admit(req, workload=wa)
                     if self.kvscope is not None:
                         # residency probe beside it: ghost-tree regret
                         # match + session resume edge (host-side only)
@@ -1040,6 +1087,11 @@ class ServingEngine:
             # session idle edge: the byte-seconds-held-while-idle meter
             # starts when a session's LAST live request terminates
             self.kvscope.on_retire(req)
+        if self.tenantscope is not None:
+            # terminal attribution: OK retirements credit the tenant
+            # with the SAME len(req.tokens) ServingStats.on_retire adds
+            # to Serve/completed_tokens — per-tenant sums conserve it
+            self.tenantscope.on_retire(req)
         if self.capture is not None:
             self.capture.on_result(req)
         if self._request_logs or self.flight is not None:
@@ -1130,6 +1182,10 @@ class ServingEngine:
             # the prompt's blocks are in the pool now: index them for
             # future sharing and release the copy-on-write source pin
             self.pool.on_inserted(req.rid, req.prompt)
+            if self.tenantscope is not None:
+                # first-writer block ownership: a later demotion of any
+                # of these blocks bills its tier bytes to this tenant
+                self.tenantscope.on_blocks(req)
         else:
             ins = self._prog("insert", lambda: jax.jit(
                 insert_request, donate_argnums=(0,)))
@@ -1262,9 +1318,15 @@ class ServingEngine:
                 self.demote_wait_s += max(0.0, self.stats.clock() - t0)
                 pressured = True
             for i, e in enumerate(batch):
-                self.pool.host.put(e["tokens"],
-                                   {k: np.ascontiguousarray(v[:, i])
-                                    for k, v in tiles.items()})
+                self.pool.host.put(
+                    e["tokens"],
+                    {k: np.ascontiguousarray(v[:, i])
+                     for k, v in tiles.items()},
+                    # tier-byte attribution: the tenant whose request
+                    # first wrote this block (None when tenantscope is
+                    # off or the block predates it)
+                    owner=(self.tenantscope.block_owner(e["tokens"])
+                           if self.tenantscope is not None else None))
         if pressured:
             self.stats.registry.set_gauges({
                 "Serve/host_tier_demote_wait_s": self.demote_wait_s})
@@ -1404,6 +1466,10 @@ class ServingEngine:
                                "(set serving.page_size)")
         if not self.sched.free:
             return False
+        if self.tenantscope is not None:
+            # rid → tenant binding must exist BEFORE try_admit fires the
+            # pool's on_pages hook, or the pages bill to "default"
+            self.tenantscope.on_adopt(req)
         # book_savings=False: seating already-computed KV skips no
         # prefill — the SOURCE replica owns the savings accounting
         alloc = self.pool.try_admit(req.prompt, req.max_new, req.rid,
@@ -1430,6 +1496,8 @@ class ServingEngine:
                               {k: jnp.asarray(v) for k, v in payload.items()},
                               jnp.asarray(alloc.row), jnp.int32(alloc.shared))
             self.pool.on_inserted(req.rid, req.prompt)
+            if self.tenantscope is not None:
+                self.tenantscope.on_blocks(req)
         if self.kvscope is not None:
             # decode-side session intake: residency moves here (no
             # regret probe — this replica paid no prefill)
@@ -1438,17 +1506,20 @@ class ServingEngine:
         return True
 
     def serve_batch(self, prompts, max_new_tokens=None, seeds=None,
-                    session_ids=None) -> list:
+                    session_ids=None, tenant_ids=None) -> list:
         """Convenience: submit a list of (ragged) prompts, drain, return
         each request's tokens as an int32 array, in submission order.
-        ``max_new_tokens``, ``seeds``, and ``session_ids`` may be
-        scalars or per-request lists. Results are collected (popped) —
-        repeated calls on one engine don't accumulate host state."""
+        ``max_new_tokens``, ``seeds``, ``session_ids``, and
+        ``tenant_ids`` may be scalars or per-request lists. Results are
+        collected (popped) — repeated calls on one engine don't
+        accumulate host state."""
         n = len(prompts)
         mn = expand_per_request(max_new_tokens, n, None, int)
         sd = expand_per_request(seeds, n, 0, int)
         sid = expand_per_request(session_ids, n, None)
-        rids = [self.submit(p, mn[i], seed=sd[i], session_id=sid[i])
+        tid = expand_per_request(tenant_ids, n, None)
+        rids = [self.submit(p, mn[i], seed=sd[i], session_id=sid[i],
+                            tenant_id=tid[i])
                 for i, p in enumerate(prompts)]
         want = set(rids)
         got: dict[int, Request] = {}
@@ -1609,7 +1680,23 @@ class ServingEngine:
             out["goodput"] = self.goodput.snapshot()
         if self.loadscope is not None:
             out["loadscope"] = self.scaling_snapshot()
+        if self.tenantscope is not None:
+            out["tenants"] = self.tenants_snapshot()
         return out
+
+    def tenants_snapshot(self) -> Optional[dict]:
+        """The per-tenant breakdown (``GET /tenants``, doctor's
+        ``[tenants]`` section): tenantscope's report with this engine's
+        tier stores attached so resident bytes split by owner. None when
+        tenantscope is off."""
+        if self.tenantscope is None:
+            return None
+        tiers = {}
+        if self.hostkv is not None:
+            tiers["host_tier"] = self.hostkv
+        if self.nvmekv is not None:
+            tiers["nvme_tier"] = self.nvmekv
+        return self.tenantscope.report(tiers=tiers or None)
 
     def requests_table(self) -> list[dict]:
         """Live in-flight table (the ``GET /requests`` endpoint): every
@@ -1878,6 +1965,7 @@ class ServingEngine:
             ledger=ledger, census=cen, workload=wl, occupancy_avg=occ,
             commscope=commscope, kvscope=self.kv_residency(),
             loadscope=self.scaling_snapshot(),
+            tenantscope=self.tenants_snapshot(),
             pages=self.pool.snapshot() if self._paged else None,
             meta={"job": "serving", "slots": self.cfg.slots,
                   "max_len": self.cfg.max_len,
@@ -1981,7 +2069,9 @@ class ServingEngine:
                      if self.flight is not None else None),
             slo_reload_fn=self.reload_slo,
             scaling_fn=(self.scaling_snapshot
-                        if self.loadscope is not None else None))
+                        if self.loadscope is not None else None),
+            tenants_fn=(self.tenants_snapshot
+                        if self.tenantscope is not None else None))
         server = TelemetryServer(hooks, host=host, port=port, token=token)
         # bind FIRST: a failed bind (port in use) must not leave a dead
         # server object behind that makes the idempotency guard return

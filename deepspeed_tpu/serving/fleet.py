@@ -474,6 +474,7 @@ class FleetEngine:
             self.registry.counter("Fleet/requeue_sheds").inc()
             self._audit_record("requeue_shed", rid=req.rid, role=role,
                                session_id=req.session_id,
+                               tenant_id=req.tenant_id,
                                candidates=ranked,
                                lost_replica=lost_replica)
             self._adopt_result(req, "")
@@ -486,6 +487,7 @@ class FleetEngine:
         self.registry.counter("Fleet/requeued").inc()
         self._audit_record("requeue", rid=req.rid, role=role,
                            session_id=req.session_id, chosen=name,
+                           tenant_id=req.tenant_id,
                            sticky=sticky, candidates=ranked,
                            lost_replica=lost_replica)
         if self.spans is not None:
@@ -568,6 +570,7 @@ class FleetEngine:
     # --------------------------------------------------------- route audit
     def _audit_record(self, event: str, rid: Optional[int] = None,
                       role: Optional[str] = None, session_id=None,
+                      tenant_id=None,
                       chosen: Optional[str] = None,
                       sticky: Optional[str] = None,
                       affinity: Optional[str] = None,
@@ -583,6 +586,7 @@ class FleetEngine:
         entry = {
             "seq": self._audit_seq, "t": self._clock(), "event": event,
             "rid": rid, "role": role, "session_id": session_id,
+            "tenant_id": tenant_id,
             "chosen": chosen, "sticky": sticky, "affinity": affinity,
             "candidates": [
                 {"name": i["name"], "healthy": i["healthy"],
@@ -683,13 +687,14 @@ class FleetEngine:
 
     # -------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               seed: int = 0, session_id=None,
+               seed: int = 0, session_id=None, tenant_id=None,
                ttft_deadline_s: Optional[float] = None,
                total_deadline_s: Optional[float] = None) -> int:
         """Route one request into the fleet; returns its fleet-wide rid.
         Same contract as ``ServingEngine.submit`` plus ``session_id``
         (opaque, hashable): requests of one session prefer the replica
-        holding their shared prefix. Raises the same typed
+        holding their shared prefix. ``tenant_id`` rides along for
+        per-tenant attribution (tenantscope). Raises the same typed
         :class:`QueueFullError` when every eligible replica sheds."""
         role = ROLE_PREFILL if self._disagg else ROLE_SERVE
         tried: set = set()
@@ -707,7 +712,8 @@ class FleetEngine:
                 rid = eng.submit(prompt, max_new_tokens, seed=seed,
                                  ttft_deadline_s=ttft_deadline_s,
                                  total_deadline_s=total_deadline_s,
-                                 session_id=session_id)
+                                 session_id=session_id,
+                                 tenant_id=tenant_id)
                 break
             except QueueFullError as e:
                 # this replica flipped to full/draining between the
@@ -726,7 +732,7 @@ class FleetEngine:
         self._audit_record(
             "affinity_fallback" if decision["affinity"] == "miss"
             else "route",
-            rid=rid, chosen=name, **decision)
+            rid=rid, chosen=name, tenant_id=tenant_id, **decision)
         if self.spans is not None:
             # the trace context's first fleet hop: rid → replica. The
             # replica's own ring continues from its queue span.
@@ -1030,7 +1036,7 @@ class FleetEngine:
         return self.results
 
     def serve_batch(self, prompts, max_new_tokens=None, seeds=None,
-                    session_ids=None) -> list:
+                    session_ids=None, tenant_ids=None) -> list:
         """Convenience mirror of ``ServingEngine.serve_batch`` across the
         fleet: submit, drive, return each request's tokens in submission
         order (results popped)."""
@@ -1042,7 +1048,9 @@ class FleetEngine:
         mn = expand_per_request(max_new_tokens, n, None, int)
         sd = expand_per_request(seeds, n, 0, int)
         sid = expand_per_request(session_ids, n, None)
-        rids = [self.submit(p, mn[i], seed=sd[i], session_id=sid[i])
+        tid = expand_per_request(tenant_ids, n, None)
+        rids = [self.submit(p, mn[i], seed=sd[i], session_id=sid[i],
+                            tenant_id=tid[i])
                 for i, p in enumerate(prompts)]
         want = set(rids)
         got: dict = {}

@@ -497,6 +497,13 @@ class PagePool:
         # admission/eviction, nothing else.
         self.host = None
         self.on_demote = None
+        # page-residency seam (observability/tenantscope.py): called as
+        # ``on_pages(rid, delta)`` with the SAME page counts the pool
+        # books — +pages at admission, -pages at truncate rollback,
+        # -pages at release — so a per-tenant page-second integral sums
+        # to the pool's own occupancy exactly. None (default) = one
+        # `is not None` per admission/release, nothing else.
+        self.on_pages = None
         # cumulative accounting (the capacity advisor's "achieved" side).
         # `evictions` counts PAGES freed by tree eviction (the historical
         # meaning, kept); `eviction_events` counts eviction PASSES — one
@@ -786,6 +793,8 @@ class PagePool:
             self.registry.histogram(
                 "Serve/pages_per_request").observe(total_need)
         self._publish()
+        if self.on_pages is not None:
+            self.on_pages(rid, total_need)
         return alloc
 
     # ---------------------------------------------------------- completion
@@ -830,6 +839,10 @@ class PagePool:
             self._unref(int(page))
         self.generation += 1
         self._publish()
+        if self.on_pages is not None:
+            # alloc.pages already reflects any truncate rewinds, so the
+            # admission/truncate/release deltas net to zero per rid
+            self.on_pages(rid, -alloc.pages)
 
     def truncate(self, rid: int, new_tokens: int) -> int:
         """Page-table-aware rollback: rewind ``rid``'s live extent to
@@ -873,6 +886,8 @@ class PagePool:
         alloc.pages = keep
         self.generation += 1
         self._publish()
+        if self.on_pages is not None:
+            self.on_pages(rid, -freed)
         return freed
 
     # -------------------------------------------------------------- readout

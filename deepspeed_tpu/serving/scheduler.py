@@ -129,6 +129,10 @@ class Request:
     attempts: int = 0
     # fleet session affinity key (None outside the fleet router)
     session_id: "object | None" = None
+    # cost-attribution dimension (observability/tenantscope.py): which
+    # tenant this request bills to. "default" when the caller never set
+    # one — the inert value every pre-tenant record upgrades to.
+    tenant_id: str = "default"
     submit_t: float = 0.0
     admit_t: Optional[float] = None       # left the queue (prefill starts)
     first_token_t: Optional[float] = None
@@ -221,7 +225,7 @@ class Scheduler:
     def submit(self, prompt, max_new: int, seed: int = 0,
                ttft_deadline_s: Optional[float] = None,
                total_deadline_s: Optional[float] = None,
-               session_id=None) -> Request:
+               session_id=None, tenant_id: Optional[str] = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -252,7 +256,9 @@ class Scheduler:
             rid = self._next_rid
             self._next_rid += 1
         req = Request(rid=rid, prompt=prompt, max_new=int(max_new),
-                      seed=int(seed), session_id=session_id)
+                      seed=int(seed), session_id=session_id,
+                      tenant_id="default" if tenant_id is None
+                      else str(tenant_id))
         self.queue.append(req)
         req.submit_t = self.stats.on_submit(len(self.queue))
         ttft = self.ttft_deadline_s if ttft_deadline_s is None \
@@ -592,6 +598,7 @@ class Scheduler:
                 # status and move count while it waits again
                 "status": req.status.value,
                 "attempts": req.attempts,
+                "tenant_id": req.tenant_id,
                 # live hop decomposition: hops the request has completed
                 # so far (the rest null) — /requests shows where an
                 # in-flight request's time is going

@@ -94,6 +94,14 @@ class TierStore:
         self.clock = clock if clock is not None else (lambda: 0.0)
         self.entries: OrderedDict = OrderedDict()
         self.bytes_used = 0
+        # per-owner resident bytes (observability/tenantscope.py): every
+        # entry carries the tenant that first demoted its block, and the
+        # five bookkeeping paths that move bytes_used (put, replace,
+        # prune, corrupt-drop, consume-pop) move the owner's cell by the
+        # SAME nbytes — so Σ owner_bytes == bytes_used exactly whenever
+        # every put carried an owner, and owner attribution survives the
+        # spill chain down to the NVMe rung.
+        self.owner_bytes: dict = {}
         # the rung below (wired by TieringEngine): prune victims spill
         # there instead of vanishing
         self.spill_to: Optional["TierStore"] = None
@@ -144,6 +152,15 @@ class TierStore:
         if self.registry is not None and n:
             self.registry.counter(name).inc(n)
 
+    def _owner_delta(self, owner, nbytes: int) -> None:
+        if owner is None:
+            return
+        b = self.owner_bytes.get(owner, 0) + int(nbytes)
+        if b <= 0:
+            self.owner_bytes.pop(owner, None)
+        else:
+            self.owner_bytes[owner] = b
+
     @property
     def pressure(self) -> bool:
         """True when the tier cannot fit another typical page without
@@ -154,12 +171,14 @@ class TierStore:
         return self.capacity_bytes - self.bytes_used < mean
 
     # ------------------------------------------------------------- demotion
-    def put(self, tokens, tiles: dict) -> bool:
+    def put(self, tokens, tiles: dict, owner=None) -> bool:
         """Store one demoted page: ``tokens`` is the full token prefix
         the tree entry cached (its identity), ``tiles`` the page's raw
         host arrays. Over-budget puts prune LRU (unpinned) entries; a
         page larger than the whole budget is skipped, counted, never an
-        error. Returns whether the page was kept."""
+        error. ``owner`` (optional tenant id) bills the page's bytes in
+        ``owner_bytes`` for as long as it is resident at this rung.
+        Returns whether the page was kept."""
         toks = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
         nbytes = sum(int(v.nbytes) for v in tiles.values())
         if nbytes > self.capacity_bytes:
@@ -181,14 +200,17 @@ class TierStore:
                 return False
             self.entries.pop(key)
             self.bytes_used -= old["nbytes"]
+            self._owner_delta(old.get("owner"), -old["nbytes"])
             self._discard(old)
         ent = {
             "tokens": toks, "tiles": None, "nbytes": nbytes,
             "crc": tiles_crc(tiles), "t": self.clock(), "pinned": False,
+            "owner": owner,
         }
         self._attach(key, ent, tiles)
         self.entries[key] = ent
         self.bytes_used += nbytes
+        self._owner_delta(owner, nbytes)
         self.demotes += 1
         self.demote_bytes += nbytes
         self._count(f"Serve/{self.kind}_demotes")
@@ -227,13 +249,14 @@ class TierStore:
                 return
             ent = self.entries.pop(victim)
             self.bytes_used -= ent["nbytes"]
+            self._owner_delta(ent.get("owner"), -ent["nbytes"])
             self.prunes += 1
             self.pruned_bytes += ent["nbytes"]
             self._count(f"Serve/{self.kind}_prunes")
             if self.spill_to is not None:
                 tiles = self._verify(ent)
-                if tiles is not None and self.spill_to.put(ent["tokens"],
-                                                           tiles):
+                if tiles is not None and self.spill_to.put(
+                        ent["tokens"], tiles, owner=ent.get("owner")):
                     self.spills += 1
                     self._count(f"Serve/{self.kind}_spills")
             self._discard(ent)
@@ -269,6 +292,7 @@ class TierStore:
             # block — the tier degrades, serving never crashes
             self.entries.pop(key, None)
             self.bytes_used -= ent["nbytes"]
+            self._owner_delta(ent.get("owner"), -ent["nbytes"])
             self.fallbacks += 1
             self._count(f"Serve/{self.kind}_fallbacks")
             self._discard(ent)
@@ -337,6 +361,7 @@ class TierStore:
         staged by ``match_one`` — ride out on the returned entry."""
         ent = self.entries.pop(key)
         self.bytes_used -= ent["nbytes"]
+        self._owner_delta(ent.get("owner"), -ent["nbytes"])
         self.hits += 1
         self._count(f"Serve/{self.kind}_hits")
         self._discard(ent)
@@ -415,6 +440,7 @@ class TierStore:
             "pruned_bytes": self.pruned_bytes,
             "spills": self.spills,
             "fallbacks": self.fallbacks,
+            "owner_bytes": dict(self.owner_bytes),
         }
         out.update(self._snapshot_extra())
         return out
@@ -610,8 +636,8 @@ class TieringEngine:
     def pressure(self) -> bool:
         return self.stores[0].pressure
 
-    def put(self, tokens, tiles: dict) -> bool:
-        return self.stores[0].put(tokens, tiles)
+    def put(self, tokens, tiles: dict, owner=None) -> bool:
+        return self.stores[0].put(tokens, tiles, owner=owner)
 
     def holds(self, tokens, key=None) -> bool:
         return any(st.holds(tokens, key=key) for st in self.stores)

@@ -406,23 +406,79 @@ def test_request_record_v2_upgrades_to_trace(setup):
         assert it < 100_000
     rows = [request_record(done[r]) for r in rids]
     rec = rows[0]
-    assert rec["schema"] == "dstpu.request_record.v2"
+    assert rec["schema"] == "dstpu.request_record.v3"
+    assert rec["tenant_id"] == "default"    # never set → the inert value
     assert isinstance(rec["prompt"], list) and rec["seed"] >= 700
     assert rec["total_deadline_s"] == pytest.approx(60.0)
     assert rec["ttft_deadline_s"] is None
-    # v2 rows + one v1-ish row lacking replay fields → upgrade skips it
+    # v3 rows + true v2 rows (no tenant_id) + one v1-ish row lacking
+    # replay fields → the upgrade defaults the v2 tenants (counted in
+    # meta) and skips only the v1 row — never a crash
+    v2 = {k: v for k, v in rows[1].items() if k != "tenant_id"}
+    v2["rid"] = 12345                       # distinct request, v2 shape
     legacy = {"rid": 99, "status": "ok", "tokens": 4}
-    tr, skipped = trace_from_request_log(rows + [legacy])
+    tr, skipped = trace_from_request_log(rows + [v2, legacy])
     assert skipped == 1
-    assert len(tr.requests) == len(rows)
+    assert len(tr.requests) == len(rows) + 1
+    assert tr.meta["tenantless_rows"] == 1
     assert tr.validate() == []
     assert tr.requests[0]["total_deadline_s"] == pytest.approx(60.0)
+    # default tenants are not materialized in the trace (byte-stable
+    # with pre-tenant captures); replay bills them to "default"
+    assert all("tenant_id" not in e for e in tr.requests)
     # no recorded outputs in a request log → the oracle degrades to None
     rc = ReplayClock(dt=1e-3)
     rep = ReplayDriver(ds.ServingEngine(eng, _serving(), clock=rc), tr,
                        clock=rc).run()
     assert rep.parity is None and rep.replayed == len(tr.requests)
     srv.close()
+
+
+# ------------------------------------------------------- tenant co-fidelity
+def test_capture_carries_tenants_and_replay_is_bit_identical(setup):
+    """Captured traces carry tenant ids VERBATIM, a tenant-labeled
+    replay is bit-identical to the recorded outputs, and the replayed
+    engine re-attributes the same tenants — while tenant-free captures
+    stay byte-identical to the pre-tenant layout (no tenant_id keys)."""
+    _, _, _, eng = setup
+    clock = ReplayClock(dt=1e-3)
+    srv = ds.ServingEngine(eng, _serving({"capture": True}), clock=clock)
+    reqs = _reqs(4, seed=6)
+    tenants = ["acme", "umbrella", "acme", None]
+    outs = srv.serve_batch([p for p, _, _ in reqs],
+                           [mn for _, mn, _ in reqs],
+                           [sd for _, _, sd in reqs],
+                           tenant_ids=tenants)
+    trace = srv.capture.trace()
+    assert trace.validate() == []
+    assert [e.get("tenant_id") for e in trace.requests] \
+        == ["acme", "umbrella", "acme", None]   # default = unrecorded
+    srv.close()
+
+    rc = ReplayClock(dt=1e-3)
+    target = ds.ServingEngine(
+        eng, _serving({"tenantscope": True}), clock=rc)
+    rep = ReplayDriver(target, trace, clock=rc).run()
+    assert rep.parity is True and rep.matched == 4
+    snap = target.tenants_snapshot()
+    assert set(snap["tenants"]) == {"acme", "umbrella", "default"}
+    assert snap["tenants"]["acme"]["retired_ok"] == 2
+    assert sum(r["completed_tokens"] for r in snap["tenants"].values()) \
+        == sum(len(t) for t in outs)
+    target.close()
+
+    # a tenant-free capture emits NO tenant_id keys at all: old traces
+    # (and their byte layout) are unchanged by the v3 dimension
+    clock2 = ReplayClock(dt=1e-3)
+    srv2 = ds.ServingEngine(eng, _serving({"capture": True}),
+                            clock=clock2)
+    reqs2 = _reqs(2, seed=7)
+    srv2.serve_batch([p for p, _, _ in reqs2],
+                     [mn for _, mn, _ in reqs2],
+                     [sd for _, _, sd in reqs2])
+    assert all("tenant_id" not in e
+               for e in srv2.capture.trace().events)
+    srv2.close()
 
 
 # ----------------------------------------------------------------- backtest
